@@ -1,0 +1,42 @@
+"""cometbft_tpu — a TPU-native BFT consensus framework.
+
+A ground-up rebuild of the capabilities of CometBFT (Tendermint-lineage BFT
+consensus engine; reference: 0xElder/cometbft) designed TPU-first:
+
+- The signature-verification / vote-tallying hot path (per-vote ed25519 verify
+  in ``VoteSet.add_vote``, commit batch verification in
+  ``types.validation.verify_commit``) runs as data-parallel JAX/XLA kernels on
+  TPU — limb-decomposed Curve25519 arithmetic vectorized over thousands of
+  signatures, sharded over a device mesh for very large validator sets.
+- The engine around it (consensus state machine, ABCI boundary, p2p gossip,
+  mempool, stores, light client, RPC, CLI) is an asyncio host runtime mirroring
+  the reference's reactor architecture (reference: node/node.go,
+  internal/consensus/state.go).
+
+Layout (mirrors SURVEY.md §1 layer map):
+  libs/      service lifecycle, logging, pubsub, bits       (ref: libs/, internal/)
+  crypto/    keys, ed25519, merkle, tmhash, batch dispatch  (ref: crypto/)
+  ops/       JAX/Pallas TPU kernels (fe25519, ed25519, sha) (ref: none — TPU-native)
+  parallel/  mesh/sharding for multi-chip batch verify      (ref: none — TPU-native)
+  types/     Block, Vote, Commit, ValidatorSet, VoteSet     (ref: types/)
+  abci/      Application interface, clients, kvstore app    (ref: abci/, proxy/)
+  consensus/ state machine, WAL, replay, reactor            (ref: internal/consensus/)
+  mempool/   CList mempool with lanes, reactor              (ref: mempool/)
+  p2p/       secret connection, mconn, switch, pex          (ref: p2p/)
+  state/     BlockExecutor, state store, validation         (ref: state/)
+  store/     block store                                    (ref: store/)
+  light/     light client, verifier, detector               (ref: light/)
+  rpc/       JSON-RPC server/clients, core methods          (ref: rpc/)
+  node/      node assembly                                  (ref: node/)
+  cmd/       CLI                                            (ref: cmd/cometbft/)
+  config/    config tree + TOML                             (ref: config/)
+  privval/   file signer w/ double-sign protection          (ref: privval/)
+  db/        embedded KV (sqlite-backed + memdb)            (ref: db/)
+"""
+
+__version__ = "0.1.0"
+
+# Protocol versions (reference: version/version.go:21)
+BLOCK_PROTOCOL = 11
+P2P_PROTOCOL = 9
+ABCI_SEMVER = "2.0.0"

@@ -1,0 +1,8 @@
+"""Crypto layer: key/signature interfaces, ed25519, merkle, hashing.
+
+Reference: crypto/crypto.go — PubKey, PrivKey, BatchVerifier contracts.
+"""
+from .keys import PubKey, PrivKey, BatchVerifier, address_hash
+from . import tmhash
+
+__all__ = ["PubKey", "PrivKey", "BatchVerifier", "address_hash", "tmhash"]

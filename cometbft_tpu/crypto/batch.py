@@ -1,0 +1,65 @@
+"""Batch-verifier dispatch: key type + configured backend -> BatchVerifier.
+
+Reference: crypto/batch/batch.go — CreateBatchVerifier (:10),
+SupportsBatchVerifier (:21); only ed25519 supports batching.
+
+TPU-native addition: a process-global backend selector (the `crypto.backend`
+config key from BASELINE.json's north star). Backends:
+  * "tpu"  — JAX/XLA data-parallel verifier (ops/ed25519_jax.py); used when a
+             TPU (or any JAX device) is available. Falls back to "cpu" when
+             JAX import or device init fails.
+  * "cpu"  — per-signature OpenSSL loop (crypto/ed25519.py).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from . import ed25519
+from .keys import BatchVerifier, PubKey
+
+_backend: Optional[str] = None
+
+
+def set_backend(name: str) -> None:
+    """Select the batch-verification backend: 'tpu', 'cpu', or 'auto'."""
+    global _backend
+    if name not in ("tpu", "cpu", "auto"):
+        raise ValueError(f"unknown crypto backend {name!r}")
+    _backend = None if name == "auto" else name
+
+
+def get_backend() -> str:
+    if _backend is not None:
+        return _backend
+    env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
+    if env:
+        env = env.lower()
+        if env in ("tpu", "cpu"):
+            return env
+        if env != "auto":
+            raise ValueError(
+                f"COMETBFT_TPU_CRYPTO_BACKEND={env!r}: expected tpu|cpu|auto")
+    try:
+        from ..ops import ed25519_jax  # noqa: F401
+        return "tpu"
+    except Exception:
+        return "cpu"
+
+
+def supports_batch_verifier(pub_key: PubKey) -> bool:
+    """Only ed25519 supports batching (reference: batch.go:21)."""
+    return pub_key.type() == ed25519.KEY_TYPE
+
+
+def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
+    """Reference: batch.go:10 — errors for unsupported key types."""
+    if pub_key.type() != ed25519.KEY_TYPE:
+        raise ValueError(f"batch verification unsupported for {pub_key.type()}")
+    if get_backend() == "tpu":
+        try:
+            from ..ops.ed25519_jax import TpuBatchVerifier
+            return TpuBatchVerifier()
+        except Exception:
+            pass
+    return ed25519.CpuBatchVerifier()

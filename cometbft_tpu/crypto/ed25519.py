@@ -1,0 +1,154 @@
+"""ed25519 keys — the default validator key type.
+
+Reference: crypto/ed25519/ed25519.go — curve25519-voi with ZIP-215
+verification semantics (:36-44), LRU expanded-pubkey cache of size 4096
+(:62-68), batch verification (:189-222).
+
+Design here:
+  * Signing and the fast path of single verification use OpenSSL via the
+    ``cryptography`` package (same performance class as the reference's Go).
+  * OpenSSL implements cofactorless RFC-8032 verification; ZIP-215 is strictly
+    more permissive (cofactored + permissive point decoding), so an OpenSSL
+    "accept" is always a ZIP-215 "accept". On OpenSSL "reject" we re-check
+    with the exact ZIP-215 golden model so consensus-visible semantics match
+    the reference byte-for-byte.
+  * Batch verification dispatches to the TPU backend (ops.ed25519_jax) when
+    available, falling back to a CPU loop. See crypto/batch.py for dispatch.
+"""
+from __future__ import annotations
+
+import secrets
+from collections import OrderedDict
+from typing import Sequence
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from . import _ed25519_ref as ref
+from .keys import BatchVerifier, PrivKey, PubKey, address_hash
+
+KEY_TYPE = "ed25519"
+PUB_KEY_SIZE = 32
+PRIV_KEY_SIZE = 64  # seed || pubkey, matching the reference's 64-byte privkey
+SIGNATURE_SIZE = 64
+
+# LRU cache of parsed OpenSSL pubkey objects
+# (reference: cachedVerification LRU, size 4096, ed25519.go:62-68)
+_CACHE_SIZE = 4096
+_pub_cache: OrderedDict[bytes, Ed25519PublicKey] = OrderedDict()
+
+
+def _cached_openssl_pub(raw: bytes) -> Ed25519PublicKey:
+    k = _pub_cache.get(raw)
+    if k is None:
+        k = Ed25519PublicKey.from_public_bytes(raw)
+        _pub_cache[raw] = k
+        if len(_pub_cache) > _CACHE_SIZE:
+            _pub_cache.popitem(last=False)
+    else:
+        _pub_cache.move_to_end(raw)
+    return k
+
+
+class Ed25519PubKey(PubKey):
+    __slots__ = ("_raw", "_addr")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PUB_KEY_SIZE:
+            raise ValueError(f"ed25519 pubkey must be {PUB_KEY_SIZE} bytes")
+        self._raw = bytes(raw)
+        self._addr: bytes | None = None
+
+    def address(self) -> bytes:
+        if self._addr is None:
+            self._addr = address_hash(self._raw)
+        return self._addr
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIGNATURE_SIZE:
+            return False
+        try:
+            _cached_openssl_pub(self._raw).verify(sig, msg)
+            return True
+        except InvalidSignature:
+            # ZIP-215 is strictly more permissive than OpenSSL's cofactorless
+            # check; re-verify with the exact golden model on reject.
+            return ref.verify(self._raw, msg, sig)
+        except ValueError:
+            # invalid point encoding for OpenSSL; ZIP-215 may still accept
+            return ref.verify(self._raw, msg, sig)
+
+
+class Ed25519PrivKey(PrivKey):
+    __slots__ = ("_seed", "_pub", "_ossl")
+
+    def __init__(self, raw: bytes):
+        # accept 32-byte seed or 64-byte seed||pub (reference format)
+        if len(raw) == 64:
+            raw = raw[:32]
+        if len(raw) != 32:
+            raise ValueError("ed25519 privkey must be 32-byte seed or 64 bytes")
+        self._seed = bytes(raw)
+        self._ossl = Ed25519PrivateKey.from_private_bytes(self._seed)
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+        self._pub = self._ossl.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw)
+
+    def bytes(self) -> bytes:
+        return self._seed + self._pub  # 64-byte reference layout
+
+    def sign(self, msg: bytes) -> bytes:
+        return self._ossl.sign(msg)
+
+    def pub_key(self) -> Ed25519PubKey:
+        return Ed25519PubKey(self._pub)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> Ed25519PrivKey:
+    return Ed25519PrivKey(secrets.token_bytes(32))
+
+
+def gen_priv_key_from_secret(secret: bytes) -> Ed25519PrivKey:
+    """Deterministic key from a secret (reference: GenPrivKeyFromSecret —
+    seed = SHA-256(secret))."""
+    from . import tmhash
+    return Ed25519PrivKey(tmhash.sum(secret))
+
+
+class CpuBatchVerifier(BatchVerifier):
+    """CPU batch verifier: verifies each signature individually.
+
+    This is the comparison baseline for the TPU path; see
+    ops/ed25519_jax.py for the data-parallel implementation.
+    """
+
+    def __init__(self):
+        self._items: list[tuple[Ed25519PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if not isinstance(pub_key, Ed25519PubKey):
+            raise TypeError("CpuBatchVerifier requires ed25519 keys")
+        if len(sig) != SIGNATURE_SIZE:
+            raise ValueError("malformed signature")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def verify(self) -> tuple[bool, Sequence[bool]]:
+        per = [pk.verify_signature(m, s) for pk, m, s in self._items]
+        return all(per), per

@@ -1,0 +1,205 @@
+"""RFC-6962-style binary merkle tree with domain-separated hashing, proofs,
+and chained proof operators.
+
+Reference: crypto/merkle/tree.go (HashFromByteSlices, leaf/inner prefixes,
+getSplitPoint), crypto/merkle/proof.go (Proof.Verify, aunts),
+crypto/merkle/proof_op.go (ProofOperators for IAVL-style chained proofs).
+
+A JAX-vectorized tree hash for large leaf counts lives in ops/merkle_jax.py.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Sequence
+
+from .tmhash import sum as _sha256
+
+LEAF_PREFIX = b"\x00"
+INNER_PREFIX = b"\x01"
+
+
+def leaf_hash(leaf: bytes) -> bytes:
+    return _sha256(LEAF_PREFIX + leaf)
+
+
+def inner_hash(left: bytes, right: bytes) -> bytes:
+    return _sha256(INNER_PREFIX + left + right)
+
+
+def empty_hash() -> bytes:
+    return _sha256(b"")
+
+
+def _split_point(n: int) -> int:
+    """Largest power of two strictly less than n (reference: tree.go:89)."""
+    if n < 1:
+        raise ValueError("n must be >= 1")
+    b = 1 << (n.bit_length() - 1)
+    return b // 2 if b == n else b
+
+
+def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
+    """Merkle root of items (reference: crypto/merkle/tree.go:11)."""
+    n = len(items)
+    if n == 0:
+        return empty_hash()
+    if n == 1:
+        return leaf_hash(items[0])
+    k = _split_point(n)
+    return inner_hash(hash_from_byte_slices(items[:k]),
+                      hash_from_byte_slices(items[k:]))
+
+
+@dataclass
+class Proof:
+    """Merkle inclusion proof (reference: crypto/merkle/proof.go)."""
+    total: int
+    index: int
+    leaf_hash: bytes
+    aunts: list[bytes] = field(default_factory=list)
+
+    def verify(self, root: bytes, leaf: bytes) -> None:
+        if self.total < 0:
+            raise ValueError("proof total must be >= 0")
+        if self.index < 0:
+            raise ValueError("proof index must be >= 0")
+        lh = leaf_hash(leaf)
+        if lh != self.leaf_hash:
+            raise ValueError("invalid leaf hash")
+        computed = self.compute_root_hash()
+        if computed != root:
+            raise ValueError("invalid proof: root mismatch")
+
+    def compute_root_hash(self) -> bytes:
+        return _compute_from_aunts(self.index, self.total, self.leaf_hash,
+                                   self.aunts)
+
+    def to_dict(self) -> dict:
+        return {"total": self.total, "index": self.index,
+                "leaf_hash": self.leaf_hash.hex(),
+                "aunts": [a.hex() for a in self.aunts]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Proof":
+        return cls(total=d["total"], index=d["index"],
+                   leaf_hash=bytes.fromhex(d["leaf_hash"]),
+                   aunts=[bytes.fromhex(a) for a in d["aunts"]])
+
+
+def _compute_from_aunts(index: int, total: int, lh: bytes,
+                        aunts: Sequence[bytes]) -> bytes:
+    if index >= total or index < 0 or total <= 0:
+        raise ValueError("invalid index/total")
+    if total == 1:
+        if aunts:
+            raise ValueError("unexpected aunts for single leaf")
+        return lh
+    if not aunts:
+        raise ValueError("missing aunts")
+    k = _split_point(total)
+    if index < k:
+        left = _compute_from_aunts(index, k, lh, aunts[:-1])
+        return inner_hash(left, aunts[-1])
+    right = _compute_from_aunts(index - k, total - k, lh, aunts[:-1])
+    return inner_hash(aunts[-1], right)
+
+
+def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple[bytes, list[Proof]]:
+    """Root + one inclusion proof per item (reference: proof.go:40)."""
+    trails, root_node = _trails_from_byte_slices(items)
+    root = root_node.hash if root_node else empty_hash()
+    proofs = []
+    for i, trail in enumerate(trails):
+        proofs.append(Proof(total=len(items), index=i,
+                            leaf_hash=trail.hash,
+                            aunts=trail.flatten_aunts()))
+    return root, proofs
+
+
+class _Node:
+    __slots__ = ("hash", "parent", "left", "right")
+
+    def __init__(self, h: bytes):
+        self.hash = h
+        self.parent = None
+        self.left = None   # sibling trail nodes, reference naming
+        self.right = None
+
+    def flatten_aunts(self) -> list[bytes]:
+        aunts = []
+        node = self
+        while node is not None:
+            if node.left is not None:
+                aunts.append(node.left.hash)
+            elif node.right is not None:
+                aunts.append(node.right.hash)
+            node = node.parent
+        return aunts
+
+
+def _trails_from_byte_slices(items: Sequence[bytes]):
+    n = len(items)
+    if n == 0:
+        return [], None
+    if n == 1:
+        node = _Node(leaf_hash(items[0]))
+        return [node], node
+    k = _split_point(n)
+    lefts, left_root = _trails_from_byte_slices(items[:k])
+    rights, right_root = _trails_from_byte_slices(items[k:])
+    root = _Node(inner_hash(left_root.hash, right_root.hash))
+    left_root.parent = root
+    left_root.right = right_root
+    right_root.parent = root
+    right_root.left = left_root
+    return lefts + rights, root
+
+
+# -- chained proof operators (reference: crypto/merkle/proof_op.go) ---------
+
+class ProofOperator:
+    def run(self, values: list[bytes]) -> list[bytes]:
+        raise NotImplementedError
+
+    def get_key(self) -> bytes:
+        raise NotImplementedError
+
+
+@dataclass
+class ValueOp(ProofOperator):
+    """Proves leaf value inclusion under a root (reference: proof_value.go)."""
+    key: bytes
+    proof: Proof
+
+    def run(self, values: list[bytes]) -> list[bytes]:
+        if len(values) != 1:
+            raise ValueError("ValueOp expects one value")
+        vhash = _sha256(values[0])
+        lh = leaf_hash(vhash)
+        if lh != self.proof.leaf_hash:
+            raise ValueError("leaf hash mismatch")
+        return [self.proof.compute_root_hash()]
+
+    def get_key(self) -> bytes:
+        return self.key
+
+
+class ProofOperators(list):
+    def verify(self, root: bytes, keypath: Sequence[bytes],
+               args: list[bytes]) -> None:
+        keys = list(keypath)
+        for op in self:
+            key = op.get_key()
+            if key:
+                if not keys or keys[-1] != key:
+                    raise ValueError(f"key mismatch on {key!r}")
+                keys.pop()
+            args = op.run(args)
+        if args[0] != root:
+            raise ValueError("root mismatch after proof chain")
+        if keys:
+            raise ValueError("unconsumed keypath")
+
+    def verify_value(self, root: bytes, keypath: Sequence[bytes],
+                     value: bytes) -> None:
+        self.verify(root, keypath, [value])

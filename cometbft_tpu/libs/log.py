@@ -1,0 +1,94 @@
+"""Structured logging with per-module level filters.
+
+Reference: libs/log/logger.go (slog-based structured logger),
+libs/log/filter.go (per-module level filtering).
+"""
+from __future__ import annotations
+
+import logging
+import sys
+import time
+from typing import Any
+
+
+class Logger:
+    """Key-value structured logger, mirroring the reference's log.Logger
+    interface (Debug/Info/Error + With for bound context)."""
+
+    __slots__ = ("_logger", "_ctx")
+
+    def __init__(self, logger: logging.Logger, ctx: dict[str, Any] | None = None):
+        self._logger = logger
+        self._ctx = ctx or {}
+
+    def with_(self, **kv: Any) -> "Logger":
+        return Logger(self._logger, {**self._ctx, **kv})
+
+    def _fmt(self, msg: str, kv: dict[str, Any]) -> str:
+        items = {**self._ctx, **kv}
+        if not items:
+            return msg
+        kvs = " ".join(f"{k}={_render(v)}" for k, v in items.items())
+        return f"{msg} {kvs}"
+
+    def debug(self, msg: str, **kv: Any) -> None:
+        if self._logger.isEnabledFor(logging.DEBUG):
+            self._logger.debug(self._fmt(msg, kv))
+
+    def info(self, msg: str, **kv: Any) -> None:
+        if self._logger.isEnabledFor(logging.INFO):
+            self._logger.info(self._fmt(msg, kv))
+
+    def warn(self, msg: str, **kv: Any) -> None:
+        self._logger.warning(self._fmt(msg, kv))
+
+    def error(self, msg: str, **kv: Any) -> None:
+        self._logger.error(self._fmt(msg, kv))
+
+
+def _render(v: Any) -> str:
+    if isinstance(v, bytes):
+        return v.hex().upper()[:16] or "''"
+    s = str(v)
+    if " " in s:
+        return repr(s)
+    return s
+
+
+_configured = False
+
+
+def _configure_root(level: int = logging.INFO) -> None:
+    global _configured
+    if _configured:
+        return
+    h = logging.StreamHandler(sys.stderr)
+    h.setFormatter(logging.Formatter("%(asctime)s %(levelname).1s %(name)s: %(message)s"))
+    root = logging.getLogger("cometbft")
+    root.addHandler(h)
+    root.setLevel(level)
+    root.propagate = False
+    _configured = True
+
+
+def new_logger(module: str = "main", level: str | int = logging.INFO, **ctx: Any) -> Logger:
+    _configure_root()
+    if isinstance(level, str):
+        level = getattr(logging, level.upper())
+    lg = logging.getLogger(f"cometbft.{module}")
+    lg.setLevel(level)
+    return Logger(lg, ctx)
+
+
+def nop_logger() -> Logger:
+    lg = logging.getLogger("cometbft.nop")
+    if not lg.handlers:
+        lg.addHandler(logging.NullHandler())
+        lg.setLevel(logging.CRITICAL + 1)
+        lg.propagate = False
+    return Logger(lg)
+
+
+def set_module_level(module: str, level: str) -> None:
+    """Per-module level filter (reference: libs/log/filter.go)."""
+    logging.getLogger(f"cometbft.{module}").setLevel(getattr(logging, level.upper()))

@@ -1,0 +1,126 @@
+"""Crypto layer tests.
+
+Modeled on the reference's crypto tests: crypto/ed25519/ed25519_test.go
+(sign/verify round-trip, batch verify), crypto/merkle/tree_test.go
+(root/proof construction + RFC-6962 vectors).
+"""
+import hashlib
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519, merkle, tmhash, batch
+from cometbft_tpu.crypto import _ed25519_ref as ref
+
+
+class TestEd25519:
+    def test_sign_verify_roundtrip(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        msg = b"consensus is hard"
+        sig = priv.sign(msg)
+        assert len(sig) == 64
+        assert pub.verify_signature(msg, sig)
+        assert not pub.verify_signature(b"other", sig)
+        assert not pub.verify_signature(msg, b"\x00" * 64)
+        assert not pub.verify_signature(msg, sig[:-1])
+
+    def test_pure_python_ref_matches_openssl(self):
+        priv = ed25519.gen_priv_key()
+        seed = priv.bytes()[:32]
+        assert ref.public_key(seed) == priv.pub_key().bytes()
+        msg = b"golden model agreement"
+        assert ref.sign(seed, msg) == priv.sign(msg)
+        assert ref.verify(priv.pub_key().bytes(), msg, priv.sign(msg))
+
+    def test_deterministic_from_secret(self):
+        a = ed25519.gen_priv_key_from_secret(b"hello")
+        b = ed25519.gen_priv_key_from_secret(b"hello")
+        assert a.bytes() == b.bytes()
+        assert a.bytes()[:32] == tmhash.sum(b"hello")
+
+    def test_address_is_truncated_sha256(self):
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        assert pub.address() == hashlib.sha256(pub.bytes()).digest()[:20]
+        assert len(pub.address()) == 20
+
+    def test_noncanonical_s_rejected(self):
+        priv = ed25519.gen_priv_key()
+        msg = b"m"
+        sig = bytearray(priv.sign(msg))
+        s = int.from_bytes(sig[32:], "little")
+        bad = (s + ref.L).to_bytes(32, "little")
+        sig[32:] = bad
+        assert not priv.pub_key().verify_signature(msg, bytes(sig))
+        assert not ref.verify(priv.pub_key().bytes(), msg, bytes(sig))
+
+    def test_zip215_batch_equation(self):
+        items = []
+        for i in range(8):
+            priv = ed25519.gen_priv_key()
+            msg = f"vote {i}".encode()
+            items.append((priv.pub_key().bytes(), msg, priv.sign(msg)))
+        ok, per = ref.batch_verify(items)
+        assert ok and all(per)
+        # corrupt one signature -> batch fails, per-sig mask identifies it
+        bad = bytearray(items[3][2])
+        bad[0] ^= 0xFF
+        items[3] = (items[3][0], items[3][1], bytes(bad))
+        ok, per = ref.batch_verify(items)
+        assert not ok
+        assert per == [True, True, True, False, True, True, True, True]
+
+    def test_cpu_batch_verifier(self):
+        bv = ed25519.CpuBatchVerifier()
+        privs = [ed25519.gen_priv_key() for _ in range(5)]
+        for i, p in enumerate(privs):
+            msg = f"height {i}".encode()
+            bv.add(p.pub_key(), msg, p.sign(msg))
+        ok, per = bv.verify()
+        assert ok and list(per) == [True] * 5
+
+    def test_batch_dispatch(self):
+        pub = ed25519.gen_priv_key().pub_key()
+        assert batch.supports_batch_verifier(pub)
+        bv = batch.create_batch_verifier(pub)
+        assert bv is not None
+
+
+class TestMerkle:
+    def test_empty_root(self):
+        assert merkle.hash_from_byte_slices([]) == hashlib.sha256(b"").digest()
+
+    def test_single_leaf(self):
+        assert merkle.hash_from_byte_slices([b"x"]) == \
+            hashlib.sha256(b"\x00x").digest()
+
+    def test_two_leaves(self):
+        l0 = hashlib.sha256(b"\x00a").digest()
+        l1 = hashlib.sha256(b"\x00b").digest()
+        assert merkle.hash_from_byte_slices([b"a", b"b"]) == \
+            hashlib.sha256(b"\x01" + l0 + l1).digest()
+
+    @pytest.mark.parametrize("n", [1, 2, 3, 4, 5, 7, 8, 9, 33, 100])
+    def test_proofs_verify(self, n):
+        items = [f"item-{i}".encode() for i in range(n)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == merkle.hash_from_byte_slices(items)
+        for i, p in enumerate(proofs):
+            p.verify(root, items[i])
+            with pytest.raises(ValueError):
+                p.verify(root, b"wrong")
+        # proof for item i must not verify at root of modified set
+        items2 = list(items)
+        items2[0] = b"evil"
+        root2 = merkle.hash_from_byte_slices(items2)
+        if root2 != root:
+            with pytest.raises(ValueError):
+                proofs[0].verify(root2, items[0])
+
+    def test_split_point(self):
+        assert merkle._split_point(2) == 1
+        assert merkle._split_point(3) == 2
+        assert merkle._split_point(4) == 2
+        assert merkle._split_point(5) == 4
+        assert merkle._split_point(8) == 4
+        assert merkle._split_point(9) == 8
