@@ -1,0 +1,82 @@
+"""North-star benchmark: 10k-validator Commit verification on TPU.
+
+Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
+
+Workload (BASELINE.json config #5 / north star): verify 10,000 ed25519
+signatures over distinct vote sign-bytes — the hot path of
+types/validation.go verifyCommitBatch in the reference.  Baseline is the
+same batch on the CPU single-signature path (OpenSSL, the performance class
+of the reference's Go curve25519-voi path).  vs_baseline = speedup (x).
+"""
+import json
+import secrets
+import sys
+import time
+
+import numpy as np
+
+
+def make_workload(n: int, msg_len: int = 110):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    items = []
+    base = secrets.token_bytes(msg_len - 8)
+    for i in range(n):
+        sk = Ed25519PrivateKey.generate()
+        pub = sk.public_key().public_bytes(Encoding.Raw, PublicFormat.Raw)
+        msg = base + i.to_bytes(8, "little")  # distinct per-validator votes
+        items.append((pub, msg, sk.sign(msg)))
+    return items
+
+
+def cpu_verify(items):
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+    from cryptography.exceptions import InvalidSignature
+    ok = True
+    for pub, msg, sig in items:
+        try:
+            Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+        except InvalidSignature:
+            ok = False
+    return ok
+
+
+def main():
+    n = 10_000
+    items = make_workload(n)
+
+    from cometbft_tpu.ops import ed25519_jax as ej
+
+    # CPU baseline (sampled, extrapolated)
+    sample = items[:1000]
+    t0 = time.perf_counter()
+    assert cpu_verify(sample)
+    cpu_ms = (time.perf_counter() - t0) * 1000.0 * (n / len(sample))
+
+    # warm up compile for the 10k bucket, then measure end-to-end p50
+    ok, mask = ej.verify_batch(items)
+    assert ok, "workload must verify"
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ok, _ = ej.verify_batch(items)
+        times.append((time.perf_counter() - t0) * 1000.0)
+    assert ok
+    tpu_ms = float(np.median(times))
+
+    print(json.dumps({
+        "metric": "commit_verify_10k_sigs_p50",
+        "value": round(tpu_ms, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / tpu_ms, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

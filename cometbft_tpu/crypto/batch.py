@@ -19,6 +19,7 @@ from . import ed25519
 from .keys import BatchVerifier, PubKey
 
 _backend: Optional[str] = None
+_auto_probe: Optional[str] = None   # cached auto-detection result
 
 
 def set_backend(name: str) -> None:
@@ -30,6 +31,7 @@ def set_backend(name: str) -> None:
 
 
 def get_backend() -> str:
+    global _auto_probe
     if _backend is not None:
         return _backend
     env = os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND")
@@ -40,11 +42,13 @@ def get_backend() -> str:
         if env != "auto":
             raise ValueError(
                 f"COMETBFT_TPU_CRYPTO_BACKEND={env!r}: expected tpu|cpu|auto")
-    try:
-        from ..ops import ed25519_jax  # noqa: F401
-        return "tpu"
-    except Exception:
-        return "cpu"
+    if _auto_probe is None:
+        try:
+            from ..ops import ed25519_jax  # noqa: F401
+            _auto_probe = "tpu"
+        except Exception:
+            _auto_probe = "cpu"
+    return _auto_probe
 
 
 def supports_batch_verifier(pub_key: PubKey) -> bool:

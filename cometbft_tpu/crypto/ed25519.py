@@ -74,6 +74,14 @@ class Ed25519PubKey(PubKey):
         return KEY_TYPE
 
     def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        # NOTE: an OpenSSL reject falls back to the exact-but-slow Python
+        # ZIP-215 model (required for consensus-identical semantics: a
+        # cofactorless reject may still be a cofactored accept when A/R have
+        # torsion components, which cannot be detected cheaply). This makes
+        # invalid signatures ~1000x costlier than valid ones — an
+        # amplification lever that the native/ C++ ZIP-215 verifier
+        # (planned; see SURVEY §7 hard parts) removes by making the exact
+        # check fast in both directions.
         if len(sig) != SIGNATURE_SIZE:
             return False
         try:

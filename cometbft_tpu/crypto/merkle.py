@@ -157,6 +157,17 @@ def _trails_from_byte_slices(items: Sequence[bytes]):
 
 # -- chained proof operators (reference: crypto/merkle/proof_op.go) ---------
 
+def _uvarint(n: int) -> bytes:
+    """Uvarint length prefix (reference: crypto/merkle/types.go:30
+    encodeByteSlice)."""
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        out.append(b | 0x80 if n else b)
+        if not n:
+            return bytes(out)
+
 class ProofOperator:
     def run(self, values: list[bytes]) -> list[bytes]:
         raise NotImplementedError
@@ -175,7 +186,10 @@ class ValueOp(ProofOperator):
         if len(values) != 1:
             raise ValueError("ValueOp expects one value")
         vhash = _sha256(values[0])
-        lh = leaf_hash(vhash)
+        # leaf binds <key, value-hash> as length-prefixed pair
+        # (reference: proof_value.go:89-102 encodeByteSlice(key)+(vhash))
+        kv = _uvarint(len(self.key)) + self.key + _uvarint(len(vhash)) + vhash
+        lh = leaf_hash(kv)
         if lh != self.proof.leaf_hash:
             raise ValueError("leaf hash mismatch")
         return [self.proof.compute_root_hash()]

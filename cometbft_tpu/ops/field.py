@@ -1,0 +1,224 @@
+"""GF(2^255 - 19) arithmetic as vectorized limb operations for TPU.
+
+Design notes (TPU-first; the reference uses curve25519-voi's 64-bit host
+arithmetic, crypto/ed25519/ed25519.go — nothing here is a translation):
+
+  * A field element is an int32 array whose last axis holds 32 little-endian
+    radix-2^8 limbs.  8-bit limbs keep every partial product far inside int32
+    (32 * 600^2 < 2^24) and line up with the int8 MXU path for later
+    optimization.
+  * Representations are redundant: limbs may be negative or exceed 255
+    between operations.  `mul` renormalizes its output to |limb| <= ~300;
+    add/sub/neg are lazy (no carry).  All ops are correct mod p for inputs
+    with |limb| <= ~600, which every composition below respects.
+  * 2^256 = 2*p + 38, so folding the carry out of limb 31 into limb 0 with
+    weight 38 preserves the value mod p.
+  * `canonical` produces the unique representative in [0, p) with limbs in
+    [0, 256); carry resolution there runs *sequentially over the 32 limbs*
+    (exact in one sweep) — the batch axis provides all the parallelism, so
+    32 scalar-per-lane steps cost nothing.
+
+Everything is shape-polymorphic over leading batch axes and jit-safe.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.lax as lax
+import jax.numpy as jnp
+
+P = 2**255 - 19
+LIMBS = 32
+_FOLD = 38  # 2^256 mod p
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """Host: python int -> 32 int32 limbs (canonical)."""
+    x = x % P
+    return np.frombuffer(x.to_bytes(32, "little"), dtype=np.uint8).astype(np.int32)
+
+
+def from_limbs(a) -> int:
+    """Host: limb array (any redundancy) -> python int mod p. Test helper."""
+    limbs = np.asarray(a, dtype=np.int64).reshape(-1)
+    val = 0
+    for i, limb in enumerate(limbs):
+        val += int(limb) << (8 * i)
+    return val % P
+
+
+def constant(x: int) -> jnp.ndarray:
+    return jnp.asarray(to_limbs(x))
+
+
+def carry_fold(x: jnp.ndarray) -> jnp.ndarray:
+    """One parallel carry pass; the carry out of limb 31 folds back into
+    limb 0 with weight 38.  Value preserved mod p; magnitudes shrink ~256x
+    per pass.  Handles negative limbs (arithmetic shift = floor division)."""
+    c = x >> 8
+    lo = x & 255
+    c = jnp.roll(c, 1, axis=-1)
+    c = c.at[..., 0].multiply(_FOLD)
+    return lo + c
+
+
+def normalize(x: jnp.ndarray, passes: int = 4) -> jnp.ndarray:
+    for _ in range(passes):
+        x = carry_fold(x)
+    return x
+
+
+def add(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return x + y
+
+
+def sub(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return x - y
+
+
+def neg(x: jnp.ndarray) -> jnp.ndarray:
+    return -x
+
+
+def mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    """Field multiply. |input limbs| <= ~600 allowed; output |limbs| <= ~300.
+
+    Schoolbook convolution as 32 shifted multiply-accumulates (unrolled at
+    trace time; XLA fuses the chain), then the 2^256->38 fold and carries."""
+    batch = jnp.broadcast_shapes(x.shape[:-1], y.shape[:-1])
+    x = jnp.broadcast_to(x, batch + (LIMBS,))
+    y = jnp.broadcast_to(y, batch + (LIMBS,))
+    prod = jnp.zeros(batch + (2 * LIMBS - 1,), jnp.int32)
+    for i in range(LIMBS):
+        prod = prod.at[..., i:i + LIMBS].add(x[..., i:i + 1] * y)
+    lo = prod[..., :LIMBS]
+    hi = jnp.pad(prod[..., LIMBS:], [(0, 0)] * (x.ndim - 1) + [(0, 1)])
+    return normalize(lo + _FOLD * hi, passes=4)
+
+
+def sqr(x: jnp.ndarray) -> jnp.ndarray:
+    return mul(x, x)
+
+
+def mul_const(x: jnp.ndarray, c: int) -> jnp.ndarray:
+    """Multiply by a small nonnegative int (< 2^15)."""
+    return normalize(x * jnp.int32(c), passes=3)
+
+
+def pow2k(x: jnp.ndarray, k: int) -> jnp.ndarray:
+    """x^(2^k) via k squarings (fori_loop keeps the trace small)."""
+    if k <= 4:
+        for _ in range(k):
+            x = sqr(x)
+        return x
+    return lax.fori_loop(0, k, lambda _, v: sqr(v), x)
+
+
+def pow_p58(x: jnp.ndarray) -> jnp.ndarray:
+    """x^((p-5)/8) = x^(2^252 - 3). Standard ed25519 addition chain."""
+    x2 = sqr(x)                      # 2
+    t = sqr(sqr(x2))                 # 8
+    z9 = mul(x, t)                   # 9
+    z11 = mul(x2, z9)                # 11
+    z22 = sqr(z11)                   # 22
+    z_5_0 = mul(z9, z22)             # 2^5 - 1
+    t = pow2k(z_5_0, 5)
+    z_10_0 = mul(t, z_5_0)           # 2^10 - 1
+    t = pow2k(z_10_0, 10)
+    z_20_0 = mul(t, z_10_0)          # 2^20 - 1
+    t = pow2k(z_20_0, 20)
+    z_40_0 = mul(t, z_20_0)          # 2^40 - 1
+    t = pow2k(z_40_0, 10)
+    z_50_0 = mul(t, z_10_0)          # 2^50 - 1
+    t = pow2k(z_50_0, 50)
+    z_100_0 = mul(t, z_50_0)         # 2^100 - 1
+    t = pow2k(z_100_0, 100)
+    z_200_0 = mul(t, z_100_0)        # 2^200 - 1
+    t = pow2k(z_200_0, 50)
+    z_250_0 = mul(t, z_50_0)         # 2^250 - 1
+    t = pow2k(z_250_0, 2)
+    return mul(x, t)                 # 2^252 - 3
+
+
+# --- canonicalization -------------------------------------------------------
+
+# A 4p offset in 32 limbs (limb values up to 510): adding it makes any
+# redundant value here (|v| <= ~1.2 * 2^256 < 2.4p) positive without changing
+# it mod p.  Built as 2 * (2p), where 2p = 2^256 - 38 fits canonical limbs.
+_2P_BYTES = np.frombuffer((2 * P).to_bytes(32, "little"), np.uint8).astype(np.int32)
+_FOUR_P = jnp.asarray(2 * _2P_BYTES)
+_P_NP = np.frombuffer(P.to_bytes(32, "little"), np.uint8).astype(np.int32)
+_P_LIMBS = jnp.asarray(_P_NP)
+
+
+def _seq_carry(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Exact sequential carry sweep: limbs -> [0,256) plus carry-out.
+    32 scalar-per-lane steps; batch axes carry the parallelism."""
+    outs = []
+    c = jnp.zeros(x.shape[:-1], jnp.int32)
+    for i in range(LIMBS):
+        v = x[..., i] + c
+        outs.append(v & 255)
+        c = v >> 8
+    return jnp.stack(outs, axis=-1), c
+
+
+def canonical(x: jnp.ndarray) -> jnp.ndarray:
+    """Unique representative in [0, p), limbs in [0, 256)."""
+    x = normalize(x, passes=4)          # |limbs| <= ~300
+    x = x + _FOUR_P                     # value now positive, < 2^257 + 2^256
+    for _ in range(3):                  # sweep + fold until carry-out is 0
+        x, c = _seq_carry(x)
+        x = x.at[..., 0].add(_FOLD * c)
+    # value in [0, 2^256) < 3p: subtract p at most twice
+    for _ in range(2):
+        ge = _ge_p(x)
+        diff = _seq_sub_p(x)
+        x = jnp.where(ge[..., None], diff, x)
+    return x
+
+
+def _ge_p(x: jnp.ndarray) -> jnp.ndarray:
+    """Lexicographic x >= p for canonical-limbed x ([0,256))."""
+    ge = jnp.zeros(x.shape[:-1], bool)
+    eq_above = jnp.ones(x.shape[:-1], bool)
+    for i in range(LIMBS - 1, -1, -1):
+        pi = int(_P_NP[i])
+        ge = ge | (eq_above & (x[..., i] > pi))
+        eq_above = eq_above & (x[..., i] == pi)
+    return ge | eq_above                # x == p counts as >=
+
+
+def _seq_sub_p(x: jnp.ndarray) -> jnp.ndarray:
+    """x - p with an exact sequential borrow sweep (x assumed >= p)."""
+    outs = []
+    c = jnp.zeros(x.shape[:-1], jnp.int32)
+    for i in range(LIMBS):
+        v = x[..., i] - int(_P_NP[i]) + c
+        outs.append(v & 255)
+        c = v >> 8
+    return jnp.stack(outs, axis=-1)
+
+
+def is_zero(x: jnp.ndarray) -> jnp.ndarray:
+    """True where x ≡ 0 mod p (bool over batch axes)."""
+    return jnp.all(canonical(x) == 0, axis=-1)
+
+
+def eq(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
+    return is_zero(x - y)
+
+
+def parity(x: jnp.ndarray) -> jnp.ndarray:
+    """Low bit of the canonical value (the ed25519 sign-of-x bit)."""
+    return canonical(x)[..., 0] & 1
+
+
+def bytes_to_limbs(b: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] uint8 -> int32 limbs (no reduction; values >= p are fine in
+    the redundant representation — ZIP-215 permissive decoding relies on it)."""
+    return b.astype(jnp.int32)
+
+
+def canonical_bytes(x: jnp.ndarray) -> jnp.ndarray:
+    """[..., 32] uint8 canonical little-endian encoding."""
+    return canonical(x).astype(jnp.uint8)

@@ -124,3 +124,27 @@ class TestMerkle:
         assert merkle._split_point(5) == 4
         assert merkle._split_point(8) == 4
         assert merkle._split_point(9) == 8
+
+
+class TestValueOp:
+    def test_value_op_binds_key(self):
+        """Leaf is leafHash(uvarint-len(key)+key + uvarint-len(vhash)+vhash)
+        (reference: crypto/merkle/proof_value.go:89-102)."""
+        from cometbft_tpu.crypto import merkle, tmhash
+        kvs = [(b"k%d" % i, b"v%d" % i) for i in range(5)]
+        leaves = []
+        for k, v in kvs:
+            vhash = tmhash.sum(v)
+            leaves.append(merkle._uvarint(len(k)) + k +
+                          merkle._uvarint(len(vhash)) + vhash)
+        root, proofs = merkle.proofs_from_byte_slices(leaves)
+        op = merkle.ValueOp(key=kvs[2][0], proof=proofs[2])
+        ops = merkle.ProofOperators([op])
+        ops.verify_value(root, [kvs[2][0]], kvs[2][1])  # succeeds
+        import pytest
+        with pytest.raises(ValueError):
+            ops.verify_value(root, [kvs[2][0]], b"wrong-value")
+        # a proof for k2 must not verify under a different claimed key
+        op_bad = merkle.ValueOp(key=b"k3", proof=proofs[2])
+        with pytest.raises(ValueError):
+            merkle.ProofOperators([op_bad]).verify_value(root, [b"k3"], kvs[2][1])
