@@ -1,0 +1,42 @@
+"""PubKey ↔ proto PublicKey conversion.
+
+Reference: crypto/encoding/codec.go — oneof sum keyed by key type
+(proto/cometbft/crypto/v1/keys.proto: ed25519=1, secp256k1=2, bls12381=3,
+secp256k1eth=4).
+"""
+from __future__ import annotations
+
+from . import ed25519
+from .keys import PubKey
+
+# proto oneof field name per key type
+_FIELD_BY_TYPE = {
+    "ed25519": "ed25519",
+    "secp256k1": "secp256k1",
+    "bls12_381": "bls12381",
+    "secp256k1eth": "secp256k1eth",
+}
+
+
+class EncodingError(Exception):
+    pass
+
+
+def pub_key_to_proto(pk: PubKey) -> dict:
+    field = _FIELD_BY_TYPE.get(pk.type())
+    if field is None:
+        raise EncodingError(f"unsupported key type {pk.type()}")
+    return {field: pk.bytes()}
+
+
+def pub_key_from_proto(d: dict) -> PubKey:
+    if "ed25519" in d:
+        return ed25519.Ed25519PubKey(d["ed25519"])
+    raise EncodingError(f"unsupported proto pubkey {sorted(d)}")
+
+
+def pub_key_from_type_and_bytes(key_type: str, raw: bytes) -> PubKey:
+    """Reference: crypto/encoding codec + internal/keytypes registry."""
+    if key_type == "ed25519":
+        return ed25519.Ed25519PubKey(raw)
+    raise EncodingError(f"unsupported key type {key_type}")

@@ -160,13 +160,8 @@ def _trails_from_byte_slices(items: Sequence[bytes]):
 def _uvarint(n: int) -> bytes:
     """Uvarint length prefix (reference: crypto/merkle/types.go:30
     encodeByteSlice)."""
-    out = bytearray()
-    while True:
-        b = n & 0x7F
-        n >>= 7
-        out.append(b | 0x80 if n else b)
-        if not n:
-            return bytes(out)
+    from ..wire.proto import encode_uvarint
+    return encode_uvarint(n)
 
 class ProofOperator:
     def run(self, values: list[bytes]) -> list[bytes]:

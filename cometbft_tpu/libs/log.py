@@ -71,21 +71,17 @@ def _configure_root(level: int = logging.INFO) -> None:
     _configured = True
 
 
-_explicit_levels: set[str] = set()
-
-
 def new_logger(module: str = "main", level: str | int | None = None,
                **ctx: Any) -> Logger:
     """Get a structured logger for a module.  level=None inherits the root
-    level; explicit levels (here or via set_module_level) are sticky and are
-    not clobbered by later default-level new_logger calls."""
+    level; an explicit level (here or via set_module_level) sticks because
+    default-level calls never touch the logger's level."""
     _configure_root()
     lg = logging.getLogger(f"cometbft.{module}")
     if level is not None:
         if isinstance(level, str):
             level = getattr(logging, level.upper())
         lg.setLevel(level)
-        _explicit_levels.add(module)
     return Logger(lg, ctx)
 
 
@@ -100,5 +96,4 @@ def nop_logger() -> Logger:
 
 def set_module_level(module: str, level: str) -> None:
     """Per-module level filter (reference: libs/log/filter.go)."""
-    _explicit_levels.add(module)
     logging.getLogger(f"cometbft.{module}").setLevel(getattr(logging, level.upper()))

@@ -239,10 +239,13 @@ def _verify_chunk(items) -> np.ndarray:
     return ok
 
 
-@functools.lru_cache(maxsize=None)
 def warmup(n: int) -> None:
     """Pre-compile the kernel for the bucket covering n lanes."""
-    m = _bucket(n)
+    _warmup_bucket(_bucket(n))
+
+
+@functools.lru_cache(maxsize=None)
+def _warmup_bucket(m: int) -> None:
     a = np.tile(np.frombuffer(_B_BYTES, np.uint8), (m, 1))
     r = np.tile(np.frombuffer(_IDENTITY_BYTES, np.uint8), (m, 1))
     z = np.zeros((253, m), np.int32)

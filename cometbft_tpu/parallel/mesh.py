@@ -24,6 +24,10 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
     """1-D mesh over the first n_devices JAX devices."""
     devs = jax.devices()
     if n_devices is not None:
+        if len(devs) < n_devices:
+            raise ValueError(
+                f"requested {n_devices}-device mesh but only "
+                f"{len(devs)} JAX devices are available")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (BATCH_AXIS,))
 

@@ -1,0 +1,5 @@
+"""Core consensus types: blocks, votes, validators, commits, evidence.
+
+Mirrors the capability surface of the reference's types/ package (~8.7k LoC)
+with byte-identical consensus-critical encodings (sign-bytes, hashes).
+"""

@@ -1,0 +1,93 @@
+"""Canonical sign-bytes: the exact bytes validators sign.
+
+Reference: types/canonical.go + proto/cometbft/types/v2/canonical.proto.
+Height/round are sfixed64 (fixed-size for canonicalization); the BlockID is
+dropped entirely for nil votes; sign-bytes are uvarint-length-delimited
+(libs/protoio MarshalDelimited).  Byte-identical output is pinned by the
+reference's own test vectors (types/vote_test.go TestVoteSignBytesTestVectors)
+in tests/test_wire.py.
+"""
+from __future__ import annotations
+
+from ..wire import pb, marshal_delimited
+from .block_id import BlockID
+from .timestamp import Timestamp
+
+# SignedMsgType (proto/cometbft/types/v2/types.proto)
+UNKNOWN_TYPE = 0
+PREVOTE_TYPE = 1
+PRECOMMIT_TYPE = 2
+PROPOSAL_TYPE = 32
+
+
+def is_vote_type_valid(t: int) -> bool:
+    return t in (PREVOTE_TYPE, PRECOMMIT_TYPE)
+
+
+def canonicalize_block_id(bid: BlockID) -> dict | None:
+    """nil → None (field omitted from sign-bytes); else CanonicalBlockID."""
+    if bid.is_nil():
+        return None
+    d: dict = {"part_set_header": bid.part_set_header.to_proto()}
+    if bid.hash:
+        d["hash"] = bid.hash
+    return d
+
+
+def _canonical_vote(chain_id: str, type_: int, height: int, round_: int,
+                    bid: BlockID, ts: Timestamp) -> dict:
+    d: dict = {"timestamp": ts.to_proto()}
+    if type_:
+        d["type"] = type_
+    if height:
+        d["height"] = height
+    if round_:
+        d["round"] = round_
+    cbid = canonicalize_block_id(bid)
+    if cbid is not None:
+        d["block_id"] = cbid
+    if chain_id:
+        d["chain_id"] = chain_id
+    return d
+
+
+def vote_sign_bytes(chain_id: str, type_: int, height: int, round_: int,
+                    bid: BlockID, ts: Timestamp) -> bytes:
+    """Reference: types/vote.go VoteSignBytes."""
+    return marshal_delimited(
+        pb.CANONICAL_VOTE,
+        _canonical_vote(chain_id, type_, height, round_, bid, ts))
+
+
+def vote_extension_sign_bytes(chain_id: str, height: int, round_: int,
+                              extension: bytes) -> bytes:
+    """Reference: types/vote.go VoteExtensionSignBytes."""
+    d: dict = {}
+    if extension:
+        d["extension"] = extension
+    if height:
+        d["height"] = height
+    if round_:
+        d["round"] = round_
+    if chain_id:
+        d["chain_id"] = chain_id
+    return marshal_delimited(pb.CANONICAL_VOTE_EXTENSION, d)
+
+
+def proposal_sign_bytes(chain_id: str, height: int, round_: int,
+                        pol_round: int, bid: BlockID,
+                        ts: Timestamp) -> bytes:
+    """Reference: types/proposal.go ProposalSignBytes."""
+    d: dict = {"type": PROPOSAL_TYPE, "timestamp": ts.to_proto()}
+    if height:
+        d["height"] = height
+    if round_:
+        d["round"] = round_
+    if pol_round:
+        d["pol_round"] = pol_round
+    cbid = canonicalize_block_id(bid)
+    if cbid is not None:
+        d["block_id"] = cbid
+    if chain_id:
+        d["chain_id"] = chain_id
+    return marshal_delimited(pb.CANONICAL_PROPOSAL, d)

@@ -1,0 +1,27 @@
+"""Signature cache: skip re-verification of identical (sig, addr, msg).
+
+Reference: types/signature_cache.go — map sig → (valAddr, signBytes),
+shared across light-client adjacent/non-adjacent checks.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+
+class SignatureCacheValue(NamedTuple):
+    validator_address: bytes
+    vote_sign_bytes: bytes
+
+
+class SignatureCache:
+    def __init__(self):
+        self._m: dict[bytes, SignatureCacheValue] = {}
+
+    def get(self, sig: bytes) -> Optional[SignatureCacheValue]:
+        return self._m.get(sig)
+
+    def add(self, sig: bytes, value: SignatureCacheValue) -> None:
+        self._m[sig] = value
+
+    def __len__(self) -> int:
+        return len(self._m)

@@ -1,0 +1,43 @@
+"""Transactions and their merkle hashing.
+
+Reference: types/tx.go — Tx.Hash = sha256(tx); Txs.Hash = merkle root over
+the per-tx hashes (leaves are TxIDs); Proof via merkle proofs.
+"""
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..crypto import merkle, tmhash
+
+
+def tx_hash(tx: bytes) -> bytes:
+    return tmhash.sum(tx)
+
+
+def tx_key(tx: bytes) -> bytes:
+    """Map key for mempool dedup (reference: types/tx.go TxKey —
+    the sha256 of the tx)."""
+    return tmhash.sum(tx)
+
+
+def txs_hash(txs: Sequence[bytes]) -> bytes:
+    return merkle.hash_from_byte_slices([tx_hash(tx) for tx in txs])
+
+
+def txs_proof(txs: Sequence[bytes], index: int):
+    """(root, proof) of tx at index (reference: Txs.Proof)."""
+    root, proofs = merkle.proofs_from_byte_slices(
+        [tx_hash(tx) for tx in txs])
+    return root, proofs[index]
+
+
+def compute_proto_size_overhead(n: int) -> int:
+    """Upper-bound proto overhead for a bytes field of length n
+    (reference: types/tx.go ComputeProtoSizeForTxs usage)."""
+    # field tag (1 byte for field 1) + uvarint length
+    ln = n
+    bytes_needed = 1
+    while ln >= 0x80:
+        ln >>= 7
+        bytes_needed += 1
+    return 1 + bytes_needed

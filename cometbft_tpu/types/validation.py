@@ -1,0 +1,286 @@
+"""Commit verification — the primary TPU offload seam.
+
+Reference: types/validation.go.  Semantics preserved exactly:
+  * batching requires >= 2 signatures, a batch-capable key type, and all
+    validators sharing one key type (:15-21);
+  * VerifyCommit checks ALL signatures (incentivization contract),
+    VerifyCommitLight* stop at 2/3 unless the AllSignatures variant;
+  * on batch failure, the first invalid signature is identified (:384-397);
+  * signature-cache hits skip verification and successes populate the cache.
+
+The batch path dispatches through crypto.batch.create_batch_verifier, which
+routes ed25519 batches to the TPU kernel (ops/ed25519_jax.py): one padded
+device batch verifies every signature and the voting-power tally is a masked
+segment-sum in the same XLA program.
+"""
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Optional
+
+from ..crypto import batch as crypto_batch
+from .commit import Commit, CommitSig, CommitError
+from .block_id import BlockID
+from .signature_cache import SignatureCache, SignatureCacheValue
+from .validator_set import ValidatorSet
+from .vote import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
+
+BATCH_VERIFY_THRESHOLD = 2
+
+
+class Fraction(NamedTuple):
+    numerator: int
+    denominator: int
+
+
+class VerificationError(Exception):
+    pass
+
+
+class NotEnoughVotingPowerError(VerificationError):
+    def __init__(self, got: int, needed: int):
+        super().__init__(
+            f"invalid commit -- insufficient voting power: got {got}, "
+            f"needed more than {needed}")
+        self.got = got
+        self.needed = needed
+
+
+def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    return (len(commit.signatures) >= BATCH_VERIFY_THRESHOLD and
+            crypto_batch.supports_batch_verifier(
+                vals.get_proposer().pub_key) and
+            vals.all_keys_have_same_type())
+
+
+def _verify_basic_vals_and_commit(vals: ValidatorSet, commit: Commit,
+                                  height: int, block_id: BlockID) -> None:
+    if vals is None:
+        raise VerificationError("nil validator set")
+    if commit is None:
+        raise VerificationError("nil commit")
+    if vals.size() != len(commit.signatures):
+        raise VerificationError(
+            f"invalid commit -- wrong set size: {vals.size()} vs "
+            f"{len(commit.signatures)}")
+    if height != commit.height:
+        raise VerificationError(
+            f"invalid commit -- wrong height: {height} vs {commit.height}")
+    if block_id != commit.block_id:
+        raise VerificationError(
+            f"invalid commit -- wrong block ID: want {block_id}, "
+            f"got {commit.block_id}")
+
+
+def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
+                  height: int, commit: Commit,
+                  cache: Optional[SignatureCache] = None) -> None:
+    """+2/3 signed; checks ALL signatures (reference: VerifyCommit :30)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag == BLOCK_ID_FLAG_ABSENT  # noqa: E731
+    count = lambda c: c.block_id_flag == BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=True, look_up_by_index=True, cache=cache)
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=True, look_up_by_index=True, cache=cache)
+
+
+def verify_commit_light(chain_id: str, vals: ValidatorSet,
+                        block_id: BlockID, height: int, commit: Commit,
+                        count_all_signatures: bool = False,
+                        cache: Optional[SignatureCache] = None) -> None:
+    """Light-client variant: stops at 2/3 unless count_all_signatures.
+
+    Reference: VerifyCommitLight / ...AllSignatures / ...WithCache (:65)."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    voting_power_needed = vals.total_voting_power() * 2 // 3
+    ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=True, cache=cache)
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=True, cache=cache)
+
+
+def verify_commit_light_trusting(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        trust_level: Fraction, count_all_signatures: bool = False,
+        cache: Optional[SignatureCache] = None) -> None:
+    """trustLevel (e.g. 1/3) of a TRUSTED validator set signed; used for
+    skipping verification.  Looks validators up by address since the sets
+    need not correspond (reference: VerifyCommitLightTrusting :150)."""
+    if vals is None:
+        raise VerificationError("nil validator set")
+    if trust_level.denominator == 0:
+        raise VerificationError("trustLevel has zero Denominator")
+    if commit is None:
+        raise VerificationError("nil commit")
+    product = vals.total_voting_power() * trust_level.numerator
+    if product >= (1 << 63):
+        raise VerificationError(
+            "int64 overflow while calculating voting power needed")
+    voting_power_needed = product // trust_level.denominator
+    ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
+    count = lambda c: True  # noqa: E731
+    if _should_batch_verify(vals, commit):
+        _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=False, cache=cache)
+    else:
+        _verify_commit_single(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=False, cache=cache)
+
+
+# ---------------------------------------------------------------------------
+
+
+def _verify_commit_batch(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        voting_power_needed: int,
+        ignore_sig: Callable[[CommitSig], bool],
+        count_sig: Callable[[CommitSig], bool],
+        count_all_signatures: bool, look_up_by_index: bool,
+        cache: Optional[SignatureCache]) -> None:
+    """Reference: verifyCommitBatch (:265)."""
+    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    seen_vals: dict[int, int] = {}
+    batch_sig_idxs: list[int] = []
+    tallied = 0
+
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(
+                commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise VerificationError(
+                    f"double vote from {val} "
+                    f"({seen_vals[val_idx]} and {idx})")
+            seen_vals[val_idx] = idx
+
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+
+        cache_hit = False
+        if cache is not None:
+            cv = cache.get(commit_sig.signature)
+            cache_hit = (cv is not None and
+                         cv.validator_address == val.pub_key.address() and
+                         cv.vote_sign_bytes == vote_sign_bytes)
+        if not cache_hit:
+            bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
+            batch_sig_idxs.append(idx)
+
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            break
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+    if not batch_sig_idxs:
+        return  # everything was cached
+
+    ok, valid_sigs = bv.verify()
+    if ok:
+        if cache is not None:
+            for i in range(len(valid_sigs)):
+                idx = batch_sig_idxs[i]
+                sig = commit.signatures[idx]
+                cache.add(sig.signature, SignatureCacheValue(
+                    sig.validator_address,
+                    commit.vote_sign_bytes(chain_id, idx)))
+        return
+
+    # find and report the first invalid signature
+    for i, sig_ok in enumerate(valid_sigs):
+        idx = batch_sig_idxs[i]
+        sig = commit.signatures[idx]
+        if not sig_ok:
+            raise VerificationError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}")
+        if cache is not None:
+            cache.add(sig.signature, SignatureCacheValue(
+                sig.validator_address,
+                commit.vote_sign_bytes(chain_id, idx)))
+    raise VerificationError(
+        "BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_single(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        voting_power_needed: int,
+        ignore_sig: Callable[[CommitSig], bool],
+        count_sig: Callable[[CommitSig], bool],
+        count_all_signatures: bool, look_up_by_index: bool,
+        cache: Optional[SignatureCache]) -> None:
+    """Reference: verifyCommitSingle (:413)."""
+    seen_vals: dict[int, int] = {}
+    tallied = 0
+    for idx, commit_sig in enumerate(commit.signatures):
+        if ignore_sig(commit_sig):
+            continue
+        try:
+            commit_sig.validate_basic()
+        except CommitError as e:
+            raise VerificationError(
+                f"invalid signature at index {idx}: {e}") from e
+        if look_up_by_index:
+            val = vals.validators[idx]
+        else:
+            val_idx, val = vals.get_by_address(
+                commit_sig.validator_address)
+            if val is None:
+                continue
+            if val_idx in seen_vals:
+                raise VerificationError(
+                    f"double vote from {val} "
+                    f"({seen_vals[val_idx]} and {idx})")
+            seen_vals[val_idx] = idx
+        if val.pub_key is None:
+            raise VerificationError(
+                f"validator {val} has a nil PubKey at index {idx}")
+
+        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
+
+        cache_hit = False
+        if cache is not None:
+            cv = cache.get(commit_sig.signature)
+            cache_hit = (cv is not None and
+                         cv.validator_address == val.pub_key.address() and
+                         cv.vote_sign_bytes == vote_sign_bytes)
+        if not cache_hit:
+            if not val.pub_key.verify_signature(vote_sign_bytes,
+                                                commit_sig.signature):
+                raise VerificationError(
+                    f"wrong signature (#{idx}): "
+                    f"{commit_sig.signature.hex().upper()}")
+            if cache is not None:
+                cache.add(commit_sig.signature, SignatureCacheValue(
+                    val.pub_key.address(), vote_sign_bytes))
+
+        if count_sig(commit_sig):
+            tallied += val.voting_power
+        if not count_all_signatures and tallied > voting_power_needed:
+            return
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)

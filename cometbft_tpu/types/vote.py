@@ -1,0 +1,194 @@
+"""Vote: a prevote or precommit, optionally carrying vote extensions.
+
+Reference: types/vote.go — Vote struct (:66-81), Verify/VerifyWithExtension/
+VerifyExtension (:247,256,281), ValidateBasic, MaxVoteBytes/extension caps.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field, replace
+
+from ..crypto.keys import PubKey
+from . import canonical
+from .block_id import BlockID
+from .part_set import PartSetError
+from .timestamp import Timestamp
+
+# reference: types/vote.go:20 — 1 MiB cap on any single extension
+MAX_VOTE_EXTENSION_SIZE = 1024 * 1024
+
+# BlockIDFlag (proto/cometbft/types/v2/validator.proto)
+BLOCK_ID_FLAG_UNKNOWN = 0
+BLOCK_ID_FLAG_ABSENT = 1
+BLOCK_ID_FLAG_COMMIT = 2
+BLOCK_ID_FLAG_NIL = 3
+
+
+class VoteError(Exception):
+    pass
+
+
+class InvalidSignatureError(VoteError):
+    pass
+
+
+@dataclass
+class Vote:
+    type: int = canonical.UNKNOWN_TYPE
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    timestamp: Timestamp = field(default_factory=Timestamp.zero)
+    validator_address: bytes = b""
+    validator_index: int = 0
+    signature: bytes = b""
+    extension: bytes = b""
+    extension_signature: bytes = b""
+    non_rp_extension: bytes = b""
+    non_rp_extension_signature: bytes = b""
+
+    # ------------------------------------------------------------------
+    def sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.vote_sign_bytes(
+            chain_id, self.type, self.height, self.round, self.block_id,
+            self.timestamp)
+
+    def extension_sign_bytes(self, chain_id: str) -> bytes:
+        return canonical.vote_extension_sign_bytes(
+            chain_id, self.height, self.round, self.extension)
+
+    def is_nil(self) -> bool:
+        return self.block_id.is_nil()
+
+    # ------------------------------------------------------------------
+    def _verify_vote_sig(self, chain_id: str, pub_key: PubKey) -> None:
+        if pub_key.address() != self.validator_address:
+            raise InvalidSignatureError(
+                "vote validator address does not match pubkey")
+        if not pub_key.verify_signature(self.sign_bytes(chain_id),
+                                        self.signature):
+            raise InvalidSignatureError("invalid vote signature")
+
+    def verify(self, chain_id: str, pub_key: PubKey) -> None:
+        """Reference: vote.go Verify — vote signature only."""
+        self._verify_vote_sig(chain_id, pub_key)
+
+    def verify_vote_and_extension(self, chain_id: str,
+                                  pub_key: PubKey) -> None:
+        """Reference: vote.go VerifyVoteAndExtension — for precommits on a
+        block, additionally checks the extension signature."""
+        self._verify_vote_sig(chain_id, pub_key)
+        if (self.type == canonical.PRECOMMIT_TYPE and
+                not self.block_id.is_nil()):
+            self.verify_extension(chain_id, pub_key)
+
+    def verify_extension(self, chain_id: str, pub_key: PubKey) -> None:
+        """Reference: vote.go VerifyExtension."""
+        if self.type != canonical.PRECOMMIT_TYPE:
+            return
+        if not pub_key.verify_signature(self.extension_sign_bytes(chain_id),
+                                        self.extension_signature):
+            raise InvalidSignatureError("invalid vote extension signature")
+
+    # ------------------------------------------------------------------
+    def validate_basic(self) -> None:
+        """Reference: vote.go ValidateBasic."""
+        if not canonical.is_vote_type_valid(self.type):
+            raise VoteError(f"invalid vote type {self.type}")
+        if self.height <= 0:
+            raise VoteError("vote height must be positive")
+        if self.round < 0:
+            raise VoteError("vote round must be non-negative")
+        try:
+            self.block_id.validate_basic()
+        except PartSetError as e:
+            raise VoteError(f"wrong BlockID: {e}") from e
+        if not self.block_id.is_nil() and not self.block_id.is_complete():
+            raise VoteError("BlockID must be either empty or complete")
+        if len(self.validator_address) != 20:
+            raise VoteError("wrong validator address size")
+        if self.validator_index < 0:
+            raise VoteError("negative validator index")
+        if len(self.signature) == 0:
+            raise VoteError("signature is missing")
+        if len(self.signature) > 64:
+            raise VoteError("signature is too big")
+        if self.type == canonical.PRECOMMIT_TYPE and \
+                not self.block_id.is_nil():
+            if len(self.extension) > MAX_VOTE_EXTENSION_SIZE:
+                raise VoteError("vote extension too big")
+            if self.extension and not self.extension_signature:
+                raise VoteError("vote extension signature is missing")
+        else:
+            # reference: extensions only allowed on non-nil precommits
+            if self.extension or self.extension_signature:
+                raise VoteError(
+                    "unexpected vote extension on non-precommit vote")
+
+    # ------------------------------------------------------------------
+    def commit_sig(self) -> dict:
+        """CommitSig view of this vote (reference: vote.go CommitSig)."""
+        if self.block_id.is_nil():
+            flag = BLOCK_ID_FLAG_NIL
+        else:
+            flag = BLOCK_ID_FLAG_COMMIT
+        return {
+            "block_id_flag": flag,
+            "validator_address": self.validator_address,
+            "timestamp": self.timestamp,
+            "signature": self.signature,
+        }
+
+    def to_proto(self) -> dict:
+        d: dict = {
+            "block_id": self.block_id.to_proto(),
+            "timestamp": self.timestamp.to_proto(),
+        }
+        if self.type:
+            d["type"] = self.type
+        if self.height:
+            d["height"] = self.height
+        if self.round:
+            d["round"] = self.round
+        if self.validator_address:
+            d["validator_address"] = self.validator_address
+        if self.validator_index:
+            d["validator_index"] = self.validator_index
+        if self.signature:
+            d["signature"] = self.signature
+        if self.extension:
+            d["extension"] = self.extension
+        if self.extension_signature:
+            d["extension_signature"] = self.extension_signature
+        if self.non_rp_extension:
+            d["non_rp_extension"] = self.non_rp_extension
+        if self.non_rp_extension_signature:
+            d["non_rp_extension_signature"] = self.non_rp_extension_signature
+        return d
+
+    @classmethod
+    def from_proto(cls, d: dict) -> "Vote":
+        return cls(
+            type=d.get("type", 0),
+            height=d.get("height", 0),
+            round=d.get("round", 0),
+            block_id=BlockID.from_proto(d.get("block_id") or {}),
+            timestamp=Timestamp.from_proto(d.get("timestamp") or {}),
+            validator_address=d.get("validator_address", b""),
+            validator_index=d.get("validator_index", 0),
+            signature=d.get("signature", b""),
+            extension=d.get("extension", b""),
+            extension_signature=d.get("extension_signature", b""),
+            non_rp_extension=d.get("non_rp_extension", b""),
+            non_rp_extension_signature=d.get(
+                "non_rp_extension_signature", b""),
+        )
+
+    def copy(self) -> "Vote":
+        return replace(self)
+
+    def __str__(self) -> str:
+        tname = {1: "Prevote", 2: "Precommit"}.get(self.type, "?")
+        return (f"Vote{{{self.validator_index}:"
+                f"{self.validator_address.hex().upper()[:12]} "
+                f"{self.height}/{self.round:02d} {tname} "
+                f"{self.block_id}}}")

@@ -1,0 +1,13 @@
+"""Protocol versions.
+
+Reference: version/version.go:21 — block protocol 11, p2p protocol 9,
+ABCI semver.
+"""
+
+CMT_SEM_VER = "1.0.0-tpu"
+ABCI_SEM_VER = "2.2.0"
+ABCI_VERSION = ABCI_SEM_VER
+
+# uint64 protocol versions
+P2P_PROTOCOL = 9
+BLOCK_PROTOCOL = 11

@@ -1,0 +1,18 @@
+"""Deterministic wire encoding (protobuf) for consensus-critical bytes.
+
+The reference serializes every consensus-visible artifact (sign-bytes, header
+field hashes, stored blocks, p2p messages) as gogoproto-generated protobuf
+(reference: proto/ + api/, ~173k generated LoC).  Here the same wire format is
+produced by a ~200-line descriptor-driven encoder instead of codegen: each
+message is a dict, each schema a `Msg` descriptor, and encoding is canonical
+(ascending field order, proto3 zero-omission, gogoproto non-nullable embedded
+messages always emitted).  Byte-compatibility is pinned by the reference's own
+sign-bytes test vectors (tests/test_wire.py).
+"""
+from .proto import Msg, F, encode, decode, marshal_delimited, unmarshal_delimited
+from . import pb
+
+__all__ = [
+    "Msg", "F", "encode", "decode", "marshal_delimited",
+    "unmarshal_delimited", "pb",
+]
