@@ -1,0 +1,6 @@
+"""ABCI: the application blockchain interface.
+
+Reference: abci/ — 15-method Application interface over 4 logical
+connections (consensus, mempool, info, snapshot), clients (local, socket,
+grpc), servers, and the kvstore example app.
+"""

@@ -1,0 +1,168 @@
+"""ABCI clients.
+
+Reference: abci/client/ — local_client (in-process, mutexed),
+unsync_local_client, socket_client (pipelined, abci/client/socket_client.go).
+The local variants live here; the socket client arrives with the
+out-of-process server.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from . import types as abci
+
+
+class ABCIClientError(Exception):
+    pass
+
+
+class LocalClient:
+    """In-process client serializing calls with one lock.
+
+    Reference: abci/client/local_client.go — a global mutex makes the app
+    see at most one concurrent call, which is the ABCI concurrency
+    contract for a single connection.
+    """
+
+    def __init__(self, app: abci.Application,
+                 lock: Optional[asyncio.Lock] = None):
+        self._app = app
+        self._lock = lock if lock is not None else asyncio.Lock()
+
+    @property
+    def app(self) -> abci.Application:
+        return self._app
+
+    async def echo(self, message: str) -> abci.EchoResponse:
+        async with self._lock:
+            return await self._app.echo(abci.EchoRequest(message=message))
+
+    async def flush(self) -> None:
+        return None
+
+    async def info(self, req: abci.InfoRequest) -> abci.InfoResponse:
+        async with self._lock:
+            return await self._app.info(req)
+
+    async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
+        async with self._lock:
+            return await self._app.query(req)
+
+    async def check_tx(self, req: abci.CheckTxRequest
+                       ) -> abci.CheckTxResponse:
+        async with self._lock:
+            return await self._app.check_tx(req)
+
+    async def init_chain(self, req: abci.InitChainRequest
+                         ) -> abci.InitChainResponse:
+        async with self._lock:
+            return await self._app.init_chain(req)
+
+    async def prepare_proposal(self, req: abci.PrepareProposalRequest
+                               ) -> abci.PrepareProposalResponse:
+        async with self._lock:
+            return await self._app.prepare_proposal(req)
+
+    async def process_proposal(self, req: abci.ProcessProposalRequest
+                               ) -> abci.ProcessProposalResponse:
+        async with self._lock:
+            return await self._app.process_proposal(req)
+
+    async def finalize_block(self, req: abci.FinalizeBlockRequest
+                             ) -> abci.FinalizeBlockResponse:
+        async with self._lock:
+            return await self._app.finalize_block(req)
+
+    async def extend_vote(self, req: abci.ExtendVoteRequest
+                          ) -> abci.ExtendVoteResponse:
+        async with self._lock:
+            return await self._app.extend_vote(req)
+
+    async def verify_vote_extension(
+            self, req: abci.VerifyVoteExtensionRequest
+    ) -> abci.VerifyVoteExtensionResponse:
+        async with self._lock:
+            return await self._app.verify_vote_extension(req)
+
+    async def commit(self) -> abci.CommitResponse:
+        async with self._lock:
+            return await self._app.commit(abci.CommitRequest())
+
+    async def list_snapshots(self, req: abci.ListSnapshotsRequest
+                             ) -> abci.ListSnapshotsResponse:
+        async with self._lock:
+            return await self._app.list_snapshots(req)
+
+    async def offer_snapshot(self, req: abci.OfferSnapshotRequest
+                             ) -> abci.OfferSnapshotResponse:
+        async with self._lock:
+            return await self._app.offer_snapshot(req)
+
+    async def load_snapshot_chunk(self, req: abci.LoadSnapshotChunkRequest
+                                  ) -> abci.LoadSnapshotChunkResponse:
+        async with self._lock:
+            return await self._app.load_snapshot_chunk(req)
+
+    async def apply_snapshot_chunk(
+            self, req: abci.ApplySnapshotChunkRequest
+    ) -> abci.ApplySnapshotChunkResponse:
+        async with self._lock:
+            return await self._app.apply_snapshot_chunk(req)
+
+
+class _NoopLock:
+    async def __aenter__(self):
+        return self
+
+    async def __aexit__(self, *exc):
+        return False
+
+
+class UnsyncLocalClient(LocalClient):
+    """Local client without any lock: the app handles its own
+    synchronization (reference: unsync_local_client.go has no mutex)."""
+
+    def __init__(self, app: abci.Application):
+        super().__init__(app, lock=_NoopLock())
+
+
+class AppConns:
+    """The four named ABCI connections sharing one client.
+
+    Reference: proxy/multi_app_conn.go — consensus/mempool/query/snapshot.
+    With a local client they share one mutex (the reference's
+    NewConnSyncLocalClientCreator semantics).
+    """
+
+    def __init__(self, app: abci.Application, sync: bool = True):
+        if sync:
+            lock = asyncio.Lock()
+            self.consensus = LocalClient(app, lock)
+            self.mempool = LocalClient(app, lock)
+            self.query = LocalClient(app, lock)
+            self.snapshot = LocalClient(app, lock)
+        else:
+            self.consensus = UnsyncLocalClient(app)
+            self.mempool = UnsyncLocalClient(app)
+            self.query = UnsyncLocalClient(app)
+            self.snapshot = UnsyncLocalClient(app)
+
+
+class ClientCreator:
+    """Reference: proxy/client.go ClientCreator — local vs remote."""
+
+    def __init__(self, app: Optional[abci.Application] = None,
+                 addr: str = "", transport: str = "local"):
+        self._app = app
+        self._addr = addr
+        self._transport = transport
+
+    def new_app_conns(self) -> AppConns:
+        if self._transport in ("local", "builtin", "builtin_unsync"):
+            if self._app is None:
+                raise ABCIClientError("local client requires an app")
+            return AppConns(self._app,
+                            sync=self._transport != "builtin_unsync")
+        raise ABCIClientError(
+            f"transport {self._transport!r} not yet supported")

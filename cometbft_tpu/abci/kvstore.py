@@ -1,0 +1,327 @@
+"""kvstore: the canonical example/test application.
+
+Reference: abci/example/kvstore/kvstore.go (677 LoC) — key=value txs,
+validator-update txs ("val=<type>!<b64 pubkey>!<power>"), priority lanes,
+app hash = varint(size), /val query path.  Used by the e2e baseline
+config #1 and as the universal test app.
+"""
+from __future__ import annotations
+
+import base64
+import json
+from typing import Optional
+
+from .. import version as _version
+from ..db import DB, MemDB
+from ..libs.log import new_logger
+from . import types as abci
+
+VALIDATOR_PREFIX = "val="
+APP_VERSION = 1
+DEFAULT_LANE = "default"
+
+CODE_TYPE_OK = 0
+CODE_TYPE_ENCODING_ERROR = 1
+CODE_TYPE_INVALID_TX_FORMAT = 2
+CODE_TYPE_UNAUTHORIZED = 3
+CODE_TYPE_EXECUTED = 5
+
+_KV_PREFIX = b"kvPairKey:"
+_STATE_KEY = b"appstate"
+
+# lane priorities (reference: kvstore.go NewInMemoryApplication lanes)
+DEFAULT_LANES = {"val": 9, "foo": 7, DEFAULT_LANE: 3, "bar": 1}
+
+
+def _zigzag_varint(n: int) -> bytes:
+    """Go binary.PutVarint into an 8-byte buffer (reference:
+    State.Hash — kvstore.go:669)."""
+    zz = (n << 1) ^ (n >> 63) if n < 0 else n << 1
+    out = bytearray(8)
+    i = 0
+    while True:
+        b = zz & 0x7F
+        zz >>= 7
+        if zz:
+            out[i] = b | 0x80
+        else:
+            out[i] = b
+            break
+        i += 1
+    return bytes(out)
+
+
+def make_val_set_change_tx(pub_key_type: str, pub_key_bytes: bytes,
+                           power: int) -> bytes:
+    """Reference: helpers.go MakeValSetChangeTx."""
+    pub = base64.b64encode(pub_key_bytes).decode()
+    return f"{VALIDATOR_PREFIX}{pub_key_type}!{pub}!{power}".encode()
+
+
+def is_validator_tx(tx: bytes) -> bool:
+    return tx.startswith(VALIDATOR_PREFIX.encode())
+
+
+def parse_validator_tx(tx: bytes) -> tuple[str, bytes, int]:
+    """Returns (key_type, pub_key_bytes, power)."""
+    body = tx[len(VALIDATOR_PREFIX):].decode()
+    parts = body.split("!")
+    if len(parts) != 3:
+        raise ValueError(f"expected 'type!pubkey!power', got {body!r}")
+    key_type, pub_b64, power_s = parts
+    pub = base64.b64decode(pub_b64)
+    power = int(power_s)
+    if power < 0:
+        raise ValueError("power can not be less than 0")
+    return key_type, pub, power
+
+
+def parse_tx(tx: bytes) -> tuple[str, str]:
+    parts = tx.split(b"=")
+    if len(parts) != 2:
+        raise ValueError(f"invalid tx format: {tx!r}")
+    if not parts[0]:
+        raise ValueError("key cannot be empty")
+    return parts[0].decode(), parts[1].decode()
+
+
+def is_valid_tx(tx: bytes) -> bool:
+    """key=value or key:value, exactly one separator, not at the ends."""
+    for sep, other in ((b":", b"="), (b"=", b":")):
+        if tx.count(sep) == 1 and tx.count(other) == 0:
+            if not tx.startswith(sep) and not tx.endswith(sep):
+                return True
+    return False
+
+
+def assign_lane(tx: bytes) -> str:
+    """Deterministic lane assignment (reference: kvstore.go assignLane)."""
+    if is_validator_tx(tx):
+        return "val"
+    try:
+        key, _ = parse_tx(tx)
+        key_int = int(key)
+    except ValueError:
+        return DEFAULT_LANE
+    if key_int % 11 == 0:
+        return "foo"
+    if key_int % 3 == 0:
+        return "bar"
+    return DEFAULT_LANE
+
+
+class KVStoreApplication(abci.Application):
+    def __init__(self, db: Optional[DB] = None,
+                 lane_priorities: Optional[dict[str, int]] = DEFAULT_LANES):
+        self.db = db if db is not None else MemDB()
+        self.lane_priorities = dict(lane_priorities or {})
+        self.retain_blocks = 0
+        self.logger = new_logger("kvstore")
+        self._staged_txs: list[bytes] = []
+        self._val_updates: list[abci.ValidatorUpdate] = []
+        self._val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
+        self._gen_block_events = False
+        self.next_block_delay_ns = 0
+        self._height = 0
+        self._size = 0
+        self._load_state()
+
+    # ------------------------------------------------------------------
+    def _load_state(self) -> None:
+        raw = self.db.get(_STATE_KEY)
+        if raw:
+            st = json.loads(raw)
+            self._height = st.get("height", 0)
+            self._size = st.get("size", 0)
+
+    def _save_state(self) -> None:
+        self.db.set(_STATE_KEY, json.dumps(
+            {"height": self._height, "size": self._size}).encode())
+
+    def _app_hash(self) -> bytes:
+        return _zigzag_varint(self._size)
+
+    def set_gen_block_events(self) -> None:
+        self._gen_block_events = True
+
+    # ------------------------------------------------------------------
+    async def info(self, req: abci.InfoRequest) -> abci.InfoResponse:
+        default_lane = ""
+        if self.lane_priorities:
+            default_lane = DEFAULT_LANE
+        return abci.InfoResponse(
+            data=json.dumps({"size": self._size}),
+            version=_version.ABCI_SEM_VER,
+            app_version=APP_VERSION,
+            last_block_height=self._height,
+            last_block_app_hash=self._app_hash(),
+            lane_priorities=dict(self.lane_priorities),
+            default_lane=default_lane,
+        )
+
+    async def init_chain(self, req: abci.InitChainRequest
+                         ) -> abci.InitChainResponse:
+        for v in req.validators:
+            self._update_validator(v)
+        return abci.InitChainResponse(app_hash=self._app_hash())
+
+    async def check_tx(self, req: abci.CheckTxRequest
+                       ) -> abci.CheckTxResponse:
+        if is_validator_tx(req.tx):
+            try:
+                parse_validator_tx(req.tx)
+            except ValueError:
+                return abci.CheckTxResponse(
+                    code=CODE_TYPE_INVALID_TX_FORMAT)
+        elif not is_valid_tx(req.tx):
+            return abci.CheckTxResponse(code=CODE_TYPE_INVALID_TX_FORMAT)
+        if not self.lane_priorities:
+            return abci.CheckTxResponse(code=CODE_TYPE_OK, gas_wanted=1)
+        return abci.CheckTxResponse(code=CODE_TYPE_OK, gas_wanted=1,
+                                    lane_id=assign_lane(req.tx))
+
+    async def prepare_proposal(self, req: abci.PrepareProposalRequest
+                               ) -> abci.PrepareProposalResponse:
+        """Normalize 'k:v' to 'k=v', drop invalid txs (reference:
+        formatTxs)."""
+        txs = []
+        for tx in req.txs:
+            if is_validator_tx(tx):
+                try:
+                    parse_validator_tx(tx)
+                except ValueError:
+                    continue
+                txs.append(tx)
+            elif is_valid_tx(tx):
+                txs.append(tx.replace(b":", b"="))
+        return abci.PrepareProposalResponse(txs=txs)
+
+    async def process_proposal(self, req: abci.ProcessProposalRequest
+                               ) -> abci.ProcessProposalResponse:
+        for tx in req.txs:
+            if is_validator_tx(tx):
+                try:
+                    parse_validator_tx(tx)
+                except ValueError:
+                    return abci.ProcessProposalResponse(
+                        status=abci.PROCESS_PROPOSAL_STATUS_REJECT)
+            elif not is_valid_tx(tx) or b":" in tx:
+                # only the proposer's "=" normal form is acceptable here
+                return abci.ProcessProposalResponse(
+                    status=abci.PROCESS_PROPOSAL_STATUS_REJECT)
+        return abci.ProcessProposalResponse(
+            status=abci.PROCESS_PROPOSAL_STATUS_ACCEPT)
+
+    async def finalize_block(self, req: abci.FinalizeBlockRequest
+                             ) -> abci.FinalizeBlockResponse:
+        self._val_updates = []
+        self._staged_txs = []
+
+        # punish equivocators by one power unit (reference: kvstore.go:318)
+        for ev in req.misbehavior:
+            if ev.type == abci.MISBEHAVIOR_TYPE_DUPLICATE_VOTE:
+                entry = self._val_addr_to_pubkey.get(ev.validator.address)
+                if entry is not None:
+                    key_type, pub = entry
+                    self._val_updates.append(abci.ValidatorUpdate(
+                        power=ev.validator.power - 1,
+                        pub_key_type=key_type, pub_key_bytes=pub))
+                    self.logger.info(
+                        "Decreased val power by 1 for equivocation",
+                        val=ev.validator.address.hex())
+
+        tx_results = []
+        for tx in req.txs:
+            if is_validator_tx(tx):
+                key_type, pub, power = parse_validator_tx(tx)
+                self._val_updates.append(abci.ValidatorUpdate(
+                    power=power, pub_key_type=key_type,
+                    pub_key_bytes=pub))
+            else:
+                self._staged_txs.append(tx)
+            parts = tx.split(b"=")
+            if len(parts) == 2:
+                key, value = parts[0].decode(), parts[1].decode()
+            else:
+                key = value = tx.decode(errors="replace")
+            tx_results.append(abci.ExecTxResult(
+                code=CODE_TYPE_OK,
+                events=[abci.Event(type="app", attributes=[
+                    abci.EventAttribute("creator", "Cosmoshi Netowoko",
+                                        True),
+                    abci.EventAttribute("key", key, True),
+                    abci.EventAttribute("index_key", "index is working",
+                                        True),
+                    abci.EventAttribute("noindex_key", "index is working",
+                                        False),
+                ])],
+            ))
+            self._size += 1
+
+        self._height = req.height
+        resp = abci.FinalizeBlockResponse(
+            tx_results=tx_results,
+            validator_updates=list(self._val_updates),
+            app_hash=self._app_hash(),
+            next_block_delay_ns=self.next_block_delay_ns,
+        )
+        if self._gen_block_events:
+            resp.events = [abci.Event(type="begin_event", attributes=[
+                abci.EventAttribute("foo", "100", True),
+                abci.EventAttribute("bar", "200", True)])]
+        return resp
+
+    async def commit(self, req: abci.CommitRequest) -> abci.CommitResponse:
+        for v in self._val_updates:
+            self._update_validator(v)
+        for tx in self._staged_txs:
+            parts = tx.split(b"=")
+            if len(parts) != 2:
+                raise RuntimeError(f"unexpected tx format: {tx!r}")
+            self.db.set(_KV_PREFIX + parts[0], parts[1])
+        self._save_state()
+        resp = abci.CommitResponse()
+        if self.retain_blocks > 0 and self._height >= self.retain_blocks:
+            resp.retain_height = self._height - self.retain_blocks + 1
+        return resp
+
+    async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
+        if req.path == "/val":
+            value = self.db.get(
+                (VALIDATOR_PREFIX + req.data.decode()).encode()) or b""
+            return abci.QueryResponse(key=req.data, value=value)
+        value = self.db.get(_KV_PREFIX + req.data)
+        return abci.QueryResponse(
+            key=req.data,
+            value=value or b"",
+            log="exists" if value is not None else "does not exist",
+            height=self._height,
+        )
+
+    # ------------------------------------------------------------------
+    def _update_validator(self, v: abci.ValidatorUpdate) -> None:
+        from ..crypto import encoding as crypto_encoding
+        pub = crypto_encoding.pub_key_from_type_and_bytes(
+            v.pub_key_type, v.pub_key_bytes)
+        addr = pub.address()
+        key = (VALIDATOR_PREFIX +
+               base64.b64encode(v.pub_key_bytes).decode()).encode()
+        if v.power == 0:
+            self.db.delete(key)
+            self._val_addr_to_pubkey.pop(addr, None)
+        else:
+            self.db.set(key, str(v.power).encode())
+            self._val_addr_to_pubkey[addr] = (v.pub_key_type,
+                                              v.pub_key_bytes)
+
+    def get_validators(self) -> list[abci.ValidatorUpdate]:
+        out = []
+        for addr, (key_type, pub) in self._val_addr_to_pubkey.items():
+            key = (VALIDATOR_PREFIX +
+                   base64.b64encode(pub).decode()).encode()
+            raw = self.db.get(key)
+            if raw:
+                out.append(abci.ValidatorUpdate(
+                    power=int(raw), pub_key_type=key_type,
+                    pub_key_bytes=pub))
+        return out

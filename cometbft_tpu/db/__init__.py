@@ -1,0 +1,12 @@
+"""Embedded key-value store.
+
+Reference: db/db.go:24 — the DB interface (Get/Has/Set/Delete/Iterator/
+Batch/Compact), sole backend PebbleDB, plus the prefixdb wrapper.  The
+TPU build's persistent backend is SQLite (stdlib, single-writer, WAL) —
+an ordered-KV engine of the same durability class, with no native-build
+dependency; MemDB backs tests and ephemeral configs.
+"""
+from .db import DB, Batch, DBError, MemDB, SQLiteDB, PrefixDB, new_db
+
+__all__ = ["DB", "Batch", "DBError", "MemDB", "SQLiteDB", "PrefixDB",
+           "new_db"]

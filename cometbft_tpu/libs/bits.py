@@ -1,0 +1,132 @@
+"""BitArray: gossiped vote bitmaps.
+
+Reference: internal/bits/bit_array.go — fixed-size bit array with
+set/get, copy, bitwise ops, random-true-index picking (used by consensus
+gossip to choose which vote to send a peer).
+"""
+from __future__ import annotations
+
+import random
+from typing import Optional
+
+
+class BitArray:
+    __slots__ = ("bits", "_elems")
+
+    def __init__(self, bits: int):
+        if bits < 0:
+            raise ValueError("negative bits")
+        self.bits = bits
+        self._elems = 0  # int bitmap, bit i == index i
+
+    @classmethod
+    def from_indices(cls, bits: int, indices) -> "BitArray":
+        ba = cls(bits)
+        for i in indices:
+            ba.set_index(i, True)
+        return ba
+
+    def size(self) -> int:
+        return self.bits
+
+    def get_index(self, i: int) -> bool:
+        if i < 0 or i >= self.bits:
+            return False
+        return bool((self._elems >> i) & 1)
+
+    def set_index(self, i: int, v: bool) -> bool:
+        if i < 0 or i >= self.bits:
+            return False
+        if v:
+            self._elems |= (1 << i)
+        else:
+            self._elems &= ~(1 << i)
+        return True
+
+    def copy(self) -> "BitArray":
+        ba = BitArray(self.bits)
+        ba._elems = self._elems
+        return ba
+
+    def or_(self, other: "BitArray") -> "BitArray":
+        """Union; result size is the larger (reference: Or)."""
+        ba = BitArray(max(self.bits, other.bits))
+        ba._elems = self._elems | other._elems
+        return ba
+
+    def and_(self, other: "BitArray") -> "BitArray":
+        ba = BitArray(min(self.bits, other.bits))
+        mask = (1 << ba.bits) - 1
+        ba._elems = self._elems & other._elems & mask
+        return ba
+
+    def not_(self) -> "BitArray":
+        ba = BitArray(self.bits)
+        ba._elems = ~self._elems & ((1 << self.bits) - 1)
+        return ba
+
+    def sub(self, other: "BitArray") -> "BitArray":
+        """Bits set in self but not in other (reference: Sub)."""
+        ba = BitArray(self.bits)
+        mask = (1 << self.bits) - 1
+        ba._elems = self._elems & ~(other._elems) & mask
+        return ba
+
+    def is_empty(self) -> bool:
+        return self._elems == 0
+
+    def is_full(self) -> bool:
+        return self.bits > 0 and self._elems == (1 << self.bits) - 1
+
+    def true_indices(self) -> list[int]:
+        e, out, i = self._elems, [], 0
+        while e:
+            if e & 1:
+                out.append(i)
+            e >>= 1
+            i += 1
+        return out
+
+    def pick_random(self) -> Optional[int]:
+        """A uniformly random true index, or None (reference: PickRandom)."""
+        idxs = self.true_indices()
+        if not idxs:
+            return None
+        return random.choice(idxs)
+
+    def update(self, other: "BitArray") -> None:
+        """Copy other's bits into self (reference: Update)."""
+        mask = (1 << self.bits) - 1
+        self._elems = other._elems & mask
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, BitArray) and self.bits == other.bits and
+                self._elems == other._elems)
+
+    def __str__(self) -> str:
+        s = "".join("x" if self.get_index(i) else "_"
+                    for i in range(self.bits))
+        return f"BA{{{self.bits}:{s}}}"
+
+    def to_proto(self) -> dict:
+        # libs/bits proto: {bits: int64, elems: repeated uint64}
+        elems = []
+        e = self._elems
+        for _ in range((self.bits + 63) // 64):
+            elems.append(e & ((1 << 64) - 1))
+            e >>= 64
+        d: dict = {}
+        if self.bits:
+            d["bits"] = self.bits
+        if elems:
+            d["elems"] = elems
+        return d
+
+    @classmethod
+    def from_proto(cls, d: dict) -> "BitArray":
+        ba = cls(d.get("bits", 0))
+        e = 0
+        for i, w in enumerate(d.get("elems", [])):
+            e |= w << (64 * i)
+        ba._elems = e & ((1 << ba.bits) - 1) if ba.bits else 0
+        return ba

@@ -1,0 +1,236 @@
+"""Pub/sub with a query language, feeding RPC subscribers and the indexer.
+
+Reference: libs/pubsub/pubsub.go (Server :93) + libs/pubsub/query (the
+gogll-generated grammar).  Queries are conjunctions of conditions over
+event tags:
+
+    tm.event = 'NewBlock' AND tx.height > 5 AND account.name CONTAINS 'igor'
+
+Operators: =, <, <=, >, >=, CONTAINS, EXISTS.  Values: single-quoted
+strings, numbers, dates (treated as strings here).  Tags are multi-valued
+(one event key can carry several values, e.g. several tx senders).
+"""
+from __future__ import annotations
+
+import asyncio
+import re
+from dataclasses import dataclass, field
+from typing import Any, Callable, Optional
+
+
+class PubSubError(Exception):
+    pass
+
+
+class QueryError(PubSubError):
+    pass
+
+
+_COND_RE = re.compile(
+    r"\s*(?P<key>[\w.\-/]+)\s*"
+    r"(?P<op>=|<=|>=|<|>|CONTAINS|EXISTS)\s*"
+    r"(?P<val>'(?:[^'\\]|\\.)*'|[\w.\-:+TZ]+)?\s*$",
+    re.IGNORECASE)
+
+
+def _parse_value(raw: str):
+    if raw.startswith("'") and raw.endswith("'"):
+        return raw[1:-1]
+    try:
+        return int(raw)
+    except ValueError:
+        pass
+    try:
+        return float(raw)
+    except ValueError:
+        pass
+    return raw
+
+
+def _as_number(v) -> Optional[float]:
+    if isinstance(v, (int, float)):
+        return float(v)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return None
+
+
+@dataclass(frozen=True)
+class Condition:
+    key: str
+    op: str
+    value: Any = None
+
+    def matches_value(self, ev_val: str) -> bool:
+        op = self.op
+        if op == "EXISTS":
+            return True
+        if op == "CONTAINS":
+            return str(self.value) in ev_val
+        if op == "=":
+            n, m = _as_number(self.value), _as_number(ev_val)
+            if n is not None and m is not None:
+                return n == m
+            return str(self.value) == ev_val
+        n, m = _as_number(self.value), _as_number(ev_val)
+        if n is None or m is None:
+            # fall back to lexicographic comparison for dates/strings
+            a, b = ev_val, str(self.value)
+            return {"<": a < b, "<=": a <= b,
+                    ">": a > b, ">=": a >= b}[op]
+        return {"<": m < n, "<=": m <= n, ">": m > n, ">=": m >= n}[op]
+
+
+class Query:
+    """Conjunction of conditions; matches event tag maps."""
+
+    def __init__(self, query_str: str):
+        self.query_str = query_str.strip()
+        self.conditions: list[Condition] = []
+        if not self.query_str:
+            return
+        for part in re.split(r"\s+AND\s+", self.query_str,
+                             flags=re.IGNORECASE):
+            m = _COND_RE.match(part)
+            if not m:
+                raise QueryError(f"invalid condition {part!r}")
+            op = m.group("op").upper()
+            raw_val = m.group("val")
+            if op == "EXISTS":
+                if raw_val:
+                    raise QueryError(f"EXISTS takes no value: {part!r}")
+                self.conditions.append(Condition(m.group("key"), op))
+            else:
+                if raw_val is None:
+                    raise QueryError(f"missing value in {part!r}")
+                self.conditions.append(Condition(
+                    m.group("key"), op, _parse_value(raw_val)))
+
+    def matches(self, events: dict[str, list[str]]) -> bool:
+        """events: composite key ("type.attr") → list of values."""
+        for cond in self.conditions:
+            vals = events.get(cond.key)
+            if not vals:
+                return False
+            if not any(cond.matches_value(v) for v in vals):
+                return False
+        return True
+
+    def __str__(self) -> str:
+        return self.query_str
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Query) and \
+            self.query_str == other.query_str
+
+    def __hash__(self) -> int:
+        return hash(self.query_str)
+
+
+EMPTY_QUERY = Query("")
+
+
+@dataclass
+class Message:
+    data: Any
+    events: dict[str, list[str]] = field(default_factory=dict)
+
+
+_CANCEL_SENTINEL = object()
+
+
+class Subscription:
+    """A subscriber's message stream (reference: pubsub.Subscription;
+    its Canceled channel wakes blocked readers — here a sentinel message
+    does)."""
+
+    def __init__(self, out_capacity: int = 100):
+        # +1 slot so the cancel sentinel always fits
+        self._queue: asyncio.Queue = asyncio.Queue(out_capacity + 1)
+        self._capacity = out_capacity
+        self._canceled: Optional[str] = None
+
+    @property
+    def canceled(self) -> Optional[str]:
+        return self._canceled
+
+    def cancel(self, reason: str) -> None:
+        if self._canceled is None:
+            self._canceled = reason
+            # wake any reader blocked in next()
+            self._queue.put_nowait(_CANCEL_SENTINEL)
+
+    async def next(self) -> Message:
+        if self._canceled:
+            raise PubSubError(f"subscription canceled: {self._canceled}")
+        msg = await self._queue.get()
+        if msg is _CANCEL_SENTINEL:
+            raise PubSubError(f"subscription canceled: {self._canceled}")
+        return msg
+
+    def try_put(self, msg: Message) -> bool:
+        if self._canceled or self._queue.qsize() >= self._capacity:
+            return False
+        self._queue.put_nowait(msg)
+        return True
+
+
+class Server:
+    """In-process pub/sub server (reference: pubsub.Server :93).
+
+    Subscriptions are keyed by (subscriber, query).  Publishing is
+    synchronous fan-out; a full subscriber queue cancels that
+    subscription (the reference's non-buffered semantics surface
+    slow-subscriber errors the same way).
+    """
+
+    def __init__(self):
+        self._subs: dict[tuple[str, str], tuple[Query, Subscription]] = {}
+
+    def subscribe(self, subscriber: str, query: Query | str,
+                  out_capacity: int = 100) -> Subscription:
+        if isinstance(query, str):
+            query = Query(query)
+        key = (subscriber, query.query_str)
+        if key in self._subs:
+            raise PubSubError("already subscribed")
+        sub = Subscription(out_capacity)
+        self._subs[key] = (query, sub)
+        return sub
+
+    def unsubscribe(self, subscriber: str, query: Query | str) -> None:
+        qs = query.query_str if isinstance(query, Query) else \
+            Query(query).query_str
+        key = (subscriber, qs)
+        if key not in self._subs:
+            raise PubSubError("subscription not found")
+        _, sub = self._subs.pop(key)
+        sub.cancel("unsubscribed")
+
+    def unsubscribe_all(self, subscriber: str) -> None:
+        keys = [k for k in self._subs if k[0] == subscriber]
+        if not keys:
+            raise PubSubError("subscription not found")
+        for k in keys:
+            _, sub = self._subs.pop(k)
+            sub.cancel("unsubscribed")
+
+    def num_clients(self) -> int:
+        return len({k[0] for k in self._subs})
+
+    def num_client_subscriptions(self, subscriber: str) -> int:
+        return sum(1 for k in self._subs if k[0] == subscriber)
+
+    def publish(self, data: Any,
+                events: Optional[dict[str, list[str]]] = None) -> None:
+        events = events or {}
+        msg = Message(data, events)
+        dead = []
+        for key, (query, sub) in self._subs.items():
+            if query.matches(events):
+                if not sub.try_put(msg):
+                    sub.cancel("out of capacity")
+                    dead.append(key)
+        for key in dead:
+            self._subs.pop(key, None)

@@ -1,0 +1,179 @@
+"""ABCI + kvstore + db tests."""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import AppConns, LocalClient
+from cometbft_tpu.abci.kvstore import (
+    KVStoreApplication, assign_lane, is_valid_tx, make_val_set_change_tx,
+    parse_validator_tx,
+)
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.db import MemDB, PrefixDB, SQLiteDB
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+async def _drive_blocks(app, txs_per_block, start_height=1):
+    conns = AppConns(app)
+    results = []
+    h = start_height
+    for txs in txs_per_block:
+        r = await conns.consensus.finalize_block(
+            abci.FinalizeBlockRequest(txs=txs, height=h))
+        await conns.consensus.commit()
+        results.append(r)
+        h += 1
+    return results
+
+
+class TestKVStore:
+    def test_check_tx_formats(self):
+        app = KVStoreApplication()
+        async def go():
+            ok = await app.check_tx(abci.CheckTxRequest(tx=b"a=1"))
+            assert ok.code == 0 and ok.lane_id
+            ok2 = await app.check_tx(abci.CheckTxRequest(tx=b"a:1"))
+            assert ok2.code == 0
+            bad = await app.check_tx(abci.CheckTxRequest(tx=b"nosep"))
+            assert bad.code != 0
+            bad2 = await app.check_tx(abci.CheckTxRequest(tx=b"=x"))
+            assert bad2.code != 0
+        run(go())
+
+    def test_lanes(self):
+        assert assign_lane(b"22=1") == "foo"      # 22 % 11 == 0
+        assert assign_lane(b"9=1") == "bar"       # 9 % 3 == 0
+        assert assign_lane(b"5=1") == "default"
+        assert assign_lane(b"abc=1") == "default"
+        assert assign_lane(make_val_set_change_tx(
+            "ed25519", b"\x01" * 32, 5)) == "val"
+
+    def test_finalize_and_query(self):
+        app = KVStoreApplication()
+        async def go():
+            await _drive_blocks(app, [[b"name=satoshi"], [b"x=1", b"y=2"]])
+            q = await app.query(abci.QueryRequest(data=b"name"))
+            assert q.value == b"satoshi"
+            assert q.log == "exists"
+            q2 = await app.query(abci.QueryRequest(data=b"missing"))
+            assert q2.log == "does not exist"
+            info = await app.info(abci.InfoRequest())
+            assert info.last_block_height == 2
+            assert len(info.last_block_app_hash) == 8
+        run(go())
+
+    def test_validator_updates(self):
+        app = KVStoreApplication()
+        pub = ed25519.gen_priv_key().pub_key()
+        tx = make_val_set_change_tx("ed25519", pub.bytes(), 7)
+        async def go():
+            r = (await _drive_blocks(app, [[tx]]))[0]
+            assert len(r.validator_updates) == 1
+            assert r.validator_updates[0].power == 7
+            vals = app.get_validators()
+            assert len(vals) == 1 and vals[0].power == 7
+            q = await app.query(abci.QueryRequest(
+                path="/val", data=__import__("base64").b64encode(
+                    pub.bytes())))
+            assert q.value == b"7"
+        run(go())
+
+    def test_prepare_proposal_normalizes(self):
+        app = KVStoreApplication()
+        async def go():
+            r = await app.prepare_proposal(abci.PrepareProposalRequest(
+                txs=[b"a:1", b"b=2", b"bad"], max_tx_bytes=1 << 20))
+            assert r.txs == [b"a=1", b"b=2"]
+            p = await app.process_proposal(abci.ProcessProposalRequest(
+                txs=[b"a=1"]))
+            assert p.is_accepted()
+            p2 = await app.process_proposal(abci.ProcessProposalRequest(
+                txs=[b"a:1"]))
+            assert not p2.is_accepted()
+        run(go())
+
+    def test_app_hash_changes_with_size(self):
+        app = KVStoreApplication()
+        async def go():
+            r1 = (await _drive_blocks(app, [[b"a=1"]]))[0]
+            r2 = (await _drive_blocks(app, [[b"b=2"]], 2))[0]
+            assert r1.app_hash != r2.app_hash
+        run(go())
+
+    def test_persistence(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "kv.db"))
+        app = KVStoreApplication(db=db)
+        async def go():
+            await _drive_blocks(app, [[b"k=v"]])
+        run(go())
+        app2 = KVStoreApplication(db=db)
+        async def go2():
+            info = await app2.info(abci.InfoRequest())
+            assert info.last_block_height == 1
+            q = await app2.query(abci.QueryRequest(data=b"k"))
+            assert q.value == b"v"
+        run(go2())
+
+
+class TestDB:
+    @pytest.mark.parametrize("mk", [
+        lambda p: MemDB(), lambda p: SQLiteDB(str(p / "t.db"))])
+    def test_crud_and_iteration(self, tmp_path, mk):
+        db = mk(tmp_path)
+        db.set(b"b", b"2")
+        db.set(b"a", b"1")
+        db.set(b"c", b"3")
+        assert db.get(b"a") == b"1"
+        assert db.has(b"b")
+        db.delete(b"b")
+        assert not db.has(b"b")
+        assert list(db.iterator()) == [(b"a", b"1"), (b"c", b"3")]
+        db.set(b"b", b"2")
+        assert [k for k, _ in db.iterator(b"b")] == [b"b", b"c"]
+        assert [k for k, _ in db.iterator(None, b"c")] == [b"a", b"b"]
+        assert [k for k, _ in db.reverse_iterator()] == [b"c", b"b", b"a"]
+
+    def test_batch(self, tmp_path):
+        db = SQLiteDB(str(tmp_path / "b.db"))
+        b = db.new_batch()
+        b.set(b"x", b"1")
+        b.set(b"y", b"2")
+        b.delete(b"x")
+        b.write()
+        assert db.get(b"y") == b"2"
+        assert db.get(b"x") is None
+
+    def test_prefixdb(self):
+        base = MemDB()
+        p = PrefixDB(base, b"pre/")
+        p.set(b"k", b"v")
+        assert base.get(b"pre/k") == b"v"
+        assert p.get(b"k") == b"v"
+        base.set(b"other", b"z")
+        assert list(p.iterator()) == [(b"k", b"v")]
+
+    def test_empty_key_rejected(self):
+        from cometbft_tpu.db import DBError
+        db = MemDB()
+        with pytest.raises(DBError):
+            db.set(b"", b"v")
+
+
+class TestBaseApplication:
+    def test_defaults(self):
+        app = abci.BaseApplication()
+        async def go():
+            r = await app.prepare_proposal(abci.PrepareProposalRequest(
+                txs=[b"123", b"456", b"789"], max_tx_bytes=7))
+            assert r.txs == [b"123", b"456"]
+            fb = await app.finalize_block(abci.FinalizeBlockRequest(
+                txs=[b"a", b"b"], height=1))
+            assert len(fb.tx_results) == 2
+            pp = await app.process_proposal(abci.ProcessProposalRequest())
+            assert pp.is_accepted()
+        run(go())

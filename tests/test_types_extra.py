@@ -1,0 +1,277 @@
+"""Tests: VoteSet tally/conflicts/2-3 detection, pubsub query language,
+genesis round-trip, params, proposal signing, bit arrays.
+"""
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.libs.bits import BitArray
+from cometbft_tpu.libs.pubsub import Query, QueryError, Server
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.params import ConsensusParams, ParamsError
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import MockPV, new_mock_pv
+from cometbft_tpu.types.proposal import Proposal
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+from cometbft_tpu.types.vote_set import (
+    ConflictingVoteError, VoteSet, VoteSetError,
+)
+
+
+def _fixture(n=4, power=10):
+    pvs = [new_mock_pv() for _ in range(n)]
+    vals = [Validator.new(pv.get_pub_key(), power) for pv in pvs]
+    pairs = sorted(zip(vals, pvs),
+                   key=lambda vp: (-vp[0].voting_power, vp[0].address))
+    vset = ValidatorSet([p[0] for p in pairs])
+    return vset, [p[1] for p in pairs]
+
+
+def _signed_vote(pv, vset, idx, height=1, round_=0, type_=1,
+                 block_id=None, chain_id="test"):
+    addr, val = vset.get_by_index(idx)
+    v = Vote(type=type_, height=height, round=round_,
+             block_id=block_id or BlockID(),
+             timestamp=Timestamp(1700000000, 0),
+             validator_address=addr, validator_index=idx)
+    pv.sign_vote(chain_id, v, sign_extension=False)
+    return v
+
+
+BID = BlockID(hash=b"\xaa" * 32,
+              part_set_header=PartSetHeader(1, b"\xbb" * 32))
+BID2 = BlockID(hash=b"\xcc" * 32,
+               part_set_header=PartSetHeader(1, b"\xdd" * 32))
+
+
+class TestVoteSet:
+    def test_add_votes_reach_maj23(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        for i in range(2):
+            assert vs.add_vote(_signed_vote(pvs[i], vset, i,
+                                            block_id=BID))
+        assert not vs.has_two_thirds_majority()
+        assert vs.add_vote(_signed_vote(pvs[2], vset, 2, block_id=BID))
+        assert vs.has_two_thirds_majority()
+        bid, ok = vs.two_thirds_majority()
+        assert ok and bid == BID
+
+    def test_duplicate_vote_not_added(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        v = _signed_vote(pvs[0], vset, 0, block_id=BID)
+        assert vs.add_vote(v)
+        assert not vs.add_vote(v)
+
+    def test_conflicting_vote_raises(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        assert vs.add_vote(_signed_vote(pvs[0], vset, 0, block_id=BID))
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(_signed_vote(pvs[0], vset, 0, block_id=BID2))
+
+    def test_conflict_tracked_after_peer_maj23(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        assert vs.add_vote(_signed_vote(pvs[0], vset, 0, block_id=BID))
+        vs.set_peer_maj23("peer1", BID2)
+        # conflicting vote is now tracked (but still reported)
+        with pytest.raises(ConflictingVoteError):
+            vs.add_vote(_signed_vote(pvs[0], vset, 0, block_id=BID2))
+        ba = vs.bit_array_by_block_id(BID2)
+        assert ba is not None and ba.get_index(0)
+
+    def test_wrong_signature_rejected(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        v = _signed_vote(pvs[0], vset, 0, block_id=BID)
+        v.signature = bytes(64)
+        with pytest.raises(VoteSetError, match="verify"):
+            vs.add_vote(v)
+
+    def test_wrong_step_rejected(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PREVOTE_TYPE, vset)
+        v = _signed_vote(pvs[0], vset, 0, height=2, block_id=BID)
+        with pytest.raises(VoteSetError, match="expected"):
+            vs.add_vote(v)
+
+    def test_make_extended_commit(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PRECOMMIT_TYPE, vset)
+        for i in range(3):
+            vs.add_vote(_signed_vote(pvs[i], vset, i,
+                                     type_=canonical.PRECOMMIT_TYPE,
+                                     block_id=BID))
+        ec = vs.make_extended_commit()
+        assert ec.height == 1
+        assert ec.block_id == BID
+        assert ec.size() == 4
+        flags = [s.block_id_flag for s in ec.extended_signatures]
+        assert flags.count(BLOCK_ID_FLAG_COMMIT) == 3
+        commit = ec.to_commit()
+        # verify the assembled commit
+        from cometbft_tpu.crypto import batch as cb
+        from cometbft_tpu.types.validation import verify_commit
+        cb.set_backend("cpu")
+        try:
+            verify_commit("test", vset, BID, 1, commit)
+        finally:
+            cb.set_backend("auto")
+
+    def test_nil_votes_tally_separately(self):
+        vset, pvs = _fixture(4)
+        vs = VoteSet("test", 1, 0, canonical.PRECOMMIT_TYPE, vset)
+        for i in range(3):
+            vs.add_vote(_signed_vote(pvs[i], vset, i,
+                                     type_=canonical.PRECOMMIT_TYPE))
+        bid, ok = vs.two_thirds_majority()
+        assert ok and bid.is_nil()
+
+
+class TestQuery:
+    def test_event_match(self):
+        q = Query("tm.event = 'NewBlock'")
+        assert q.matches({"tm.event": ["NewBlock"]})
+        assert not q.matches({"tm.event": ["Tx"]})
+        assert not q.matches({})
+
+    def test_and_numeric(self):
+        q = Query("tm.event = 'Tx' AND tx.height > 5")
+        assert q.matches({"tm.event": ["Tx"], "tx.height": ["6"]})
+        assert not q.matches({"tm.event": ["Tx"], "tx.height": ["5"]})
+
+    def test_contains_exists(self):
+        q = Query("account.name CONTAINS 'igor'")
+        assert q.matches({"account.name": ["igor123"]})
+        q2 = Query("tx.hash EXISTS")
+        assert q2.matches({"tx.hash": ["AB"]})
+        assert not q2.matches({})
+
+    def test_multivalue(self):
+        q = Query("transfer.sender = 'alice'")
+        assert q.matches({"transfer.sender": ["bob", "alice"]})
+
+    def test_invalid(self):
+        with pytest.raises(QueryError):
+            Query("this is !! not a query")
+
+    def test_server_pubsub(self):
+        s = Server()
+        sub = s.subscribe("c1", "tm.event = 'NewBlock'")
+        s.publish("blk", {"tm.event": ["NewBlock"]})
+        s.publish("tx", {"tm.event": ["Tx"]})
+        assert sub._queue.qsize() == 1
+        s.unsubscribe_all("c1")
+        assert sub.canceled
+
+
+class TestGenesis:
+    def test_roundtrip(self):
+        pv = new_mock_pv()
+        doc = GenesisDoc(
+            chain_id="test-chain",
+            genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(
+                address=b"", pub_key=pv.get_pub_key(), power=10,
+                name="v0")],
+            app_state={"accounts": {"alice": 100}},
+        )
+        doc.validate_and_complete()
+        doc2 = GenesisDoc.from_json(doc.to_json())
+        assert doc2.chain_id == "test-chain"
+        assert doc2.validators[0].pub_key == pv.get_pub_key()
+        assert doc2.validators[0].address == pv.get_pub_key().address()
+        assert doc2.app_state == {"accounts": {"alice": 100}}
+        assert doc2.validator_hash() == doc.validator_hash()
+
+    def test_rejects_zero_power(self):
+        pv = new_mock_pv()
+        doc = GenesisDoc(chain_id="c", validators=[GenesisValidator(
+            address=b"", pub_key=pv.get_pub_key(), power=0)])
+        with pytest.raises(Exception, match="voting power"):
+            doc.validate_and_complete()
+
+
+class TestParams:
+    def test_defaults_valid(self):
+        ConsensusParams().validate_basic()
+
+    def test_hash_deterministic(self):
+        assert ConsensusParams().hash() == ConsensusParams().hash()
+
+    def test_proto_roundtrip(self):
+        p = ConsensusParams()
+        p.feature.vote_extensions_enable_height = 10
+        p2 = ConsensusParams.from_proto(p.to_proto())
+        assert p2 == p
+
+    def test_invalid_block_bytes(self):
+        p = ConsensusParams()
+        p.block.max_bytes = 0
+        with pytest.raises(ParamsError):
+            p.validate_basic()
+
+    def test_synchrony_in_round(self):
+        p = ConsensusParams()
+        sp1 = p.synchrony.in_round(0)
+        sp2 = p.synchrony.in_round(5)
+        assert sp2.message_delay_ns > sp1.message_delay_ns
+        assert sp2.precision_ns == sp1.precision_ns
+
+
+class TestProposal:
+    def test_sign_and_verify(self):
+        pv = new_mock_pv()
+        p = Proposal(height=3, round=1, pol_round=-1, block_id=BID,
+                     timestamp=Timestamp(1700000000, 0))
+        pv.sign_proposal("test", p)
+        p.validate_basic()
+        assert pv.get_pub_key().verify_signature(
+            p.sign_bytes("test"), p.signature)
+        assert not pv.get_pub_key().verify_signature(
+            p.sign_bytes("other"), p.signature)
+
+    def test_timely(self):
+        from cometbft_tpu.types.params import SynchronyParams
+        sp = SynchronyParams(precision_ns=10**9,
+                             message_delay_ns=2 * 10**9)
+        p = Proposal(height=1, round=0, block_id=BID,
+                     timestamp=Timestamp(1700000000, 0))
+        assert p.is_timely(Timestamp(1700000001, 0), sp)
+        assert p.is_timely(Timestamp(1699999999, 500_000_000), sp)
+        assert not p.is_timely(Timestamp(1700000004, 0), sp)
+
+
+class TestBitArray:
+    def test_basic(self):
+        ba = BitArray(10)
+        assert ba.set_index(3, True)
+        assert ba.get_index(3)
+        assert not ba.get_index(4)
+        assert not ba.set_index(10, True)
+        assert ba.true_indices() == [3]
+
+    def test_ops(self):
+        a = BitArray.from_indices(8, [1, 3, 5])
+        b = BitArray.from_indices(8, [3, 4])
+        assert a.sub(b).true_indices() == [1, 5]
+        assert a.or_(b).true_indices() == [1, 3, 4, 5]
+        assert a.and_(b).true_indices() == [3]
+        assert a.not_().true_indices() == [0, 2, 4, 6, 7]
+
+    def test_pick_random(self):
+        a = BitArray.from_indices(8, [2, 6])
+        for _ in range(10):
+            assert a.pick_random() in (2, 6)
+        assert BitArray(4).pick_random() is None
+
+    def test_proto_roundtrip(self):
+        a = BitArray.from_indices(130, [0, 64, 129])
+        b = BitArray.from_proto(a.to_proto())
+        assert a == b
