@@ -1,0 +1,8 @@
+"""State & execution: the bridge between consensus and the application.
+
+Reference: state/ — sm.State value, Store persistence, BlockExecutor
+(ApplyBlock / CreateProposalBlock), block validation against state.
+"""
+from .state import State, StateError, make_genesis_state
+
+__all__ = ["State", "StateError", "make_genesis_state"]

@@ -1,0 +1,316 @@
+"""State store: persistence of sm.State and per-height lookback records.
+
+Reference: state/store.go:157 (Store interface, dbStore impl) — state
+record, validator sets and consensus params by height (with lookback
+pointers so unchanged heights store only a reference), finalize-block
+responses, pruning, bootstrap.
+"""
+from __future__ import annotations
+
+import struct
+import threading
+from typing import Optional
+
+from ..db import DB
+from ..types.params import ConsensusParams
+from ..types.validator_set import ValidatorSet
+from ..wire import state_pb, abci_pb, encode, decode
+from .state import State
+
+_STATE_KEY = b"stateKey"
+_VALIDATORS = b"\x10"       # height -> ValidatorsInfo
+_CONSENSUS_PARAMS = b"\x11"  # height -> ConsensusParamsInfo
+_ABCI_RESPONSES = b"\x12"   # height -> ABCIResponsesInfo
+
+# how far ahead validator sets are known (nextValSet delay)
+VAL_SET_CHECKPOINT_INTERVAL = 100000
+
+
+def _h(height: int) -> bytes:
+    return struct.pack(">q", height)
+
+
+def _validators_key(height: int) -> bytes:
+    return _VALIDATORS + _h(height)
+
+
+def _params_key(height: int) -> bytes:
+    return _CONSENSUS_PARAMS + _h(height)
+
+
+def _abci_responses_key(height: int) -> bytes:
+    return _ABCI_RESPONSES + _h(height)
+
+
+class StateStoreError(Exception):
+    pass
+
+
+class Store:
+    def __init__(self, db: DB):
+        self._db = db
+        self._lock = threading.RLock()
+
+    # ------------------------------------------------------------------
+    def load(self) -> Optional[State]:
+        raw = self._db.get(_STATE_KEY)
+        if raw is None:
+            return None
+        return State.from_bytes(raw)
+
+    def save(self, state: State) -> None:
+        """Persist state + the next validator set + params records.
+
+        Reference: store.go save — writes validators at
+        LastBlockHeight+2 (the nextValSet delay) and params at +1."""
+        with self._lock:
+            next_height = state.last_block_height + 1
+            if state.last_block_height == 0:   # genesis bootstrap
+                # reference: save uses InitialHeight when nextHeight == 1
+                next_height = state.initial_height
+                self._save_validators(next_height, state.validators,
+                                      state.last_height_validators_changed)
+            self._save_validators(next_height + 1, state.next_validators,
+                                  state.last_height_validators_changed)
+            self._save_params(next_height, state.consensus_params,
+                              state.last_height_consensus_params_changed)
+            self._db.set_sync(_STATE_KEY, state.bytes())
+
+    def bootstrap(self, state: State) -> None:
+        """Reference: store.go Bootstrap — used by state sync."""
+        with self._lock:
+            height = state.last_block_height + 1
+            if height > 1 and state.last_validators is not None and \
+                    state.last_validators.size() > 0:
+                self._save_validators(
+                    height - 1, state.last_validators, height - 1)
+            self._save_validators(height, state.validators, height)
+            self._save_validators(height + 1, state.next_validators,
+                                  height + 1)
+            self._save_params(
+                height, state.consensus_params,
+                state.last_height_consensus_params_changed or height)
+            self._db.set_sync(_STATE_KEY, state.bytes())
+
+    # ------------------------------------------------------------------
+    def _save_validators(self, height: int, vals: ValidatorSet,
+                         last_changed: int) -> None:
+        # store the full set at change/checkpoint heights, else a pointer
+        d: dict = {"last_height_changed": last_changed}
+        if height == last_changed or \
+                height % VAL_SET_CHECKPOINT_INTERVAL == 0:
+            d["validator_set"] = vals.to_proto()
+        self._db.set(_validators_key(height),
+                     encode(state_pb.VALIDATORS_INFO, d))
+
+    def load_validators(self, height: int) -> ValidatorSet:
+        """Reference: store.go LoadValidators with lookback."""
+        raw = self._db.get(_validators_key(height))
+        if raw is None:
+            raise StateStoreError(
+                f"no validator set found for height {height}")
+        info = decode(state_pb.VALIDATORS_INFO, raw)
+        if info.get("validator_set") is not None:
+            return ValidatorSet.from_proto(info["validator_set"])
+        last_changed = info.get("last_height_changed", 0)
+        raw2 = self._db.get(_validators_key(last_changed))
+        if raw2 is None:
+            raise StateStoreError(
+                f"validator lookback to {last_changed} failed "
+                f"for height {height}")
+        info2 = decode(state_pb.VALIDATORS_INFO, raw2)
+        if info2.get("validator_set") is None:
+            raise StateStoreError(
+                f"validator set at change-height {last_changed} is empty")
+        vals = ValidatorSet.from_proto(info2["validator_set"])
+        # roll priorities forward to the requested height
+        if height > last_changed:
+            vals.increment_proposer_priority(height - last_changed)
+        return vals
+
+    # ------------------------------------------------------------------
+    def _save_params(self, height: int, params: ConsensusParams,
+                     last_changed: int) -> None:
+        d: dict = {"last_height_changed": last_changed}
+        if height == last_changed or \
+                height % VAL_SET_CHECKPOINT_INTERVAL == 0:
+            d["consensus_params"] = params.to_proto()
+        else:
+            d["consensus_params"] = {}
+        self._db.set(_params_key(height),
+                     encode(state_pb.CONSENSUS_PARAMS_INFO, d))
+
+    def load_consensus_params(self, height: int) -> ConsensusParams:
+        raw = self._db.get(_params_key(height))
+        if raw is None:
+            raise StateStoreError(
+                f"no consensus params found for height {height}")
+        info = decode(state_pb.CONSENSUS_PARAMS_INFO, raw)
+        params_d = info.get("consensus_params") or {}
+        if params_d:
+            return ConsensusParams.from_proto(params_d)
+        last_changed = info.get("last_height_changed", 0)
+        raw2 = self._db.get(_params_key(last_changed))
+        if raw2 is None:
+            raise StateStoreError(
+                f"params lookback to {last_changed} failed")
+        info2 = decode(state_pb.CONSENSUS_PARAMS_INFO, raw2)
+        if not info2.get("consensus_params"):
+            raise StateStoreError(
+                f"params at change-height {last_changed} are empty")
+        return ConsensusParams.from_proto(info2["consensus_params"])
+
+    # ------------------------------------------------------------------
+    def save_finalize_block_response(self, height: int, resp) -> None:
+        """Persist the FinalizeBlockResponse BEFORE app Commit so crash
+        recovery can reconstruct results (reference: store.go
+        SaveFinalizeBlockResponse)."""
+        d = _fbr_to_proto(resp)
+        self._db.set_sync(
+            _abci_responses_key(height),
+            encode(state_pb.ABCI_RESPONSES_INFO,
+                   {"height": height, "finalize_block": d}))
+
+    def load_finalize_block_response(self, height: int):
+        raw = self._db.get(_abci_responses_key(height))
+        if raw is None:
+            return None
+        info = decode(state_pb.ABCI_RESPONSES_INFO, raw)
+        fb = info.get("finalize_block")
+        return _fbr_from_proto(fb) if fb is not None else None
+
+    # ------------------------------------------------------------------
+    def prune_states(self, from_height: int, to_height: int,
+                     evidence_threshold_height: int) -> int:
+        """Delete state records in [from, to) (reference: store.go
+        PruneStates — kept heights are materialized in full BEFORE their
+        lookback targets are deleted); returns number pruned."""
+        if from_height <= 0 or to_height <= from_height:
+            return 0
+        # materialize full records at the heights that survive, so their
+        # lookback pointers cannot dangle after deletion
+        for keep in {to_height, evidence_threshold_height}:
+            if keep < from_height:
+                continue
+            try:
+                vals = self.load_validators(keep)
+                self._save_validators(keep, vals, keep)
+            except StateStoreError:
+                pass
+            if keep == to_height:
+                try:
+                    params = self.load_consensus_params(keep)
+                    self._db.set(
+                        _params_key(keep),
+                        encode(state_pb.CONSENSUS_PARAMS_INFO,
+                               {"last_height_changed": keep,
+                                "consensus_params": params.to_proto()}))
+                except StateStoreError:
+                    pass
+        pruned = 0
+        batch = self._db.new_batch()
+        for h in range(from_height, to_height):
+            batch.delete(_abci_responses_key(h))
+            if h < evidence_threshold_height:
+                batch.delete(_validators_key(h))
+            batch.delete(_params_key(h))
+            pruned += 1
+        batch.write()
+        return pruned
+
+
+def _fbr_to_proto(resp) -> dict:
+    """abci.FinalizeBlockResponse dataclass -> proto dict."""
+    def event(e):
+        return {
+            **({"type": e.type} if e.type else {}),
+            "attributes": [
+                {**({"key": a.key} if a.key else {}),
+                 **({"value": a.value} if a.value else {}),
+                 **({"index": True} if a.index else {})}
+                for a in e.attributes],
+        }
+
+    def txr(r):
+        d: dict = {}
+        if r.code:
+            d["code"] = r.code
+        if r.data:
+            d["data"] = r.data
+        if r.log:
+            d["log"] = r.log
+        if r.info:
+            d["info"] = r.info
+        if r.gas_wanted:
+            d["gas_wanted"] = r.gas_wanted
+        if r.gas_used:
+            d["gas_used"] = r.gas_used
+        if r.events:
+            d["events"] = [event(e) for e in r.events]
+        if r.codespace:
+            d["codespace"] = r.codespace
+        return d
+
+    d: dict = {"next_block_delay": {}}
+    if resp.events:
+        d["events"] = [event(e) for e in resp.events]
+    if resp.tx_results:
+        d["tx_results"] = [txr(r) for r in resp.tx_results]
+    if resp.validator_updates:
+        d["validator_updates"] = [
+            {**({"power": v.power} if v.power else {}),
+             **({"pub_key_bytes": v.pub_key_bytes}
+                if v.pub_key_bytes else {}),
+             **({"pub_key_type": v.pub_key_type}
+                if v.pub_key_type else {})}
+            for v in resp.validator_updates]
+    if resp.consensus_param_updates is not None:
+        d["consensus_param_updates"] = \
+            resp.consensus_param_updates.to_proto()
+    if resp.app_hash:
+        d["app_hash"] = resp.app_hash
+    if resp.next_block_delay_ns:
+        s, ns = divmod(resp.next_block_delay_ns, 1_000_000_000)
+        nd: dict = {}
+        if s:
+            nd["seconds"] = s
+        if ns:
+            nd["nanos"] = ns
+        d["next_block_delay"] = nd
+    return d
+
+
+def _fbr_from_proto(d: dict):
+    from ..abci import types as abci_types
+
+    def event(e):
+        return abci_types.Event(
+            type=e.get("type", ""),
+            attributes=[abci_types.EventAttribute(
+                key=a.get("key", ""), value=a.get("value", ""),
+                index=a.get("index", False))
+                for a in e.get("attributes", [])])
+
+    nd = d.get("next_block_delay") or {}
+    cpu = d.get("consensus_param_updates")
+    return abci_types.FinalizeBlockResponse(
+        events=[event(e) for e in d.get("events", [])],
+        tx_results=[abci_types.ExecTxResult(
+            code=r.get("code", 0), data=r.get("data", b""),
+            log=r.get("log", ""), info=r.get("info", ""),
+            gas_wanted=r.get("gas_wanted", 0),
+            gas_used=r.get("gas_used", 0),
+            events=[event(e) for e in r.get("events", [])],
+            codespace=r.get("codespace", ""))
+            for r in d.get("tx_results", [])],
+        validator_updates=[abci_types.ValidatorUpdate(
+            power=v.get("power", 0),
+            pub_key_bytes=v.get("pub_key_bytes", b""),
+            pub_key_type=v.get("pub_key_type", ""))
+            for v in d.get("validator_updates", [])],
+        consensus_param_updates=ConsensusParams.from_proto(cpu)
+        if cpu is not None else None,
+        app_hash=d.get("app_hash", b""),
+        next_block_delay_ns=nd.get("seconds", 0) * 1_000_000_000 +
+        nd.get("nanos", 0),
+    )

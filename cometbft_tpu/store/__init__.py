@@ -1,0 +1,4 @@
+"""Block storage."""
+from .store import BlockStore, BlockStoreError
+
+__all__ = ["BlockStore", "BlockStoreError"]

@@ -1,0 +1,109 @@
+"""Wire descriptors for cometbft.abci.v2 (subset used on disk and over
+the socket protocol).
+
+Reference: proto/cometbft/abci/v2/types.proto.
+"""
+from .proto import F, Msg
+from .pb import CONSENSUS_PARAMS, PROOF_OPS, TIMESTAMP, DURATION
+
+EVENT_ATTRIBUTE = Msg(
+    "cometbft.abci.v2.EventAttribute",
+    F(1, "key", "string"),
+    F(2, "value", "string"),
+    F(3, "index", "bool"),
+)
+
+EVENT = Msg(
+    "cometbft.abci.v2.Event",
+    F(1, "type", "string"),
+    F(2, "attributes", "msg", msg=EVENT_ATTRIBUTE, repeated=True),
+)
+
+EXEC_TX_RESULT = Msg(
+    "cometbft.abci.v2.ExecTxResult",
+    F(1, "code", "uint32"),
+    F(2, "data", "bytes"),
+    F(3, "log", "string"),
+    F(4, "info", "string"),
+    F(5, "gas_wanted", "int64"),
+    F(6, "gas_used", "int64"),
+    F(7, "events", "msg", msg=EVENT, repeated=True),
+    F(8, "codespace", "string"),
+)
+
+TX_RESULT = Msg(
+    "cometbft.abci.v2.TxResult",
+    F(1, "height", "int64"),
+    F(2, "index", "uint32"),
+    F(3, "tx", "bytes"),
+    F(4, "result", "msg", msg=EXEC_TX_RESULT, always=True),
+)
+
+ABCI_VALIDATOR = Msg(
+    "cometbft.abci.v2.Validator",
+    F(1, "address", "bytes"),
+    F(3, "power", "int64"),
+)
+
+VALIDATOR_UPDATE = Msg(
+    "cometbft.abci.v2.ValidatorUpdate",
+    F(2, "power", "int64"),
+    F(3, "pub_key_bytes", "bytes"),
+    F(4, "pub_key_type", "string"),
+)
+
+VOTE_INFO = Msg(
+    "cometbft.abci.v2.VoteInfo",
+    F(1, "validator", "msg", msg=ABCI_VALIDATOR, always=True),
+    F(3, "block_id_flag", "enum"),
+)
+
+EXTENDED_VOTE_INFO = Msg(
+    "cometbft.abci.v2.ExtendedVoteInfo",
+    F(1, "validator", "msg", msg=ABCI_VALIDATOR, always=True),
+    F(3, "vote_extension", "bytes"),
+    F(4, "extension_signature", "bytes"),
+    F(5, "block_id_flag", "enum"),
+    F(6, "non_rp_vote_extension", "bytes"),
+    F(7, "non_rp_extension_signature", "bytes"),
+)
+
+COMMIT_INFO = Msg(
+    "cometbft.abci.v2.CommitInfo",
+    F(1, "round", "int32"),
+    F(2, "votes", "msg", msg=VOTE_INFO, repeated=True),
+)
+
+EXTENDED_COMMIT_INFO = Msg(
+    "cometbft.abci.v2.ExtendedCommitInfo",
+    F(1, "round", "int32"),
+    F(2, "votes", "msg", msg=EXTENDED_VOTE_INFO, repeated=True),
+)
+
+MISBEHAVIOR = Msg(
+    "cometbft.abci.v2.Misbehavior",
+    F(1, "type", "enum"),
+    F(2, "validator", "msg", msg=ABCI_VALIDATOR, always=True),
+    F(3, "height", "int64"),
+    F(4, "time", "msg", msg=TIMESTAMP, always=True),
+    F(5, "total_voting_power", "int64"),
+)
+
+SNAPSHOT = Msg(
+    "cometbft.abci.v2.Snapshot",
+    F(1, "height", "uint64"),
+    F(2, "format", "uint32"),
+    F(3, "chunks", "uint32"),
+    F(4, "hash", "bytes"),
+    F(5, "metadata", "bytes"),
+)
+
+FINALIZE_BLOCK_RESPONSE = Msg(
+    "cometbft.abci.v2.FinalizeBlockResponse",
+    F(1, "events", "msg", msg=EVENT, repeated=True),
+    F(2, "tx_results", "msg", msg=EXEC_TX_RESULT, repeated=True),
+    F(3, "validator_updates", "msg", msg=VALIDATOR_UPDATE, repeated=True),
+    F(4, "consensus_param_updates", "msg", msg=CONSENSUS_PARAMS),
+    F(5, "app_hash", "bytes"),
+    F(6, "next_block_delay", "msg", msg=DURATION, always=True),
+)

@@ -1,0 +1,235 @@
+"""State/execution tests: genesis state, BlockStore round-trips, and the
+full propose → validate → apply loop against the kvstore app.
+"""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApplication, \
+    make_val_set_change_tx
+from cometbft_tpu.crypto import batch as crypto_batch, ed25519
+from cometbft_tpu.db import MemDB
+from cometbft_tpu.state import State, make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor, tx_results_hash
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.state.validation import (
+    BlockValidationError, validate_block,
+)
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import Commit, CommitSig, ExtendedCommit
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV, new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+from cometbft_tpu.types import canonical
+
+
+def run(coro):
+    return asyncio.get_event_loop_policy().new_event_loop() \
+        .run_until_complete(coro)
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def _genesis(n_vals=3, power=10, chain_id="exec-test"):
+    pvs = [new_mock_pv() for _ in range(n_vals)]
+    doc = GenesisDoc(
+        chain_id=chain_id,
+        genesis_time=Timestamp(1700000000, 0),
+        validators=[GenesisValidator(address=b"",
+                                     pub_key=pv.get_pub_key(),
+                                     power=power) for pv in pvs],
+    )
+    state = make_genesis_state(doc)
+    # order pvs to match the sorted validator set
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    pvs = [by_addr[v.address] for v in state.validators.validators]
+    return doc, state, pvs
+
+
+def _sign_commit(chain_id, valset, pv_by_addr, height, block_id,
+                 time=None) -> ExtendedCommit:
+    """Validators with a known key precommit block_id; others absent."""
+    from cometbft_tpu.types.commit import ExtendedCommitSig
+    sigs = []
+    for i, v in enumerate(valset.validators):
+        pv = pv_by_addr.get(v.address)
+        if pv is None:
+            sigs.append(ExtendedCommitSig(timestamp=Timestamp.zero()))
+            continue
+        ts = time or Timestamp(1700000000 + height, 0)
+        vote = Vote(type=canonical.PRECOMMIT_TYPE, height=height,
+                    round=0, block_id=block_id, timestamp=ts,
+                    validator_address=v.address, validator_index=i)
+        pv.sign_vote(chain_id, vote, sign_extension=False)
+        sigs.append(ExtendedCommitSig(
+            block_id_flag=BLOCK_ID_FLAG_COMMIT,
+            validator_address=v.address, timestamp=ts,
+            signature=vote.signature))
+    return ExtendedCommit(height=height, round=0, block_id=block_id,
+                          extended_signatures=sigs)
+
+
+async def _run_chain(n_blocks=3, txs_fn=None, extra_pvs=()):
+    doc, state, pvs = _genesis()
+    pv_by_addr = {pv.get_pub_key().address(): pv
+                  for pv in list(pvs) + list(extra_pvs)}
+    app = KVStoreApplication()
+    conns = AppConns(app)
+    state_store = Store(MemDB())
+    block_store = BlockStore(MemDB())
+    state_store.save(state)
+
+    exec_ = BlockExecutor(state_store, conns.consensus,
+                          block_store=block_store)
+
+    # InitChain
+    await conns.consensus.init_chain(abci.InitChainRequest(
+        chain_id=doc.chain_id, initial_height=doc.initial_height,
+        validators=[], time=doc.genesis_time))
+
+    last_ext_commit = ExtendedCommit(height=0, round=0)
+    heights = []
+    for h in range(1, n_blocks + 1):
+        proposer = state.validators.get_proposer()
+        txs = (txs_fn(h) if txs_fn else [f"k{h}=v{h}".encode()])
+        block = await exec_.create_proposal_block(
+            h, state, last_ext_commit, proposer.address)
+        # nop mempool gives empty txs; splice ours in for the test
+        block = state.make_block(h, txs, last_ext_commit.to_commit(),
+                                 [], proposer.address,
+                                 block_time=block.header.time)
+        parts = block.make_part_set()
+        block_id = BlockID(hash=block.hash(),
+                           part_set_header=parts.header())
+        assert await exec_.process_proposal(block, state)
+        validate_block(state, block)
+        vals_at_h = state.validators   # the set that signs height h
+        state = await exec_.apply_block(state, block_id, block)
+        ext = _sign_commit(doc.chain_id, vals_at_h, pv_by_addr, h,
+                           block_id)
+        block_store.save_block(block, parts, ext.to_commit())
+        last_ext_commit = ext
+        heights.append(h)
+    return doc, state, app, state_store, block_store, heights
+
+
+class TestChainExecution:
+    def test_three_blocks(self):
+        doc, state, app, ss, bs, heights = run(_run_chain(3))
+        assert state.last_block_height == 3
+        assert bs.height == 3
+        assert bs.base == 1
+        # app hash progressed
+        assert state.app_hash != b""
+        # query works
+        async def q():
+            return await app.query(abci.QueryRequest(data=b"k2"))
+        assert run(q()).value == b"v2"
+
+    def test_block_store_roundtrip(self):
+        doc, state, app, ss, bs, heights = run(_run_chain(2))
+        b1 = bs.load_block(1)
+        assert b1 is not None
+        assert b1.header.height == 1
+        assert b1.data.txs == [b"k1=v1"]
+        meta = bs.load_block_meta(1)
+        assert meta.header.chain_id == doc.chain_id
+        assert bs.load_block_by_hash(b1.hash()).header.height == 1
+        # commit for height 1 was stored from block 2's LastCommit
+        c1 = bs.load_block_commit(1)
+        assert c1 is not None and c1.height == 1
+        sc = bs.load_seen_commit(2)
+        assert sc is not None and sc.height == 2
+
+    def test_state_store_roundtrip(self):
+        doc, state, app, ss, bs, heights = run(_run_chain(2))
+        loaded = ss.load()
+        assert loaded.last_block_height == 2
+        assert loaded.chain_id == doc.chain_id
+        assert loaded.validators.hash() == state.validators.hash()
+        assert loaded.app_hash == state.app_hash
+        # historical validators retrievable
+        v1 = ss.load_validators(1)
+        assert v1.size() == 3
+        p1 = ss.load_consensus_params(1)
+        assert p1.block.max_bytes == state.consensus_params.block.max_bytes
+
+    def test_finalize_block_response_persisted(self):
+        doc, state, app, ss, bs, heights = run(_run_chain(2))
+        r = ss.load_finalize_block_response(1)
+        assert r is not None
+        assert len(r.tx_results) == 1
+        assert r.app_hash != b""
+
+    def test_validator_update_applies_at_h_plus_2(self):
+        new_pv = new_mock_pv()
+        vtx = make_val_set_change_tx(
+            "ed25519", new_pv.get_pub_key().bytes(), 5)
+
+        def txs_fn(h):
+            return [vtx] if h == 1 else [f"k{h}=v{h}".encode()]
+
+        doc, state, app, ss, bs, heights = run(
+            _run_chain(3, txs_fn, extra_pvs=[new_pv]))
+        # update from height 1 lands in NextValidators after block 1,
+        # i.e. Validators at height 3
+        assert state.validators.size() == 4
+        addrs = {v.address for v in state.validators.validators}
+        assert new_pv.get_pub_key().address() in addrs
+
+    def test_wrong_app_hash_rejected(self):
+        doc, state, pvs = _genesis()
+        app = KVStoreApplication()
+        conns = AppConns(app)
+        ss = Store(MemDB())
+        ss.save(state)
+        exec_ = BlockExecutor(ss, conns.consensus)
+        proposer = state.validators.get_proposer()
+        block = state.make_block(1, [], Commit(), [], proposer.address)
+        block.header.app_hash = b"\x99" * 32   # wrong
+        block.fill_header()
+        with pytest.raises(BlockValidationError, match="AppHash"):
+            validate_block(state, block)
+
+    def test_last_commit_verified(self):
+        # block 2 with a corrupted LastCommit signature must fail
+        async def go():
+            doc, state, pvs = _genesis()
+            app = KVStoreApplication()
+            conns = AppConns(app)
+            ss = Store(MemDB())
+            ss.save(state)
+            exec_ = BlockExecutor(ss, conns.consensus)
+            proposer = state.validators.get_proposer()
+            b1 = state.make_block(1, [], Commit(), [], proposer.address)
+            ps1 = b1.make_part_set()
+            bid1 = BlockID(hash=b1.hash(), part_set_header=ps1.header())
+            vals1 = state.validators
+            pv_by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+            state = await exec_.apply_block(state, bid1, b1)
+            ext = _sign_commit(doc.chain_id, vals1, pv_by_addr, 1, bid1)
+            commit = ext.to_commit()
+            commit.signatures[0].signature = bytes(64)
+            proposer2 = state.validators.get_proposer()
+            b2 = state.make_block(2, [], commit, [], proposer2.address)
+            with pytest.raises(BlockValidationError):
+                validate_block(state, b2)
+        run(go())
+
+
+class TestTxResultsHash:
+    def test_deterministic_fields_only(self):
+        r1 = [abci.ExecTxResult(code=0, data=b"x", log="nondet")]
+        r2 = [abci.ExecTxResult(code=0, data=b"x", log="different")]
+        assert tx_results_hash(r1) == tx_results_hash(r2)
+        r3 = [abci.ExecTxResult(code=1, data=b"x")]
+        assert tx_results_hash(r1) != tx_results_hash(r3)
