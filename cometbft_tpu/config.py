@@ -1,0 +1,177 @@
+"""Node configuration tree.
+
+Reference: config/config.go:93 — Config{Base,RPC,P2P,Mempool,StateSync,
+BlockSync,Consensus,Storage,TxIndex,Instrumentation}, defaults (:111) and
+TestConfig (:128).  Durations are nanoseconds (ints) for determinism.
+"""
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+
+_MS = 1_000_000
+_S = 1_000_000_000
+
+
+@dataclass
+class BaseConfig:
+    chain_id: str = ""
+    home: str = "."
+    moniker: str = "anonymous"
+    proxy_app: str = "kvstore"
+    abci: str = "builtin"
+    db_backend: str = "sqlite"
+    db_dir: str = "data"
+    log_level: str = "info"
+    genesis_file: str = "config/genesis.json"
+    priv_validator_key_file: str = "config/priv_validator_key.json"
+    priv_validator_state_file: str = "data/priv_validator_state.json"
+    node_key_file: str = "config/node_key.json"
+    filter_peers: bool = False
+
+    def path(self, rel: str) -> str:
+        return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
+
+
+@dataclass
+class RPCConfig:
+    laddr: str = "tcp://127.0.0.1:26657"
+    max_open_connections: int = 900
+    max_subscription_clients: int = 100
+    max_subscriptions_per_client: int = 5
+    timeout_broadcast_tx_commit_ns: int = 10 * _S
+    max_body_bytes: int = 1_000_000
+    max_header_bytes: int = 1 << 20
+
+
+@dataclass
+class P2PConfig:
+    laddr: str = "tcp://0.0.0.0:26656"
+    external_address: str = ""
+    seeds: str = ""
+    persistent_peers: str = ""
+    addr_book_file: str = "config/addrbook.json"
+    addr_book_strict: bool = True
+    max_num_inbound_peers: int = 40
+    max_num_outbound_peers: int = 10
+    flush_throttle_timeout_ns: int = 10 * _MS
+    max_packet_msg_payload_size: int = 1024
+    send_rate: int = 5_120_000
+    recv_rate: int = 5_120_000
+    pex: bool = True
+    seed_mode: bool = False
+    private_peer_ids: str = ""
+    allow_duplicate_ip: bool = False
+    handshake_timeout_ns: int = 20 * _S
+    dial_timeout_ns: int = 3 * _S
+
+
+@dataclass
+class MempoolConfig:
+    recheck: bool = True
+    recheck_timeout_ns: int = 1 * _S
+    broadcast: bool = True
+    size: int = 5000
+    max_txs_bytes: int = 64 * 1024 * 1024
+    cache_size: int = 10000
+    keep_invalid_txs_in_cache: bool = False
+    max_tx_bytes: int = 1024 * 1024
+
+
+@dataclass
+class StateSyncConfig:
+    enable: bool = False
+    rpc_servers: list[str] = field(default_factory=list)
+    trust_height: int = 0
+    trust_hash: str = ""
+    trust_period_ns: int = 168 * 3600 * _S
+    discovery_time_ns: int = 15 * _S
+    chunk_request_timeout_ns: int = 10 * _S
+    chunk_fetchers: int = 4
+    temp_dir: str = ""
+
+
+@dataclass
+class BlockSyncConfig:
+    enable: bool = True
+
+
+@dataclass
+class ConsensusConfig:
+    wal_file: str = "data/cs.wal/wal"
+    # reference: config.go:1255-1259
+    timeout_propose_ns: int = 3000 * _MS
+    timeout_propose_delta_ns: int = 500 * _MS
+    timeout_vote_ns: int = 1000 * _MS
+    timeout_vote_delta_ns: int = 500 * _MS
+    timeout_commit_ns: int = 0        # deprecated; app next_block_delay
+    skip_timeout_commit: bool = False
+    double_sign_check_height: int = 0
+    create_empty_blocks: bool = True
+    create_empty_blocks_interval_ns: int = 0
+    peer_gossip_sleep_duration_ns: int = 100 * _MS
+    peer_query_maj23_sleep_duration_ns: int = 2 * _S
+
+    def propose_timeout_ns(self, round_: int) -> int:
+        return self.timeout_propose_ns + \
+            self.timeout_propose_delta_ns * round_
+
+    def prevote_timeout_ns(self, round_: int) -> int:
+        return self.timeout_vote_ns + self.timeout_vote_delta_ns * round_
+
+    def precommit_timeout_ns(self, round_: int) -> int:
+        return self.timeout_vote_ns + self.timeout_vote_delta_ns * round_
+
+    def wait_for_txs(self) -> bool:
+        return not self.create_empty_blocks or \
+            self.create_empty_blocks_interval_ns > 0
+
+
+@dataclass
+class StorageConfig:
+    discard_abci_responses: bool = False
+    pruning_interval_ns: int = 10 * _S
+
+
+@dataclass
+class TxIndexConfig:
+    indexer: str = "kv"
+
+
+@dataclass
+class InstrumentationConfig:
+    prometheus: bool = False
+    prometheus_listen_addr: str = ":26660"
+    pprof_listen_addr: str = ""
+    namespace: str = "cometbft"
+
+
+@dataclass
+class Config:
+    base: BaseConfig = field(default_factory=BaseConfig)
+    rpc: RPCConfig = field(default_factory=RPCConfig)
+    p2p: P2PConfig = field(default_factory=P2PConfig)
+    mempool: MempoolConfig = field(default_factory=MempoolConfig)
+    statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
+    blocksync: BlockSyncConfig = field(default_factory=BlockSyncConfig)
+    consensus: ConsensusConfig = field(default_factory=ConsensusConfig)
+    storage: StorageConfig = field(default_factory=StorageConfig)
+    tx_index: TxIndexConfig = field(default_factory=TxIndexConfig)
+    instrumentation: InstrumentationConfig = field(
+        default_factory=InstrumentationConfig)
+
+
+def default_config() -> Config:
+    return Config()
+
+
+def test_config() -> Config:
+    """Reference: config.go TestConfig (:128) — tight timeouts."""
+    cfg = Config()
+    cfg.consensus.timeout_propose_ns = 40 * _MS
+    cfg.consensus.timeout_propose_delta_ns = 1 * _MS
+    cfg.consensus.timeout_vote_ns = 10 * _MS
+    cfg.consensus.timeout_vote_delta_ns = 1 * _MS
+    cfg.consensus.timeout_commit_ns = 0
+    cfg.base.db_backend = "memdb"
+    return cfg

@@ -1,0 +1,5 @@
+"""The Tendermint consensus state machine and its support machinery.
+
+Reference: internal/consensus/ — State (the algorithm), Reactor (gossip),
+WAL, replay/handshake, HeightVoteSet, TimeoutTicker.
+"""

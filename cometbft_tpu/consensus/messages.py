@@ -1,0 +1,161 @@
+"""Consensus messages (gossip + WAL payloads).
+
+Reference: internal/consensus/msgs.go — ProposalMessage, BlockPartMessage,
+VoteMessage, NewRoundStepMessage, NewValidBlockMessage, HasVoteMessage,
+VoteSetMaj23Message, VoteSetBitsMessage, ProposalPOLMessage.
+
+WAL/JSON codec: proto-shaped dicts with bytes hex-tagged, so records are
+self-describing and durable across code changes.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Optional
+
+from ..libs.bits import BitArray
+from ..types.block_id import BlockID
+from ..types.part_set import Part
+from ..types.proposal import Proposal
+from ..types.vote import Vote
+
+
+def jsonify(obj: Any) -> Any:
+    """Nested proto-dict → JSON-safe (bytes → {"__b": hex})."""
+    if isinstance(obj, (bytes, bytearray)):
+        return {"__b": bytes(obj).hex()}
+    if isinstance(obj, dict):
+        return {k: jsonify(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [jsonify(v) for v in obj]
+    return obj
+
+
+def dejsonify(obj: Any) -> Any:
+    if isinstance(obj, dict):
+        if set(obj.keys()) == {"__b"}:
+            return bytes.fromhex(obj["__b"])
+        return {k: dejsonify(v) for k, v in obj.items()}
+    if isinstance(obj, list):
+        return [dejsonify(v) for v in obj]
+    return obj
+
+
+@dataclass
+class ProposalMessage:
+    proposal: Proposal
+
+    TYPE = "proposal"
+
+    def to_wal(self) -> dict:
+        return {"type": self.TYPE,
+                "proposal": jsonify(self.proposal.to_proto())}
+
+
+@dataclass
+class BlockPartMessage:
+    height: int
+    round: int
+    part: Part
+
+    TYPE = "block_part"
+
+    def to_wal(self) -> dict:
+        return {"type": self.TYPE, "height": self.height,
+                "round": self.round,
+                "part": jsonify(self.part.to_proto())}
+
+
+@dataclass
+class VoteMessage:
+    vote: Vote
+
+    TYPE = "vote"
+
+    def to_wal(self) -> dict:
+        return {"type": self.TYPE, "vote": jsonify(self.vote.to_proto())}
+
+
+@dataclass
+class NewRoundStepMessage:
+    height: int
+    round: int
+    step: int
+    seconds_since_start_time: int = 0
+    last_commit_round: int = -1
+
+    TYPE = "new_round_step"
+
+
+@dataclass
+class NewValidBlockMessage:
+    height: int
+    round: int
+    block_part_set_header: object = None   # PartSetHeader
+    block_parts: Optional[BitArray] = None
+    is_commit: bool = False
+
+    TYPE = "new_valid_block"
+
+
+@dataclass
+class HasVoteMessage:
+    height: int
+    round: int
+    type: int
+    index: int
+
+    TYPE = "has_vote"
+
+
+@dataclass
+class VoteSetMaj23Message:
+    height: int
+    round: int
+    type: int
+    block_id: BlockID = field(default_factory=BlockID)
+
+    TYPE = "vote_set_maj23"
+
+
+@dataclass
+class VoteSetBitsMessage:
+    height: int
+    round: int
+    type: int
+    block_id: BlockID = field(default_factory=BlockID)
+    votes: Optional[BitArray] = None
+
+    TYPE = "vote_set_bits"
+
+
+@dataclass
+class ProposalPOLMessage:
+    height: int
+    proposal_pol_round: int
+    proposal_pol: Optional[BitArray] = None
+
+    TYPE = "proposal_pol"
+
+
+@dataclass
+class HasProposalBlockPartMessage:
+    height: int
+    round: int
+    index: int
+
+    TYPE = "has_proposal_block_part"
+
+
+def message_from_wal(d: dict):
+    """Decode a WAL msg record back into a message object."""
+    t = d.get("type")
+    if t == ProposalMessage.TYPE:
+        return ProposalMessage(
+            Proposal.from_proto(dejsonify(d["proposal"])))
+    if t == BlockPartMessage.TYPE:
+        return BlockPartMessage(
+            height=d["height"], round=d["round"],
+            part=Part.from_proto(dejsonify(d["part"])))
+    if t == VoteMessage.TYPE:
+        return VoteMessage(Vote.from_proto(dejsonify(d["vote"])))
+    raise ValueError(f"unknown WAL message type {t!r}")

@@ -1,0 +1,100 @@
+"""RoundState: the public snapshot of the consensus internal state.
+
+Reference: internal/consensus/types/round_state.go:67 and the
+RoundStepType enum.
+"""
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..types.block import Block
+from ..types.block_id import BlockID
+from ..types.part_set import PartSet
+from ..types.proposal import Proposal
+from ..types.timestamp import Timestamp
+from ..types.validator_set import ValidatorSet
+
+# RoundStepType (reference: round_state.go:12-40)
+STEP_NEW_HEIGHT = 1
+STEP_NEW_ROUND = 2
+STEP_PROPOSE = 3
+STEP_PREVOTE = 4
+STEP_PREVOTE_WAIT = 5
+STEP_PRECOMMIT = 6
+STEP_PRECOMMIT_WAIT = 7
+STEP_COMMIT = 8
+
+STEP_NAMES = {
+    STEP_NEW_HEIGHT: "NewHeight",
+    STEP_NEW_ROUND: "NewRound",
+    STEP_PROPOSE: "Propose",
+    STEP_PREVOTE: "Prevote",
+    STEP_PREVOTE_WAIT: "PrevoteWait",
+    STEP_PRECOMMIT: "Precommit",
+    STEP_PRECOMMIT_WAIT: "PrecommitWait",
+    STEP_COMMIT: "Commit",
+}
+
+
+@dataclass
+class RoundState:
+    height: int = 0
+    round: int = 0
+    step: int = STEP_NEW_HEIGHT
+    start_time: Timestamp = field(default_factory=Timestamp.zero)
+    commit_time: Timestamp = field(default_factory=Timestamp.zero)
+
+    validators: Optional[ValidatorSet] = None
+    proposal: Optional[Proposal] = None
+    proposal_receive_time: Timestamp = field(
+        default_factory=Timestamp.zero)
+    proposal_block: Optional[Block] = None
+    proposal_block_parts: Optional[PartSet] = None
+
+    locked_round: int = -1
+    locked_block: Optional[Block] = None
+    locked_block_parts: Optional[PartSet] = None
+
+    # Last known round with POL for non-nil valid block
+    valid_round: int = -1
+    valid_block: Optional[Block] = None
+    valid_block_parts: Optional[PartSet] = None
+
+    votes: Optional[object] = None    # HeightVoteSet
+    commit_round: int = -1
+    last_commit: Optional[object] = None  # VoteSet of last height precommits
+    last_validators: Optional[ValidatorSet] = None
+    triggered_timeout_precommit: bool = False
+
+    def step_name(self) -> str:
+        return STEP_NAMES.get(self.step, "Unknown")
+
+    def proposal_block_id(self) -> Optional[BlockID]:
+        if self.proposal_block is None or \
+                self.proposal_block_parts is None:
+            return None
+        return BlockID(hash=self.proposal_block.hash(),
+                       part_set_header=self.proposal_block_parts.header())
+
+    def event_summary(self) -> dict:
+        return {
+            "height": self.height, "round": self.round,
+            "step": self.step_name(),
+        }
+
+    def __str__(self) -> str:
+        return (f"RoundState{{{self.height}/{self.round}/"
+                f"{self.step_name()}}}")
+
+
+@dataclass
+class TimeoutInfo:
+    duration_ns: int
+    height: int
+    round: int
+    step: int
+
+    def __str__(self) -> str:
+        return (f"{self.duration_ns / 1e6:.0f}ms@{self.height}/"
+                f"{self.round}/{STEP_NAMES.get(self.step)}")

@@ -1,0 +1,1100 @@
+"""The Tendermint consensus state machine.
+
+Reference: internal/consensus/state.go (2792 LoC) — a single receive
+routine serializes ALL inputs (peer messages, internal messages,
+timeouts); step functions enterNewRound → enterPropose → enterPrevote →
+enterPrecommit → enterCommit → finalizeCommit; WAL-before-process;
+lock/valid-block rules; PBTS timely checks; vote extensions.
+
+Here the receive routine is one asyncio task; the same serialization
+invariant holds (only that task mutates RoundState).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Callable, Optional
+
+from ..config import ConsensusConfig
+from ..libs.log import Logger, new_logger
+from ..state.execution import BlockExecutor
+from ..state.state import State as SMState
+from ..state.validation import BlockValidationError
+from ..types import canonical
+from ..types.block import Block
+from ..types.block_id import BlockID
+from ..types.commit import Commit, ExtendedCommit
+from ..types.events import EventBus, NopEventBus
+from ..types.params import MAX_BLOCK_SIZE_BYTES, BLOCK_PART_SIZE_BYTES
+from ..types.part_set import PartSet, PartSetError, PartSetHeader
+from ..types.priv_validator import PrivValidator
+from ..types.proposal import Proposal
+from ..types.timestamp import Timestamp
+from ..types.vote import Vote, VoteError
+from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
+from ..wire import pb, decode
+from .height_vote_set import HeightVoteSet, HeightVoteSetError
+from .messages import (
+    BlockPartMessage, ProposalMessage, VoteMessage,
+)
+from .round_state import (
+    STEP_COMMIT, STEP_NEW_HEIGHT, STEP_NEW_ROUND, STEP_PRECOMMIT,
+    STEP_PRECOMMIT_WAIT, STEP_PREVOTE, STEP_PREVOTE_WAIT, STEP_PROPOSE,
+    RoundState, TimeoutInfo,
+)
+from .ticker import TimeoutTicker
+from .wal import WAL, NilWAL
+
+_TIME_IOTA_NS = 1_000_000  # minimum time increment between blocks (1ms)
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class ConsensusState:
+    """The consensus machine for one node.
+
+    External inputs arrive via set_proposal / add_proposal_block_part /
+    try_add_vote (thread-unsafe; call from the event loop) or the async
+    queues used by the reactor.
+    """
+
+    def __init__(self, config: ConsensusConfig, state: SMState,
+                 block_exec: BlockExecutor, block_store,
+                 priv_validator: Optional[PrivValidator] = None,
+                 event_bus: Optional[EventBus] = None,
+                 wal: Optional[WAL] = None,
+                 logger: Optional[Logger] = None):
+        self.config = config
+        self.block_exec = block_exec
+        self.block_store = block_store
+        self.priv_validator = priv_validator
+        self.priv_validator_pub_key = \
+            priv_validator.get_pub_key() if priv_validator else None
+        self.event_bus = event_bus if event_bus is not None \
+            else NopEventBus()
+        self.wal = wal if wal is not None else NilWAL()
+        self.logger = logger if logger is not None else \
+            new_logger("consensus")
+
+        self.rs = RoundState()
+        self.sm_state: Optional[SMState] = None
+
+        # one merged input queue (Go's select over the three channels is
+        # unbiased, so FIFO merging preserves the semantics)
+        self._input_queue: asyncio.Queue = asyncio.Queue(2000)
+        self.ticker = TimeoutTicker(self._on_timeout_fired)
+        self._task: Optional[asyncio.Task] = None
+        self._stopped = asyncio.Event()
+        self.n_steps = 0
+        self.replay_mode = False
+
+        # hooks for the reactor / tests: called after state transitions
+        self.on_new_step: list[Callable[[RoundState], None]] = []
+        # broadcast hooks: the reactor wires these to peer gossip
+        self.broadcast_hooks: list[Callable[[object], None]] = []
+        # decide-proposal override (byzantine tests)
+        self.decide_proposal_override: Optional[Callable] = None
+
+        # reconstruct LastCommit from the stored seen commit BEFORE
+        # updateToState (reference: NewState — reconstructLastCommit runs
+        # first when LastBlockHeight > 0)
+        self._reconstruct_last_commit_if_needed(state)
+        self.update_to_state(state)
+
+    # ==================================================================
+    # lifecycle
+
+    async def start(self) -> None:
+        self._stopped.clear()
+        self._task = asyncio.get_running_loop().create_task(
+            self._receive_routine())
+        self._schedule_round0()
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            try:
+                await self._task
+            except asyncio.CancelledError:
+                pass
+        self.ticker.stop()
+        self.wal.close()
+        self._stopped.set()
+
+    # ==================================================================
+    # external input API (reference: state.go AddVote/SetProposal/
+    # AddProposalBlockPart — enqueue into peer/internal queues)
+
+    def send_internal(self, msg, peer_id: str = "") -> None:
+        self._input_queue.put_nowait(("internal", msg, peer_id))
+
+    def send_peer(self, msg, peer_id: str) -> None:
+        self._input_queue.put_nowait(("peer", msg, peer_id))
+
+    def _on_timeout_fired(self, ti: TimeoutInfo) -> None:
+        self._input_queue.put_nowait(("timeout", ti, ""))
+
+    # ==================================================================
+    # the receive routine — the ONLY mutator of RoundState
+
+    async def _receive_routine(self) -> None:
+        while True:
+            try:
+                # fairness: Queue.get returns without suspending while
+                # items are ready, which would starve every other task
+                # (peers, RPC, watchers) on a busy chain
+                await asyncio.sleep(0)
+                kind, msg, peer_id = await self._input_queue.get()
+                if kind == "timeout":
+                    await self._handle_timeout(msg)
+                else:
+                    await self._handle_msg(msg, peer_id,
+                                           internal=(kind == "internal"))
+            except asyncio.CancelledError:
+                raise
+            except Exception:
+                # reference: receiveRoutine recovers by flushing WAL then
+                # re-panicking; we log and crash the task
+                self.logger.error("consensus failure",
+                                  exc_info=True)
+                self.wal.flush_and_sync()
+                raise
+
+    async def _handle_msg(self, msg, peer_id: str, internal: bool) -> None:
+        # WAL-before-process (reference: state.go:886 handleMsg; internal
+        # messages are fsync'd — they may carry our own signatures).
+        # During catchup replay the messages are already in the WAL.
+        if not self.replay_mode:
+            if internal:
+                self.wal.write_sync(msg.to_wal())
+            else:
+                self.wal.write(msg.to_wal())
+
+        if isinstance(msg, ProposalMessage):
+            try:
+                self._set_proposal(msg.proposal, Timestamp.now())
+            except ConsensusError as e:
+                self.logger.error("failed to set proposal", err=str(e),
+                                  peer=peer_id)
+        elif isinstance(msg, BlockPartMessage):
+            try:
+                added = await self._add_proposal_block_part(msg, peer_id)
+            except (PartSetError, ConsensusError) as e:
+                self.logger.error("failed to add block part",
+                                  err=str(e), peer=peer_id)
+        elif isinstance(msg, VoteMessage):
+            try:
+                await self._try_add_vote(msg.vote, peer_id)
+            except (VoteSetError, HeightVoteSetError, VoteError) as e:
+                self.logger.error("failed to add vote", err=str(e),
+                                  peer=peer_id)
+        else:
+            self.logger.error(f"unknown msg type {type(msg)}")
+
+    async def _handle_timeout(self, ti: TimeoutInfo) -> None:
+        """Reference: state.go handleTimeout."""
+        rs = self.rs
+        if ti.height != rs.height or ti.round < rs.round or \
+                (ti.round == rs.round and ti.step < rs.step):
+            return
+        self.wal.write({"type": "timeout", "height": ti.height,
+                        "round": ti.round, "step": ti.step})
+        if ti.step == STEP_NEW_HEIGHT:
+            await self._enter_new_round(ti.height, 0)
+        elif ti.step == STEP_NEW_ROUND:
+            await self._enter_propose(ti.height, 0)
+        elif ti.step == STEP_PROPOSE:
+            self.event_bus.publish_timeout_propose(rs.event_summary())
+            await self._enter_prevote(ti.height, ti.round)
+        elif ti.step == STEP_PREVOTE_WAIT:
+            self.event_bus.publish_timeout_wait(rs.event_summary())
+            await self._enter_precommit(ti.height, ti.round)
+        elif ti.step == STEP_PRECOMMIT_WAIT:
+            self.event_bus.publish_timeout_wait(rs.event_summary())
+            await self._enter_precommit(ti.height, ti.round)
+            await self._enter_new_round(ti.height, ti.round + 1)
+
+    # ==================================================================
+    # state update
+
+    def update_to_state(self, state: SMState) -> None:
+        """Reference: state.go updateToState (:660)."""
+        rs = self.rs
+        if rs.commit_round > -1 and 0 < rs.height and \
+                rs.height != state.last_block_height:
+            raise ConsensusError(
+                f"updateToState expected state height {rs.height} but "
+                f"got {state.last_block_height}")
+        if self.sm_state is not None and not self.sm_state.is_empty():
+            if self.sm_state.last_block_height > 0 and \
+                    state.last_block_height <= \
+                    self.sm_state.last_block_height:
+                self._new_step()
+                return
+
+        validators = state.validators
+        if state.last_block_height == 0:
+            rs.last_commit = None
+        elif rs.commit_round > -1 and rs.votes is not None:
+            precommits = rs.votes.precommits(rs.commit_round)
+            if not precommits.has_two_thirds_majority():
+                raise ConsensusError(
+                    "wanted to form a commit but precommits lack 2/3+")
+            rs.last_commit = precommits
+        elif rs.last_commit is None:
+            raise ConsensusError(
+                f"last commit cannot be empty after initial block "
+                f"(H:{state.last_block_height + 1})")
+
+        height = state.last_block_height + 1
+        if height == 1:
+            height = state.initial_height
+
+        rs.height = height
+        rs.round = 0
+        rs.step = STEP_NEW_HEIGHT
+
+        next_block_delay = state.next_block_delay_ns
+        if next_block_delay == 0:
+            next_block_delay = self.config.timeout_commit_ns
+        if rs.commit_time.is_zero():
+            rs.start_time = Timestamp.now().add_ns(next_block_delay)
+        else:
+            rs.start_time = rs.commit_time.add_ns(next_block_delay)
+
+        rs.validators = validators
+        rs.proposal = None
+        rs.proposal_receive_time = Timestamp.zero()
+        rs.proposal_block = None
+        rs.proposal_block_parts = None
+        rs.locked_round = -1
+        rs.locked_block = None
+        rs.locked_block_parts = None
+        rs.valid_round = -1
+        rs.valid_block = None
+        rs.valid_block_parts = None
+        ext_enabled = state.consensus_params.feature \
+            .vote_extensions_enabled(height)
+        rs.votes = HeightVoteSet(state.chain_id, height, validators,
+                                 extensions_enabled=ext_enabled)
+        rs.commit_round = -1
+        rs.last_validators = state.last_validators
+        rs.triggered_timeout_precommit = False
+        self.sm_state = state
+        self._new_step()
+
+    def _reconstruct_last_commit_if_needed(self, state: SMState) -> None:
+        """Rebuild LastCommit from the stored seen commit on restart
+        (reference: state.go reconstructLastCommit :602)."""
+        if state.last_block_height == 0 or self.rs.last_commit is not None:
+            return
+        ext_enabled = state.consensus_params.feature \
+            .vote_extensions_enabled(state.last_block_height)
+        if ext_enabled:
+            ec = self.block_store.load_block_ext_commit(
+                state.last_block_height)
+            if ec is None:
+                raise ConsensusError(
+                    f"failed to reconstruct last extended commit; commit "
+                    f"for height {state.last_block_height} not found")
+            self.rs.last_commit = self._vote_set_from_extended_commit(
+                state, ec)
+        else:
+            sc = self.block_store.load_seen_commit(
+                state.last_block_height)
+            if sc is None:
+                raise ConsensusError(
+                    f"failed to reconstruct last commit; seen commit for "
+                    f"height {state.last_block_height} not found")
+            self.rs.last_commit = self._vote_set_from_commit(state, sc)
+
+    def _vote_set_from_commit(self, state: SMState,
+                              commit: Commit) -> VoteSet:
+        """Reference: types Commit.ToVoteSet."""
+        try:
+            vals = self.block_exec.store.load_validators(commit.height)
+        except Exception:
+            vals = state.last_validators
+        vs = VoteSet(state.chain_id, commit.height, commit.round,
+                     canonical.PRECOMMIT_TYPE, vals)
+        for i, cs in enumerate(commit.signatures):
+            if cs.absent_flag():
+                continue
+            vs.add_vote(commit.get_vote(i))
+        return vs
+
+    def _vote_set_from_extended_commit(self, state: SMState,
+                                       ec: ExtendedCommit) -> VoteSet:
+        vals = self.block_exec.store.load_validators(ec.height)
+        vs = VoteSet.extended(state.chain_id, ec.height, ec.round,
+                              canonical.PRECOMMIT_TYPE, vals)
+        for i, ecs in enumerate(ec.extended_signatures):
+            if ecs.absent_flag():
+                continue
+            vs.add_vote(ec.get_extended_vote(i))
+        return vs
+
+    def _new_step(self) -> None:
+        self.wal.write({"type": "round_state",
+                        **self.rs.event_summary()})
+        self.n_steps += 1
+        self.event_bus.publish_new_round_step(self.rs.event_summary())
+        for hook in self.on_new_step:
+            hook(self.rs)
+
+    # ==================================================================
+    # timeouts / round scheduling
+
+    def _schedule_round0(self) -> None:
+        sleep_ns = max(0, self.rs.start_time.sub(Timestamp.now()))
+        self._schedule_timeout(sleep_ns, self.rs.height, 0,
+                               STEP_NEW_HEIGHT)
+
+    def _schedule_timeout(self, duration_ns: int, height: int,
+                          round_: int, step: int) -> None:
+        self.ticker.schedule_timeout(
+            TimeoutInfo(duration_ns, height, round_, step))
+
+    # ==================================================================
+    # step: NewRound
+
+    async def _enter_new_round(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step != STEP_NEW_HEIGHT):
+            return
+        validators = rs.validators
+        if rs.round < round_:
+            validators = validators.copy()
+            validators.increment_proposer_priority(round_ - rs.round)
+        rs.round = round_
+        rs.step = STEP_NEW_ROUND
+        rs.validators = validators
+        if round_ != 0:
+            rs.proposal = None
+            rs.proposal_receive_time = Timestamp.zero()
+            rs.proposal_block = None
+            rs.proposal_block_parts = None
+        rs.votes.set_round(round_ + 1)  # track next round too
+        rs.triggered_timeout_precommit = False
+        self.event_bus.publish_new_round(rs.event_summary())
+        await self._enter_propose(height, round_)
+
+    # ==================================================================
+    # step: Propose
+
+    def _is_proposer(self, address: bytes) -> bool:
+        return self.rs.validators.get_proposer().address == address
+
+    async def _enter_propose(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PROPOSE):
+            return
+
+        async def done() -> None:
+            rs.round = round_
+            rs.step = STEP_PROPOSE
+            self._new_step()
+            if self._is_proposal_complete():
+                await self._enter_prevote(height, rs.round)
+
+        self._schedule_timeout(
+            self.config.propose_timeout_ns(round_), height, round_,
+            STEP_PROPOSE)
+
+        if self.priv_validator is None or \
+                self.priv_validator_pub_key is None:
+            await done()
+            return
+        addr = self.priv_validator_pub_key.address()
+        if not rs.validators.has_address(addr):
+            await done()
+            return
+        if self._is_proposer(addr):
+            if self.decide_proposal_override is not None:
+                self.decide_proposal_override(height, round_)
+            else:
+                await self._decide_proposal(height, round_)
+        await done()
+
+    async def _decide_proposal(self, height: int, round_: int) -> None:
+        """Reference: defaultDecideProposal."""
+        rs = self.rs
+        if rs.valid_block is not None:
+            block, block_parts = rs.valid_block, rs.valid_block_parts
+        else:
+            block = await self._create_proposal_block()
+            if block is None:
+                return
+            block_parts = block.make_part_set(BLOCK_PART_SIZE_BYTES)
+
+        self.wal.flush_and_sync()
+        prop_block_id = BlockID(hash=block.hash(),
+                                part_set_header=block_parts.header())
+        proposal = Proposal(
+            height=height, round=round_, pol_round=rs.valid_round,
+            block_id=prop_block_id, timestamp=block.header.time)
+        try:
+            self.priv_validator.sign_proposal(self.sm_state.chain_id,
+                                              proposal)
+        except Exception as e:
+            if not self.replay_mode:
+                self.logger.error("failed signing proposal",
+                                  height=height, err=str(e))
+            return
+        self.send_internal(ProposalMessage(proposal))
+        for i in range(block_parts.total):
+            self.send_internal(BlockPartMessage(
+                height=rs.height, round=rs.round,
+                part=block_parts.get_part(i)))
+        self._broadcast(ProposalMessage(proposal))
+        for i in range(block_parts.total):
+            self._broadcast(BlockPartMessage(
+                height=rs.height, round=rs.round,
+                part=block_parts.get_part(i)))
+
+    async def _create_proposal_block(self) -> Optional[Block]:
+        """Reference: createProposalBlock (sync wrapper over the async
+        executor call — the receive routine runs in the loop, so the
+        ABCI local client call is executed inline)."""
+        rs = self.rs
+        if rs.height == self.sm_state.initial_height:
+            last_ext_commit = ExtendedCommit()
+        elif rs.last_commit is not None and \
+                rs.last_commit.has_two_thirds_majority():
+            last_ext_commit = rs.last_commit.make_extended_commit(
+                self.sm_state.consensus_params.feature
+                .vote_extensions_enable_height)
+        else:
+            self.logger.error(
+                "propose step; cannot propose anything without commit "
+                "for the previous block")
+            return None
+        proposer_addr = self.priv_validator_pub_key.address()
+        try:
+            return await self.block_exec.create_proposal_block(
+                rs.height, self.sm_state, last_ext_commit,
+                proposer_addr)
+        except Exception as e:
+            self.logger.error("unable to create proposal block",
+                              err=str(e))
+            return None
+
+    def _is_proposal_complete(self) -> bool:
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            return False
+        if rs.proposal.pol_round < 0:
+            return True
+        pv = rs.votes.prevotes(rs.proposal.pol_round)
+        return pv is not None and pv.has_two_thirds_majority()
+
+    # ==================================================================
+    # proposal / block part ingestion
+
+    def _set_proposal(self, proposal: Proposal,
+                      recv_time: Timestamp) -> None:
+        """Reference: defaultSetProposal (:2048)."""
+        rs = self.rs
+        if rs.proposal is not None or proposal is None:
+            return
+        if proposal.height != rs.height or proposal.round != rs.round:
+            return
+        if proposal.pol_round < -1 or \
+                (proposal.pol_round >= 0 and
+                 proposal.pol_round >= proposal.round):
+            raise ConsensusError("invalid proposal POL round")
+        proposer = rs.validators.get_proposer()
+        if not proposer.pub_key.verify_signature(
+                proposal.sign_bytes(self.sm_state.chain_id),
+                proposal.signature):
+            raise ConsensusError("invalid proposal signature")
+        max_bytes = self.sm_state.consensus_params.block.max_bytes
+        if max_bytes == -1:
+            max_bytes = MAX_BLOCK_SIZE_BYTES
+        if proposal.block_id.part_set_header.total > \
+                (max_bytes - 1) // BLOCK_PART_SIZE_BYTES + 1:
+            raise ConsensusError("proposal has too many parts")
+
+        rs.proposal = proposal
+        rs.proposal_receive_time = recv_time
+        if rs.proposal_block_parts is None:
+            rs.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header)
+        self.logger.info("Received proposal", proposal=str(proposal))
+
+    async def _add_proposal_block_part(self, msg: BlockPartMessage,
+                                 peer_id: str) -> bool:
+        """Reference: addProposalBlockPart (:2129)."""
+        rs = self.rs
+        if rs.height != msg.height:
+            return False
+        if rs.proposal_block_parts is None:
+            return False
+        added = rs.proposal_block_parts.add_part(msg.part)
+        if not added:
+            return False
+        max_bytes = self.sm_state.consensus_params.block.max_bytes
+        if max_bytes == -1:
+            max_bytes = MAX_BLOCK_SIZE_BYTES
+        if rs.proposal_block_parts.byte_size > max_bytes:
+            raise ConsensusError(
+                "total size of proposal block parts exceeds block max "
+                f"bytes ({rs.proposal_block_parts.byte_size} > "
+                f"{max_bytes})")
+        if rs.proposal_block_parts.is_complete():
+            raw = rs.proposal_block_parts.assemble()
+            rs.proposal_block = Block.from_proto(decode(pb.BLOCK, raw))
+            self.logger.info(
+                "Received complete proposal block",
+                height=rs.proposal_block.header.height,
+                hash=rs.proposal_block.hash().hex().upper()[:12])
+            self.event_bus.publish_complete_proposal(rs.event_summary())
+            await self._handle_complete_proposal(msg.height)
+        return added
+
+    async def _handle_complete_proposal(self, height: int) -> None:
+        """Reference: handleCompleteProposal (:2217)."""
+        rs = self.rs
+        prevotes = rs.votes.prevotes(rs.round)
+        block_id, has_two_thirds = prevotes.two_thirds_majority()
+        if has_two_thirds and not block_id.is_nil() and \
+                rs.valid_round < rs.round:
+            if rs.proposal_block.hash() == block_id.hash:
+                rs.valid_round = rs.round
+                rs.valid_block = rs.proposal_block
+                rs.valid_block_parts = rs.proposal_block_parts
+        if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
+            await self._enter_prevote(height, rs.round)
+            if has_two_thirds:
+                await self._enter_precommit(height, rs.round)
+        elif rs.step == STEP_COMMIT:
+            await self._try_finalize_commit(height)
+
+    # ==================================================================
+    # step: Prevote
+
+    async def _enter_prevote(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE):
+            return
+        await self._do_prevote(height, round_)
+        rs.round = round_
+        rs.step = STEP_PREVOTE
+        self._new_step()
+
+    async def _do_prevote(self, height: int, round_: int) -> None:
+        """Reference: defaultDoPrevote (:1387)."""
+        rs = self.rs
+        if rs.proposal is None or rs.proposal_block is None:
+            await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                PartSetHeader())
+            return
+
+        block_hash = rs.proposal_block.hash()
+        psh = rs.proposal_block_parts.header()
+
+        if rs.proposal.pol_round == -1:
+            if rs.locked_round == -1:
+                if rs.valid_round != -1 and rs.valid_block is not None \
+                        and block_hash == rs.valid_block.hash():
+                    await self._sign_add_vote(canonical.PREVOTE_TYPE,
+                                        block_hash, psh)
+                    return
+                # PBTS timeliness
+                if self._pbts_enabled(height):
+                    if rs.proposal.timestamp != \
+                            rs.proposal_block.header.time:
+                        await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                            PartSetHeader())
+                        return
+                    sp = self.sm_state.consensus_params.synchrony \
+                        .in_round(rs.proposal.round)
+                    if not rs.proposal.is_timely(
+                            rs.proposal_receive_time, sp):
+                        self.logger.info(
+                            "Prevote step: proposal not timely; "
+                            "prevoting nil")
+                        await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                            PartSetHeader())
+                        return
+                try:
+                    self.block_exec.validate_block(self.sm_state,
+                                                   rs.proposal_block)
+                except BlockValidationError as e:
+                    self.logger.error(
+                        "prevote step: invalid block; prevoting nil",
+                        err=str(e))
+                    await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                        PartSetHeader())
+                    return
+                is_app_valid = await self.block_exec.process_proposal(
+                    rs.proposal_block, self.sm_state)
+                if not is_app_valid:
+                    self.logger.error(
+                        "prevote step: app rejected proposal; "
+                        "prevoting nil")
+                    await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                        PartSetHeader())
+                    return
+                await self._sign_add_vote(canonical.PREVOTE_TYPE, block_hash,
+                                    psh)
+                return
+            if rs.locked_block is not None and \
+                    block_hash == rs.locked_block.hash():
+                await self._sign_add_vote(canonical.PREVOTE_TYPE, block_hash,
+                                    psh)
+                return
+            await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                                PartSetHeader())
+            return
+
+        # POLRound >= 0
+        pv = rs.votes.prevotes(rs.proposal.pol_round)
+        block_id, ok = (pv.two_thirds_majority() if pv is not None
+                        else (BlockID(), False))
+        ok = ok and not block_id.is_nil()
+        if ok and block_hash == block_id.hash and \
+                rs.proposal.pol_round < rs.round:
+            if rs.locked_round < rs.proposal.pol_round:
+                await self._sign_add_vote(canonical.PREVOTE_TYPE, block_hash,
+                                    psh)
+                return
+            if rs.locked_block is not None and \
+                    block_hash == rs.locked_block.hash():
+                await self._sign_add_vote(canonical.PREVOTE_TYPE, block_hash,
+                                    psh)
+                return
+            if rs.locked_round == rs.proposal.pol_round:
+                await self._sign_add_vote(canonical.PREVOTE_TYPE, block_hash,
+                                    psh)
+                return
+        await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
+                            PartSetHeader())
+
+    async def _enter_prevote_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PREVOTE_WAIT):
+            return
+        if not rs.votes.prevotes(round_).has_two_thirds_any():
+            raise ConsensusError(
+                "entering prevote wait without any +2/3 prevotes")
+        rs.round = round_
+        rs.step = STEP_PREVOTE_WAIT
+        self._new_step()
+        self._schedule_timeout(self.config.prevote_timeout_ns(round_),
+                               height, round_, STEP_PREVOTE_WAIT)
+
+    # ==================================================================
+    # step: Precommit
+
+    async def _enter_precommit(self, height: int, round_: int) -> None:
+        """Reference: enterPrecommit (:1609)."""
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.step >= STEP_PRECOMMIT):
+            return
+
+        def done() -> None:
+            rs.round = round_
+            rs.step = STEP_PRECOMMIT
+            self._new_step()
+
+        block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
+        if not ok:
+            await self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"",
+                                PartSetHeader())
+            done()
+            return
+
+        self.event_bus.publish_polka(rs.event_summary())
+
+        if block_id.is_nil():
+            await self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"",
+                                PartSetHeader())
+            done()
+            return
+
+        # +2/3 prevoted a block
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == block_id.hash:
+            rs.locked_round = round_
+            self.event_bus.publish_relock(rs.event_summary())
+            await self._sign_add_vote(canonical.PRECOMMIT_TYPE, block_id.hash,
+                                block_id.part_set_header,
+                                block=rs.locked_block)
+            done()
+            return
+
+        if rs.proposal_block is not None and \
+                rs.proposal_block.hash() == block_id.hash:
+            try:
+                self.block_exec.validate_block(self.sm_state,
+                                               rs.proposal_block)
+            except BlockValidationError as e:
+                raise ConsensusError(
+                    f"+2/3 prevoted for an invalid block: {e}") from e
+            rs.locked_round = round_
+            rs.locked_block = rs.proposal_block
+            rs.locked_block_parts = rs.proposal_block_parts
+            self.event_bus.publish_lock(rs.event_summary())
+            await self._sign_add_vote(canonical.PRECOMMIT_TYPE, block_id.hash,
+                                block_id.part_set_header,
+                                block=rs.proposal_block)
+            done()
+            return
+
+        # polka for a block we don't have: fetch it, precommit nil
+        if rs.proposal_block_parts is None or \
+                not rs.proposal_block_parts.has_header(
+                    block_id.part_set_header):
+            rs.proposal_block = None
+            rs.proposal_block_parts = PartSet(block_id.part_set_header)
+        await self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"",
+                            PartSetHeader())
+        done()
+
+    async def _enter_precommit_wait(self, height: int, round_: int) -> None:
+        rs = self.rs
+        if rs.height != height or round_ < rs.round or \
+                (rs.round == round_ and rs.triggered_timeout_precommit):
+            return
+        if not rs.votes.precommits(round_).has_two_thirds_any():
+            raise ConsensusError(
+                "entering precommit wait without any +2/3 precommits")
+        rs.triggered_timeout_precommit = True
+        self._new_step()
+        self._schedule_timeout(self.config.precommit_timeout_ns(round_),
+                               height, round_, STEP_PRECOMMIT_WAIT)
+
+    # ==================================================================
+    # step: Commit
+
+    async def _enter_commit(self, height: int, commit_round: int) -> None:
+        """Reference: enterCommit (:1743)."""
+        rs = self.rs
+        if rs.height != height or rs.step >= STEP_COMMIT:
+            return
+
+        block_id, ok = rs.votes.precommits(commit_round) \
+            .two_thirds_majority()
+        if not ok or block_id.is_nil():
+            raise ConsensusError("enterCommit expects +2/3 precommits")
+
+        rs.step = STEP_COMMIT
+        rs.commit_round = commit_round
+        rs.commit_time = Timestamp.now()
+        self._new_step()
+
+        if rs.locked_block is not None and \
+                rs.locked_block.hash() == block_id.hash:
+            rs.proposal_block = rs.locked_block
+            rs.proposal_block_parts = rs.locked_block_parts
+
+        if rs.proposal_block is None or \
+                rs.proposal_block.hash() != block_id.hash:
+            if rs.proposal_block_parts is None or \
+                    not rs.proposal_block_parts.has_header(
+                        block_id.part_set_header):
+                rs.proposal_block = None
+                rs.proposal_block_parts = PartSet(
+                    block_id.part_set_header)
+                self.event_bus.publish_valid_block(rs.event_summary())
+
+        await self._try_finalize_commit(height)
+
+    async def _try_finalize_commit(self, height: int) -> None:
+        rs = self.rs
+        if rs.height != height:
+            raise ConsensusError("tryFinalizeCommit height mismatch")
+        block_id, ok = rs.votes.precommits(rs.commit_round) \
+            .two_thirds_majority()
+        if not ok or block_id.is_nil():
+            return
+        if rs.proposal_block is None or \
+                rs.proposal_block.hash() != block_id.hash:
+            return
+        await self._finalize_commit(height)
+
+    async def _finalize_commit(self, height: int) -> None:
+        """Reference: finalizeCommit (:1834) — validate, save with seen
+        commit, WAL EndHeight fsync barrier, ApplyBlock, updateToState,
+        schedule round 0."""
+        rs = self.rs
+        if rs.height != height or rs.step != STEP_COMMIT:
+            return
+        block_id, ok = rs.votes.precommits(rs.commit_round) \
+            .two_thirds_majority()
+        block, block_parts = rs.proposal_block, rs.proposal_block_parts
+        if not ok:
+            raise ConsensusError("cannot finalize; no 2/3 majority")
+        if not block_parts.has_header(block_id.part_set_header):
+            raise ConsensusError("proposal parts header != commit header")
+        if block.hash() != block_id.hash:
+            raise ConsensusError("proposal block != commit hash")
+        self.block_exec.validate_block(self.sm_state, block)
+
+        self.logger.info("Finalizing commit of block",
+                         height=height,
+                         hash=block.hash().hex().upper()[:12],
+                         num_txs=len(block.data.txs))
+
+        if self.block_store.height < block.header.height:
+            seen_ext = rs.votes.precommits(rs.commit_round) \
+                .make_extended_commit(
+                    self.sm_state.consensus_params.feature
+                    .vote_extensions_enable_height)
+            if self.sm_state.consensus_params.feature \
+                    .vote_extensions_enabled(block.header.height):
+                self.block_store.save_block_with_extended_commit(
+                    block, block_parts, seen_ext)
+            else:
+                self.block_store.save_block(block, block_parts,
+                                            seen_ext.to_commit())
+
+        # fsync'd end-of-height barrier BEFORE ApplyBlock: on crash,
+        # replay/handshake re-applies the block
+        self.wal.write_end_height(height)
+
+        state_copy = self.sm_state.copy()
+        state_copy = await self.block_exec.apply_verified_block(
+            state_copy,
+            BlockID(hash=block.hash(),
+                    part_set_header=block_parts.header()),
+            block, block.header.height)
+
+        self.update_to_state(state_copy)
+        if self.priv_validator is not None:
+            self.priv_validator_pub_key = \
+                self.priv_validator.get_pub_key()
+        self._schedule_round0()
+
+    # ==================================================================
+    # votes
+
+    async def _try_add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference: tryAddVote (:2253) — turns conflicting votes into
+        evidence."""
+        try:
+            return await self._add_vote(vote, peer_id)
+        except ConflictingVoteError as e:
+            if self.priv_validator_pub_key is not None and \
+                    vote.validator_address == \
+                    self.priv_validator_pub_key.address():
+                self.logger.error(
+                    "found conflicting vote from ourselves; "
+                    "did you unsafe_reset a validator?",
+                    height=vote.height, round=vote.round)
+                return False
+            if self.block_exec.evpool is not None and \
+                    hasattr(self.block_exec.evpool,
+                            "report_conflicting_votes"):
+                self.block_exec.evpool.report_conflicting_votes(
+                    e.vote_a, e.vote_b)
+            self.logger.info("found and sent conflicting vote to evpool",
+                             height=vote.height)
+            return False
+
+    async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
+        """Reference: addVote (:2299)."""
+        rs = self.rs
+
+        # precommit for the previous height (arrives during commit wait)
+        if vote.height + 1 == rs.height and \
+                vote.type == canonical.PRECOMMIT_TYPE:
+            if rs.step != STEP_NEW_HEIGHT:
+                return False
+            if rs.last_commit is None:
+                return False
+            added = rs.last_commit.add_vote(vote)
+            if not added:
+                return False
+            self.event_bus.publish_vote(vote)
+            skip = (self.sm_state.next_block_delay_ns == 0 and
+                    self.config.timeout_commit_ns == 0)
+            if skip and rs.last_commit.has_all():
+                await self._enter_new_round(rs.height, 0)
+            return added
+
+        if vote.height != rs.height:
+            return False
+
+        ext_enabled = self.sm_state.consensus_params.feature \
+            .vote_extensions_enabled(vote.height)
+        if ext_enabled:
+            my_addr = self.priv_validator_pub_key.address() \
+                if self.priv_validator_pub_key else b""
+            if vote.type == canonical.PRECOMMIT_TYPE and \
+                    not vote.block_id.is_nil() and \
+                    vote.validator_address != my_addr:
+                _, val = self.sm_state.validators.get_by_index(
+                    vote.validator_index)
+                if val is None:
+                    raise VoteSetError(
+                        f"validator index {vote.validator_index} out of "
+                        f"bounds")
+                vote.verify_extension(self.sm_state.chain_id,
+                                      val.pub_key)
+                ok = await self.block_exec.verify_vote_extension(vote)
+                if not ok:
+                    raise VoteSetError("invalid vote extension")
+        elif vote.extension or vote.extension_signature or \
+                vote.non_rp_extension or vote.non_rp_extension_signature:
+            raise VoteSetError(
+                "received vote with extension while extensions are "
+                "disabled")
+
+        height = rs.height
+        added = rs.votes.add_vote(vote, peer_id)
+        if not added:
+            return False
+        self.event_bus.publish_vote(vote)
+        self._broadcast(("has_vote", vote))
+
+        if vote.type == canonical.PREVOTE_TYPE:
+            prevotes = rs.votes.prevotes(vote.round)
+            block_id, ok = prevotes.two_thirds_majority()
+            if ok and not block_id.is_nil():
+                # update valid block
+                if rs.valid_round < vote.round and \
+                        vote.round == rs.round:
+                    if rs.proposal_block is not None and \
+                            rs.proposal_block.hash() == block_id.hash:
+                        rs.valid_round = vote.round
+                        rs.valid_block = rs.proposal_block
+                        rs.valid_block_parts = rs.proposal_block_parts
+                    else:
+                        rs.proposal_block = None
+                    if rs.proposal_block_parts is None or \
+                            not rs.proposal_block_parts.has_header(
+                                block_id.part_set_header):
+                        rs.proposal_block_parts = PartSet(
+                            block_id.part_set_header)
+                    self.event_bus.publish_valid_block(
+                        rs.event_summary())
+            if rs.round < vote.round and prevotes.has_two_thirds_any():
+                await self._enter_new_round(height, vote.round)
+            elif rs.round == vote.round and rs.step >= STEP_PREVOTE:
+                block_id, ok = prevotes.two_thirds_majority()
+                if ok and (self._is_proposal_complete() or
+                           block_id.is_nil()):
+                    await self._enter_precommit(height, vote.round)
+                elif prevotes.has_two_thirds_any():
+                    await self._enter_prevote_wait(height, vote.round)
+            elif rs.proposal is not None and \
+                    0 <= rs.proposal.pol_round == vote.round:
+                if self._is_proposal_complete():
+                    await self._enter_prevote(height, rs.round)
+
+        elif vote.type == canonical.PRECOMMIT_TYPE:
+            precommits = rs.votes.precommits(vote.round)
+            block_id, ok = precommits.two_thirds_majority()
+            if ok:
+                await self._enter_new_round(height, vote.round)
+                await self._enter_precommit(height, vote.round)
+                if not block_id.is_nil():
+                    await self._enter_commit(height, vote.round)
+                    skip = (self.sm_state.next_block_delay_ns == 0 and
+                            self.config.timeout_commit_ns == 0)
+                    if skip and precommits.has_all():
+                        await self._enter_new_round(rs.height, 0)
+                else:
+                    await self._enter_precommit_wait(height, vote.round)
+            elif rs.round <= vote.round and \
+                    precommits.has_two_thirds_any():
+                await self._enter_new_round(height, vote.round)
+                await self._enter_precommit_wait(height, vote.round)
+        else:
+            raise ConsensusError(f"unexpected vote type {vote.type}")
+        return True
+
+    # ==================================================================
+    # vote signing
+
+    def _vote_time(self, height: int) -> Timestamp:
+        """Reference: voteTime (:2578) — BFT time floor unless PBTS."""
+        if self._pbts_enabled(height):
+            return Timestamp.now()
+        now = Timestamp.now()
+        min_vote_time = now
+        rs = self.rs
+        if rs.locked_block is not None:
+            min_vote_time = rs.locked_block.header.time.add_ns(
+                _TIME_IOTA_NS)
+        elif rs.proposal_block is not None:
+            min_vote_time = rs.proposal_block.header.time.add_ns(
+                _TIME_IOTA_NS)
+        return now if now.unix_ns() > min_vote_time.unix_ns() \
+            else min_vote_time
+
+    def _pbts_enabled(self, height: int) -> bool:
+        return self.sm_state.consensus_params.feature.pbts_enabled(
+            height)
+
+    async def _sign_vote(self, msg_type: int, hash_: bytes,
+                   psh: PartSetHeader,
+                   block: Optional[Block]) -> Optional[Vote]:
+        """Reference: signVote (:2526)."""
+        self.wal.flush_and_sync()
+        rs = self.rs
+        addr = self.priv_validator_pub_key.address()
+        val_idx, _ = rs.validators.get_by_address(addr)
+        vote = Vote(
+            type=msg_type,
+            height=rs.height,
+            round=rs.round,
+            block_id=BlockID(hash=hash_, part_set_header=psh),
+            timestamp=self._vote_time(rs.height),
+            validator_address=addr,
+            validator_index=val_idx,
+        )
+        ext_enabled = self.sm_state.consensus_params.feature \
+            .vote_extensions_enabled(vote.height)
+        sign_ext = False
+        if msg_type == canonical.PRECOMMIT_TYPE and \
+                not vote.block_id.is_nil():
+            if ext_enabled:
+                if block is None:
+                    raise ConsensusError(
+                        "need block to extend a non-nil precommit")
+                ext, non_rp_ext = await self.block_exec.extend_vote(
+                    vote, block, self.sm_state)
+                vote.extension = ext
+                vote.non_rp_extension = non_rp_ext
+                sign_ext = True
+        try:
+            self.priv_validator.sign_vote(
+                self.sm_state.chain_id, vote, sign_extension=sign_ext)
+        except Exception as e:
+            self.logger.error("failed signing vote", err=str(e))
+            return None
+        return vote
+
+    async def _sign_add_vote(self, msg_type: int, hash_: bytes,
+                       psh: PartSetHeader,
+                       block: Optional[Block] = None) -> None:
+        """Reference: signAddVote (:2605)."""
+        if self.priv_validator is None or \
+                self.priv_validator_pub_key is None:
+            return
+        if not self.rs.validators.has_address(
+                self.priv_validator_pub_key.address()):
+            return
+        vote = await self._sign_vote(msg_type, hash_, psh, block)
+        if vote is None:
+            return
+        self.send_internal(VoteMessage(vote))
+        self._broadcast(VoteMessage(vote))
+
+    # ==================================================================
+    def _broadcast(self, msg) -> None:
+        for hook in self.broadcast_hooks:
+            try:
+                hook(msg)
+            except Exception:
+                self.logger.error("broadcast hook failed", exc_info=True)
+

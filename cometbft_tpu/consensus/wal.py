@@ -1,0 +1,137 @@
+"""Write-ahead log of consensus messages.
+
+Reference: internal/consensus/wal.go — CRC32C + length framed records via
+internal/autofile; WriteSync fsync barrier before height end;
+SearchForEndHeight for replay.  Record payloads here are canonical JSON
+(bytes hex-encoded) — WAL bytes are node-local, only durability and
+replayability matter.
+"""
+from __future__ import annotations
+
+import json
+import os
+import struct
+import zlib
+from typing import Iterator, Optional
+
+MAX_MSG_SIZE_BYTES = 1024 * 1024 * 2  # reference: wal.go maxMsgSizeBytes
+
+
+class WALError(Exception):
+    pass
+
+
+class CorruptWALError(WALError):
+    pass
+
+
+def _frame(payload: bytes) -> bytes:
+    crc = zlib.crc32(payload) & 0xFFFFFFFF
+    return struct.pack(">II", crc, len(payload)) + payload
+
+
+class WAL:
+    """Append-only message log with explicit fsync barriers."""
+
+    def __init__(self, path: str):
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        self._path = path
+        self._f = open(path, "ab")
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def write(self, msg: dict) -> None:
+        """Buffered append (reference: WAL.Write for peer messages)."""
+        payload = json.dumps(msg, separators=(",", ":"),
+                             sort_keys=True).encode()
+        if len(payload) > MAX_MSG_SIZE_BYTES:
+            raise WALError(f"msg is too big: {len(payload)} bytes")
+        self._f.write(_frame(payload))
+
+    def write_sync(self, msg: dict) -> None:
+        """Append + flush + fsync (reference: WAL.WriteSync — used before
+        signing our own messages and at height boundaries)."""
+        self.write(msg)
+        self.flush_and_sync()
+
+    def flush_and_sync(self) -> None:
+        self._f.flush()
+        os.fsync(self._f.fileno())
+
+    def write_end_height(self, height: int) -> None:
+        """The fsync'd end-of-height barrier (reference:
+        EndHeightMessage, state.go:1901-1911)."""
+        self.write_sync({"type": "end_height", "height": height})
+
+    def close(self) -> None:
+        try:
+            self.flush_and_sync()
+        except ValueError:
+            pass
+        self._f.close()
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def iter_messages(path: str, strict: bool = False) -> Iterator[dict]:
+        """Decode records; on a torn tail (crash mid-write) stop unless
+        strict."""
+        with open(path, "rb") as f:
+            data = f.read()
+        pos = 0
+        n = len(data)
+        while pos < n:
+            if n - pos < 8:
+                if strict:
+                    raise CorruptWALError("truncated frame header")
+                return
+            crc, length = struct.unpack(">II", data[pos:pos + 8])
+            if length > MAX_MSG_SIZE_BYTES:
+                raise CorruptWALError(f"frame too large: {length}")
+            if n - pos - 8 < length:
+                if strict:
+                    raise CorruptWALError("truncated frame payload")
+                return
+            payload = data[pos + 8:pos + 8 + length]
+            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+                raise CorruptWALError(f"crc mismatch at offset {pos}")
+            yield json.loads(payload)
+            pos += 8 + length
+
+    @staticmethod
+    def search_for_end_height(path: str, height: int
+                              ) -> Optional[list[dict]]:
+        """Messages AFTER the end-height marker for `height`, or None if
+        the marker is absent (reference: SearchForEndHeight)."""
+        if not os.path.exists(path):
+            return None
+        found = False
+        out: list[dict] = []
+        for msg in WAL.iter_messages(path):
+            if found:
+                out.append(msg)
+            elif msg.get("type") == "end_height" and \
+                    msg.get("height") == height:
+                found = True
+        return out if found else None
+
+
+class NilWAL:
+    """No-op WAL (reference: nilWAL)."""
+    path = ""
+
+    def write(self, msg: dict) -> None:
+        pass
+
+    def write_sync(self, msg: dict) -> None:
+        pass
+
+    def flush_and_sync(self) -> None:
+        pass
+
+    def write_end_height(self, height: int) -> None:
+        pass
+
+    def close(self) -> None:
+        pass

@@ -42,10 +42,15 @@ class InvalidBlockError(ExecutionError):
 
 
 def max_data_bytes(max_bytes: int, ev_size: int, n_vals: int) -> int:
-    """Reference: types/block.go MaxDataBytes."""
+    """Reference: types/block.go MaxDataBytes (panics when negative)."""
     commit_bytes = 4 + 10 + 76 + n_vals * _MAX_COMMIT_SIG_BYTES
-    return (max_bytes - _MAX_OVERHEAD_FOR_BLOCK - _MAX_HEADER_BYTES -
+    cap_ = (max_bytes - _MAX_OVERHEAD_FOR_BLOCK - _MAX_HEADER_BYTES -
             commit_bytes - ev_size)
+    if cap_ < 0:
+        raise ExecutionError(
+            f"negative MaxDataBytes: block.MaxBytes={max_bytes} is too "
+            f"small to fit a header plus a {n_vals}-validator commit")
+    return cap_
 
 
 def tx_results_hash(tx_results: list[abci.ExecTxResult]) -> bytes:

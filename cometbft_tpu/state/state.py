@@ -81,6 +81,16 @@ class State:
         return self.validators is None
 
     # ------------------------------------------------------------------
+    def block_time(self, height: int, last_commit: Commit) -> Timestamp:
+        """The consensus-mandated block time (reference: state.go
+        MakeBlock): genesis time at the initial height; now() under
+        PBTS; otherwise the BFT-time weighted median of LastCommit."""
+        if height == self.initial_height:
+            return self.last_block_time
+        if self.consensus_params.feature.pbts_enabled(height):
+            return Timestamp.now()
+        return last_commit.median_time(self.last_validators)
+
     def make_block(self, height: int, txs: list[bytes],
                    last_commit: Commit, evidence: list,
                    proposer_address: bytes,
@@ -95,7 +105,7 @@ class State:
                 chain_id=self.chain_id,
                 height=height,
                 time=block_time if block_time is not None
-                else Timestamp.now(),
+                else self.block_time(height, last_commit),
                 last_block_id=self.last_block_id,
                 validators_hash=self.validators.hash(),
                 next_validators_hash=self.next_validators.hash(),

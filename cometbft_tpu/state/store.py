@@ -103,8 +103,17 @@ class Store:
         self._db.set(_validators_key(height),
                      encode(state_pb.VALIDATORS_INFO, d))
 
+    @staticmethod
+    def _last_stored_height_for(height: int, last_changed: int) -> int:
+        """Reference: store.go lastStoredHeightFor — the nearest height
+        at which a FULL validator set exists: the later of the last
+        change height and the last checkpoint."""
+        checkpoint = height - height % VAL_SET_CHECKPOINT_INTERVAL
+        return max(checkpoint, last_changed)
+
     def load_validators(self, height: int) -> ValidatorSet:
-        """Reference: store.go LoadValidators with lookback."""
+        """Reference: store.go LoadValidators with checkpoint-aware
+        lookback."""
         raw = self._db.get(_validators_key(height))
         if raw is None:
             raise StateStoreError(
@@ -113,19 +122,21 @@ class Store:
         if info.get("validator_set") is not None:
             return ValidatorSet.from_proto(info["validator_set"])
         last_changed = info.get("last_height_changed", 0)
-        raw2 = self._db.get(_validators_key(last_changed))
+        stored_height = self._last_stored_height_for(height, last_changed)
+        raw2 = self._db.get(_validators_key(stored_height))
         if raw2 is None:
             raise StateStoreError(
-                f"validator lookback to {last_changed} failed "
+                f"validator lookback to {stored_height} failed "
                 f"for height {height}")
         info2 = decode(state_pb.VALIDATORS_INFO, raw2)
         if info2.get("validator_set") is None:
             raise StateStoreError(
-                f"validator set at change-height {last_changed} is empty")
+                f"validator set at lookback height {stored_height} "
+                f"is empty")
         vals = ValidatorSet.from_proto(info2["validator_set"])
         # roll priorities forward to the requested height
-        if height > last_changed:
-            vals.increment_proposer_priority(height - last_changed)
+        if height > stored_height:
+            vals.increment_proposer_priority(height - stored_height)
         return vals
 
     # ------------------------------------------------------------------
@@ -187,31 +198,36 @@ class Store:
         lookback targets are deleted); returns number pruned."""
         if from_height <= 0 or to_height <= from_height:
             return 0
-        # materialize full records at the heights that survive, so their
-        # lookback pointers cannot dangle after deletion
+        # heights whose FULL validator records must survive: the lookback
+        # targets of to_height and of the evidence threshold (reference:
+        # store.go PruneStates keepVals)
+        keep_val_heights: set[int] = set()
         for keep in {to_height, evidence_threshold_height}:
-            if keep < from_height:
+            if keep <= 0:
                 continue
-            try:
-                vals = self.load_validators(keep)
-                self._save_validators(keep, vals, keep)
-            except StateStoreError:
-                pass
-            if keep == to_height:
-                try:
-                    params = self.load_consensus_params(keep)
-                    self._db.set(
-                        _params_key(keep),
-                        encode(state_pb.CONSENSUS_PARAMS_INFO,
-                               {"last_height_changed": keep,
-                                "consensus_params": params.to_proto()}))
-                except StateStoreError:
-                    pass
+            raw = self._db.get(_validators_key(keep))
+            if raw is None:
+                continue
+            info = decode(state_pb.VALIDATORS_INFO, raw)
+            if info.get("validator_set") is None:
+                keep_val_heights.add(self._last_stored_height_for(
+                    keep, info.get("last_height_changed", 0)))
+        # materialize params at to_height so its pointer cannot dangle
+        try:
+            params = self.load_consensus_params(to_height)
+            self._db.set(
+                _params_key(to_height),
+                encode(state_pb.CONSENSUS_PARAMS_INFO,
+                       {"last_height_changed": to_height,
+                        "consensus_params": params.to_proto()}))
+        except StateStoreError:
+            pass
         pruned = 0
         batch = self._db.new_batch()
         for h in range(from_height, to_height):
             batch.delete(_abci_responses_key(h))
-            if h < evidence_threshold_height:
+            if h < evidence_threshold_height and \
+                    h not in keep_val_heights:
                 batch.delete(_validators_key(h))
             batch.delete(_params_key(h))
             pruned += 1

@@ -80,6 +80,13 @@ def validate_block(state: State, block: Block) -> None:
             raise BlockValidationError(
                 f"invalid LastCommit: {e}") from e
 
+    # block time rules (reference: validation.go — BFT time requires the
+    # exact weighted median of LastCommit; PBTS requires monotonicity,
+    # with timeliness checked at prevote time)
+    validate_block_time(
+        state, block,
+        state.consensus_params.feature.pbts_enabled(h.height))
+
     # evidence size cap (reference: validation.go:137 ErrEvidenceOverflow)
     max_ev_bytes = state.consensus_params.evidence.max_bytes
     ev_bytes = _evidence_byte_size(block.evidence)

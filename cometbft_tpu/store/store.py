@@ -226,16 +226,16 @@ class BlockStore:
             batch = self._db.new_batch()
             for h in range(self._base, retain_height):
                 meta = self.load_block_meta(h)
-                if meta is None:
-                    continue
-                batch.delete(_meta_key(h))
-                batch.delete(_hash_key(meta.block_id.hash))
-                for i in range(meta.block_id.part_set_header.total):
-                    batch.delete(_part_key(h, i))
-                batch.delete(_commit_key(h - 1))
+                if meta is not None:
+                    batch.delete(_meta_key(h))
+                    batch.delete(_hash_key(meta.block_id.hash))
+                    for i in range(meta.block_id.part_set_header.total):
+                        batch.delete(_part_key(h, i))
+                    pruned += 1
+                # _commit_key(h) holds the canonical commit FOR block h
+                batch.delete(_commit_key(h))
                 batch.delete(_seen_commit_key(h))
                 batch.delete(_ext_commit_key(h))
-                pruned += 1
             self._base = retain_height
             self._save_store_state(batch)
             batch.write()
@@ -255,7 +255,9 @@ class BlockStore:
                 for i in range(meta.block_id.part_set_header.total):
                     batch.delete(_part_key(h, i))
             batch.delete(_meta_key(h))
-            batch.delete(_commit_key(h - 1))
+            # the canonical commit FOR h (stored when h+1 was saved, so
+            # normally absent for the head; deleted defensively)
+            batch.delete(_commit_key(h))
             batch.delete(_seen_commit_key(h))
             batch.delete(_ext_commit_key(h))
             self._height = h - 1

@@ -1,0 +1,190 @@
+"""Crash/replay tests: ABCI handshake reconciliation and WAL catchup.
+
+Reference test model: internal/consensus/replay_test.go (crash at every
+boundary, restart, verify chain continues).
+"""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as test_config  # noqa
+from cometbft_tpu.consensus.replay import Handshaker, catchup_replay
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.consensus.wal import WAL
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.db import MemDB, SQLiteDB
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _genesis(n=1):
+    pvs = [new_mock_pv() for _ in range(n)]
+    doc = GenesisDoc(
+        chain_id="replay-test",
+        genesis_time=Timestamp(1700000000, 0),
+        validators=[GenesisValidator(address=b"",
+                                     pub_key=pv.get_pub_key(), power=10)
+                    for pv in pvs],
+    )
+    return doc, pvs
+
+
+async def _wait_height(bs, h, timeout=20.0):
+    async def waiter():
+        while bs.height < h:
+            await asyncio.sleep(0.01)
+    await asyncio.wait_for(waiter(), timeout)
+
+
+class TestHandshake:
+    def test_genesis_handshake_calls_init_chain(self):
+        async def go():
+            doc, pvs = _genesis()
+            state = make_genesis_state(doc)
+            app = KVStoreApplication()
+            conns = AppConns(app)
+            ss, bs = Store(MemDB()), BlockStore(MemDB())
+            ss.save(state)
+            h = Handshaker(ss, state, bs, doc)
+            app_hash = await h.handshake(conns)
+            # kvstore initial app hash = varint(0)
+            assert app_hash == bytes(8)
+            info = await conns.query.info(abci.InfoRequest())
+            assert info.last_block_height == 0
+        run(go())
+
+    def test_app_behind_replays_blocks(self):
+        async def go():
+            doc, pvs = _genesis()
+            state = make_genesis_state(doc)
+            app_db = MemDB()
+            app = KVStoreApplication(db=app_db)
+            conns = AppConns(app)
+            ss, bs = Store(MemDB()), BlockStore(MemDB())
+            ss.save(state)
+            cfg = test_config().consensus
+            exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
+            cs = ConsensusState(cfg, state, exec_, bs,
+                                priv_validator=pvs[0])
+            await cs.start()
+            try:
+                await _wait_height(bs, 3)
+            finally:
+                await cs.stop()
+            final_state = ss.load()
+
+            # "crash": restart with a FRESH app (lost all state), same
+            # stores — handshake must replay blocks 1..N into the app
+            app2 = KVStoreApplication(db=MemDB())
+            conns2 = AppConns(app2)
+            h = Handshaker(ss, final_state, bs, doc)
+            app_hash = await h.handshake(conns2)
+            assert h.n_blocks >= 3
+            info = await conns2.query.info(abci.InfoRequest())
+            assert info.last_block_height == bs.height
+            assert app_hash == info.last_block_app_hash
+        run(go())
+
+    def test_app_synced_noop(self):
+        async def go():
+            doc, pvs = _genesis()
+            state = make_genesis_state(doc)
+            app_db = MemDB()
+            app = KVStoreApplication(db=app_db)
+            conns = AppConns(app)
+            ss, bs = Store(MemDB()), BlockStore(MemDB())
+            ss.save(state)
+            cfg = test_config().consensus
+            exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
+            cs = ConsensusState(cfg, state, exec_, bs,
+                                priv_validator=pvs[0])
+            await cs.start()
+            try:
+                await _wait_height(bs, 2)
+            finally:
+                await cs.stop()
+            final_state = ss.load()
+            # same app, already synced: no replaying
+            app2 = KVStoreApplication(db=app_db)
+            conns2 = AppConns(app2)
+            h = Handshaker(ss, final_state, bs, doc)
+            await h.handshake(conns2)
+            assert h.n_blocks == 0
+        run(go())
+
+
+class TestWALCatchup:
+    def test_restart_resumes_chain(self, tmp_path):
+        async def go():
+            doc, pvs = _genesis()
+            wal_path = str(tmp_path / "wal")
+
+            # run 1: produce some blocks with durable stores + WAL
+            state = make_genesis_state(doc)
+            app_db = SQLiteDB(str(tmp_path / "app.db"))
+            sdb = SQLiteDB(str(tmp_path / "state.db"))
+            bdb = SQLiteDB(str(tmp_path / "blocks.db"))
+            app = KVStoreApplication(db=app_db)
+            conns = AppConns(app)
+            ss, bs = Store(sdb), BlockStore(bdb)
+            ss.save(state)
+            cfg = test_config().consensus
+            exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
+            cs = ConsensusState(cfg, state, exec_, bs,
+                                priv_validator=pvs[0],
+                                wal=WAL(wal_path))
+            await cs.start()
+            try:
+                await _wait_height(bs, 3)
+            finally:
+                await cs.stop()
+            stopped_height = bs.height
+
+            # run 2: restart from disk; handshake + WAL catchup, then
+            # the chain continues past the stopped height
+            state2 = ss.load()
+            app2 = KVStoreApplication(db=app_db)
+            conns2 = AppConns(app2)
+            h = Handshaker(ss, state2, bs, doc)
+            await h.handshake(conns2)
+            exec2 = BlockExecutor(ss, conns2.consensus, block_store=bs)
+            cs2 = ConsensusState(cfg, state2, exec2, bs,
+                                 priv_validator=pvs[0],
+                                 wal=WAL(wal_path))
+            n = await catchup_replay(cs2, wal_path)
+            assert n >= 0
+            await cs2.start()
+            try:
+                await _wait_height(bs, stopped_height + 2)
+            finally:
+                await cs2.stop()
+            assert bs.height >= stopped_height + 2
+            # chain is continuous: every height has a block linking back
+            for hh in range(2, bs.height + 1):
+                b = bs.load_block(hh)
+                prev = bs.load_block(hh - 1)
+                assert b.header.last_block_id.hash == prev.hash()
+        run(go())
