@@ -159,3 +159,145 @@ def message_from_wal(d: dict):
     if t == VoteMessage.TYPE:
         return VoteMessage(Vote.from_proto(dejsonify(d["vote"])))
     raise ValueError(f"unknown WAL message type {t!r}")
+
+
+# ---------------------------------------------------------------------------
+# p2p wire codec (reference: internal/consensus/msgs.go MsgToProto /
+# MsgFromProto over cometbft.consensus.v2.Message)
+
+def encode_p2p(msg) -> bytes:
+    from ..wire import consensus_pb, encode
+    from ..types.part_set import PartSetHeader
+
+    if isinstance(msg, ProposalMessage):
+        d = {"proposal": {"proposal": msg.proposal.to_proto()}}
+    elif isinstance(msg, BlockPartMessage):
+        d = {"block_part": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            "part": msg.part.to_proto()}}
+    elif isinstance(msg, VoteMessage):
+        d = {"vote": {"vote": msg.vote.to_proto()}}
+    elif isinstance(msg, NewRoundStepMessage):
+        d = {"new_round_step": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            **({"step": msg.step} if msg.step else {}),
+            **({"seconds_since_start_time":
+                msg.seconds_since_start_time}
+               if msg.seconds_since_start_time else {}),
+            **({"last_commit_round": msg.last_commit_round}
+               if msg.last_commit_round else {})}}
+    elif isinstance(msg, NewValidBlockMessage):
+        d = {"new_valid_block": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            "block_part_set_header":
+                msg.block_part_set_header.to_proto(),
+            **({"block_parts": msg.block_parts.to_proto()}
+               if msg.block_parts is not None else {}),
+            **({"is_commit": True} if msg.is_commit else {})}}
+    elif isinstance(msg, HasVoteMessage):
+        d = {"has_vote": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            **({"type": msg.type} if msg.type else {}),
+            **({"index": msg.index} if msg.index else {})}}
+    elif isinstance(msg, VoteSetMaj23Message):
+        d = {"vote_set_maj23": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            **({"type": msg.type} if msg.type else {}),
+            "block_id": msg.block_id.to_proto()}}
+    elif isinstance(msg, VoteSetBitsMessage):
+        d = {"vote_set_bits": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            **({"type": msg.type} if msg.type else {}),
+            "block_id": msg.block_id.to_proto(),
+            "votes": msg.votes.to_proto() if msg.votes is not None
+            else {}}}
+    elif isinstance(msg, ProposalPOLMessage):
+        d = {"proposal_pol": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"proposal_pol_round": msg.proposal_pol_round}
+               if msg.proposal_pol_round else {}),
+            "proposal_pol": msg.proposal_pol.to_proto()
+            if msg.proposal_pol is not None else {}}}
+    elif isinstance(msg, HasProposalBlockPartMessage):
+        d = {"has_proposal_block_part": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            **({"index": msg.index} if msg.index else {})}}
+    else:
+        raise ValueError(f"cannot encode message {type(msg)}")
+    return encode(consensus_pb.MESSAGE, d)
+
+
+def decode_p2p(raw: bytes):
+    from ..wire import consensus_pb, decode
+    from ..libs.bits import BitArray
+    from ..types.block_id import BlockID
+    from ..types.part_set import Part, PartSetHeader
+
+    d = decode(consensus_pb.MESSAGE, raw)
+    if "proposal" in d:
+        return ProposalMessage(Proposal.from_proto(
+            d["proposal"].get("proposal") or {}))
+    if "block_part" in d:
+        bp = d["block_part"]
+        return BlockPartMessage(
+            height=bp.get("height", 0), round=bp.get("round", 0),
+            part=Part.from_proto(bp.get("part") or {}))
+    if "vote" in d:
+        return VoteMessage(Vote.from_proto(
+            d["vote"].get("vote") or {}))
+    if "new_round_step" in d:
+        n = d["new_round_step"]
+        return NewRoundStepMessage(
+            height=n.get("height", 0), round=n.get("round", 0),
+            step=n.get("step", 0),
+            seconds_since_start_time=n.get(
+                "seconds_since_start_time", 0),
+            last_commit_round=n.get("last_commit_round", 0))
+    if "new_valid_block" in d:
+        n = d["new_valid_block"]
+        return NewValidBlockMessage(
+            height=n.get("height", 0), round=n.get("round", 0),
+            block_part_set_header=PartSetHeader.from_proto(
+                n.get("block_part_set_header") or {}),
+            block_parts=BitArray.from_proto(n["block_parts"])
+            if n.get("block_parts") is not None else None,
+            is_commit=n.get("is_commit", False))
+    if "has_vote" in d:
+        n = d["has_vote"]
+        return HasVoteMessage(height=n.get("height", 0),
+                              round=n.get("round", 0),
+                              type=n.get("type", 0),
+                              index=n.get("index", 0))
+    if "vote_set_maj23" in d:
+        n = d["vote_set_maj23"]
+        return VoteSetMaj23Message(
+            height=n.get("height", 0), round=n.get("round", 0),
+            type=n.get("type", 0),
+            block_id=BlockID.from_proto(n.get("block_id") or {}))
+    if "vote_set_bits" in d:
+        n = d["vote_set_bits"]
+        return VoteSetBitsMessage(
+            height=n.get("height", 0), round=n.get("round", 0),
+            type=n.get("type", 0),
+            block_id=BlockID.from_proto(n.get("block_id") or {}),
+            votes=BitArray.from_proto(n.get("votes") or {}))
+    if "proposal_pol" in d:
+        n = d["proposal_pol"]
+        return ProposalPOLMessage(
+            height=n.get("height", 0),
+            proposal_pol_round=n.get("proposal_pol_round", 0),
+            proposal_pol=BitArray.from_proto(
+                n.get("proposal_pol") or {}))
+    if "has_proposal_block_part" in d:
+        n = d["has_proposal_block_part"]
+        return HasProposalBlockPartMessage(
+            height=n.get("height", 0), round=n.get("round", 0),
+            index=n.get("index", 0))
+    raise ValueError(f"unknown consensus message {sorted(d)}")

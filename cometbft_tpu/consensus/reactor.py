@@ -1,0 +1,585 @@
+"""Consensus reactor: gossips the consensus state over the p2p switch.
+
+Reference: internal/consensus/reactor.go (2022 LoC) — 4 channels
+(State/Data/Vote/VoteSetBits), PeerState tracking what each peer has,
+and per-peer gossip routines: gossipDataRoutine (:594, proposal block
+parts), gossipVotesRoutine (:654), queryMaj23Routine (:718).
+"""
+from __future__ import annotations
+
+import asyncio
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..libs.bits import BitArray
+from ..libs.log import Logger, new_logger
+from ..p2p.conn import ChannelDescriptor
+from ..p2p.switch import Peer, Reactor
+from ..types import canonical
+from ..types.block_id import BlockID
+from ..types.part_set import PartSetHeader
+from .messages import (
+    BlockPartMessage, HasProposalBlockPartMessage, HasVoteMessage,
+    NewRoundStepMessage, NewValidBlockMessage, ProposalMessage,
+    ProposalPOLMessage, VoteMessage, VoteSetBitsMessage,
+    VoteSetMaj23Message, decode_p2p, encode_p2p,
+)
+from .round_state import (
+    STEP_COMMIT, STEP_NEW_HEIGHT, STEP_PRECOMMIT, STEP_PREVOTE,
+    STEP_PROPOSE, RoundState,
+)
+from .state import ConsensusState
+
+STATE_CHANNEL = 0x20
+DATA_CHANNEL = 0x21
+VOTE_CHANNEL = 0x22
+VOTE_SET_BITS_CHANNEL = 0x23
+
+
+@dataclass
+class PeerRoundState:
+    """What we believe the peer's round state is (reference:
+    cstypes.PeerRoundState)."""
+    height: int = 0
+    round: int = -1
+    step: int = 0
+    proposal: bool = False
+    proposal_block_parts_header: PartSetHeader = field(
+        default_factory=PartSetHeader)
+    proposal_block_parts: Optional[BitArray] = None
+    proposal_pol_round: int = -1
+    proposal_pol: Optional[BitArray] = None
+    prevotes: Optional[BitArray] = None
+    precommits: Optional[BitArray] = None
+    last_commit_round: int = -1
+    last_commit: Optional[BitArray] = None
+    catchup_commit_round: int = -1
+    catchup_commit: Optional[BitArray] = None
+
+
+class PeerState:
+    """Reference: internal/consensus/reactor.go PeerState."""
+
+    def __init__(self, peer: Peer):
+        self.peer = peer
+        self.prs = PeerRoundState()
+
+    def apply_new_round_step(self, msg: NewRoundStepMessage,
+                             num_validators: int) -> None:
+        prs = self.prs
+        init_height, init_round = prs.height, prs.round
+        # snapshot BEFORE resetting: if the peer advanced exactly one
+        # height, its old precommits become its new last commit
+        # (reference: ApplyNewRoundStepMessage)
+        old_precommits = prs.precommits
+        if msg.height != prs.height or msg.round != prs.round:
+            prs.proposal = False
+            prs.proposal_block_parts_header = PartSetHeader()
+            prs.proposal_block_parts = None
+            prs.proposal_pol_round = -1
+            prs.proposal_pol = None
+            prs.prevotes = BitArray(num_validators)
+            prs.precommits = BitArray(num_validators)
+        if prs.height != msg.height:
+            if msg.height == init_height + 1 and \
+                    msg.last_commit_round == init_round:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = old_precommits
+            else:
+                prs.last_commit_round = msg.last_commit_round
+                prs.last_commit = None
+            prs.catchup_commit_round = -1
+            prs.catchup_commit = None
+        prs.height = msg.height
+        prs.round = msg.round
+        prs.step = msg.step
+
+    def apply_new_valid_block(self, msg: NewValidBlockMessage) -> None:
+        prs = self.prs
+        if prs.height != msg.height:
+            return
+        if prs.round != msg.round and not msg.is_commit:
+            return
+        prs.proposal_block_parts_header = msg.block_part_set_header
+        prs.proposal_block_parts = msg.block_parts
+
+    def apply_proposal(self, msg: ProposalMessage) -> None:
+        prs = self.prs
+        p = msg.proposal
+        if prs.height != p.height or prs.round != p.round:
+            return
+        if prs.proposal:
+            return
+        prs.proposal = True
+        if prs.proposal_block_parts is not None:
+            return   # NewValidBlock already set the parts header
+        prs.proposal_block_parts_header = p.block_id.part_set_header
+        prs.proposal_block_parts = BitArray(
+            p.block_id.part_set_header.total)
+        prs.proposal_pol_round = p.pol_round
+        prs.proposal_pol = None
+
+    def apply_proposal_pol(self, msg: ProposalPOLMessage) -> None:
+        prs = self.prs
+        if prs.height != msg.height or \
+                prs.proposal_pol_round != msg.proposal_pol_round:
+            return
+        prs.proposal_pol = msg.proposal_pol
+
+    def apply_has_vote(self, msg: HasVoteMessage) -> None:
+        if self.prs.height != msg.height:
+            return
+        self.set_has_vote(msg.height, msg.round, msg.type, msg.index)
+
+    def apply_has_proposal_block_part(
+            self, msg: HasProposalBlockPartMessage) -> None:
+        prs = self.prs
+        if prs.height != msg.height or prs.round != msg.round:
+            return
+        if prs.proposal_block_parts is not None:
+            prs.proposal_block_parts.set_index(msg.index, True)
+
+    def set_has_proposal_block_part(self, height: int, round_: int,
+                                    index: int) -> None:
+        prs = self.prs
+        if prs.height != height or prs.round != round_:
+            return
+        if prs.proposal_block_parts is not None:
+            prs.proposal_block_parts.set_index(index, True)
+
+    def set_has_vote(self, height: int, round_: int, type_: int,
+                     index: int) -> None:
+        ba = self._votes_bitarray(height, round_, type_)
+        if ba is not None:
+            ba.set_index(index, True)
+
+    def _votes_bitarray(self, height: int, round_: int,
+                        type_: int) -> Optional[BitArray]:
+        prs = self.prs
+        if prs.height == height:
+            if prs.round == round_:
+                return prs.prevotes if \
+                    type_ == canonical.PREVOTE_TYPE else prs.precommits
+            if prs.catchup_commit_round == round_ and \
+                    type_ == canonical.PRECOMMIT_TYPE:
+                return prs.catchup_commit
+            if prs.proposal_pol_round == round_ and \
+                    type_ == canonical.PREVOTE_TYPE:
+                return prs.proposal_pol
+        elif prs.height == height + 1:
+            if prs.last_commit_round == round_ and \
+                    type_ == canonical.PRECOMMIT_TYPE:
+                return prs.last_commit
+        return None
+
+    def apply_vote_set_bits(self, msg: VoteSetBitsMessage,
+                            our_votes: Optional[BitArray]) -> None:
+        """Merge the peer's claimed vote bits (reference:
+        ApplyVoteSetBitsMessage — bits we can't verify locally are only
+        trusted where they agree with votes we hold)."""
+        votes = self._votes_bitarray(msg.height, msg.round, msg.type)
+        if votes is None or msg.votes is None:
+            return
+        if our_votes is None:
+            votes.update(msg.votes)
+        else:
+            other_votes = votes.sub(our_votes)
+            has_votes = other_votes.or_(msg.votes)
+            votes.update(has_votes)
+
+    def ensure_catchup_commit_round(self, height: int, round_: int,
+                                    num_validators: int) -> None:
+        prs = self.prs
+        if prs.height != height:
+            return
+        if prs.catchup_commit_round != round_:
+            prs.catchup_commit_round = round_
+            prs.catchup_commit = BitArray(num_validators)
+
+
+class ConsensusReactor(Reactor):
+    def __init__(self, cs: ConsensusState,
+                 wait_sync: bool = False,
+                 logger: Optional[Logger] = None):
+        super().__init__("CONSENSUS")
+        self.cs = cs
+        self.wait_sync = wait_sync   # true while block/state syncing
+        if logger is not None:
+            self.logger = logger
+        self._peer_states: dict[str, PeerState] = {}
+        self._gossip_tasks: dict[str, list[asyncio.Task]] = {}
+        # wire the state machine's broadcasts through the switch
+        cs.broadcast_hooks.append(self._on_cs_broadcast)
+        cs.on_new_step.append(self._on_new_step)
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        """Reference: reactor.go StreamDescriptors."""
+        return [
+            ChannelDescriptor(id=STATE_CHANNEL, priority=6,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=DATA_CHANNEL, priority=10,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_CHANNEL, priority=7,
+                              send_queue_capacity=100),
+            ChannelDescriptor(id=VOTE_SET_BITS_CHANNEL, priority=1,
+                              send_queue_capacity=2),
+        ]
+
+    # ------------------------------------------------------------------
+    async def add_peer(self, peer: Peer) -> None:
+        ps = PeerState(peer)
+        self._peer_states[peer.id] = ps
+        peer.data["consensus_peer_state"] = ps
+        loop = asyncio.get_running_loop()
+        self._gossip_tasks[peer.id] = [
+            loop.create_task(self._gossip_data_routine(ps)),
+            loop.create_task(self._gossip_votes_routine(ps)),
+            loop.create_task(self._query_maj23_routine(ps)),
+        ]
+        # tell the new peer our current state
+        peer.send(STATE_CHANNEL, encode_p2p(self._new_round_step_msg()))
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        self._peer_states.pop(peer.id, None)
+        for t in self._gossip_tasks.pop(peer.id, []):
+            t.cancel()
+
+    # ------------------------------------------------------------------
+    async def receive(self, chan_id: int, peer: Peer,
+                      msg_bytes: bytes) -> None:
+        """Reference: reactor.go Receive (:243)."""
+        try:
+            msg = decode_p2p(msg_bytes)
+        except Exception as e:
+            self.logger.error("failed to decode message",
+                              peer=peer.id[:12], err=str(e))
+            return
+        ps = self._peer_states.get(peer.id)
+        if ps is None:
+            return
+        rs = self.cs.rs
+
+        if chan_id == STATE_CHANNEL:
+            if isinstance(msg, NewRoundStepMessage):
+                ps.apply_new_round_step(
+                    msg, self.cs.rs.validators.size()
+                    if self.cs.rs.validators else 0)
+            elif isinstance(msg, NewValidBlockMessage):
+                ps.apply_new_valid_block(msg)
+            elif isinstance(msg, HasVoteMessage):
+                ps.apply_has_vote(msg)
+            elif isinstance(msg, HasProposalBlockPartMessage):
+                ps.apply_has_proposal_block_part(msg)
+            elif isinstance(msg, VoteSetMaj23Message):
+                # record the claim, then reply with our vote bits
+                if rs.height != msg.height or rs.votes is None:
+                    return
+                try:
+                    rs.votes.set_peer_maj23(msg.round, msg.type,
+                                            peer.id, msg.block_id)
+                except Exception as e:
+                    self.logger.info("bad VoteSetMaj23",
+                                     err=str(e))
+                    return
+                vs = (rs.votes.prevotes(msg.round)
+                      if msg.type == canonical.PREVOTE_TYPE
+                      else rs.votes.precommits(msg.round))
+                if vs is None:
+                    return
+                our_votes = vs.bit_array_by_block_id(msg.block_id)
+                peer.send(VOTE_SET_BITS_CHANNEL, encode_p2p(
+                    VoteSetBitsMessage(
+                        height=msg.height, round=msg.round,
+                        type=msg.type, block_id=msg.block_id,
+                        votes=our_votes or BitArray(0))))
+        elif self.wait_sync:
+            return   # ignore data/votes while syncing
+        elif chan_id == DATA_CHANNEL:
+            if isinstance(msg, ProposalMessage):
+                ps.apply_proposal(msg)
+                self.cs.send_peer(msg, peer.id)
+            elif isinstance(msg, ProposalPOLMessage):
+                ps.apply_proposal_pol(msg)
+            elif isinstance(msg, BlockPartMessage):
+                ps.set_has_proposal_block_part(msg.height, msg.round,
+                                               msg.part.index)
+                self.cs.send_peer(msg, peer.id)
+        elif chan_id == VOTE_CHANNEL:
+            if isinstance(msg, VoteMessage):
+                v = msg.vote
+                ps.set_has_vote(v.height, v.round, v.type,
+                                v.validator_index)
+                self.cs.send_peer(msg, peer.id)
+        elif chan_id == VOTE_SET_BITS_CHANNEL:
+            if isinstance(msg, VoteSetBitsMessage) and \
+                    rs.height == msg.height and msg.votes is not None:
+                vs = (rs.votes.prevotes(msg.round)
+                      if msg.type == canonical.PREVOTE_TYPE
+                      else rs.votes.precommits(msg.round))
+                our = vs.bit_array_by_block_id(msg.block_id) \
+                    if vs is not None else None
+                ps.apply_vote_set_bits(msg, our)
+
+    # ------------------------------------------------------------------
+    # broadcasts from the state machine
+
+    def _on_cs_broadcast(self, msg) -> None:
+        if self.switch is None:
+            return
+        if isinstance(msg, ProposalMessage):
+            self.switch.broadcast(DATA_CHANNEL, encode_p2p(msg))
+        elif isinstance(msg, BlockPartMessage):
+            self.switch.broadcast(DATA_CHANNEL, encode_p2p(msg))
+        elif isinstance(msg, VoteMessage):
+            v = msg.vote
+            self.switch.broadcast(VOTE_CHANNEL, encode_p2p(msg))
+            self.switch.broadcast(STATE_CHANNEL, encode_p2p(
+                HasVoteMessage(height=v.height, round=v.round,
+                               type=v.type, index=v.validator_index)))
+        elif isinstance(msg, tuple) and msg and msg[0] == "has_vote":
+            v = msg[1]
+            self.switch.broadcast(STATE_CHANNEL, encode_p2p(
+                HasVoteMessage(height=v.height, round=v.round,
+                               type=v.type, index=v.validator_index)))
+
+    def _new_round_step_msg(self) -> NewRoundStepMessage:
+        rs = self.cs.rs
+        return NewRoundStepMessage(
+            height=rs.height, round=rs.round, step=rs.step,
+            seconds_since_start_time=max(
+                0, int(time.time()) - rs.start_time.seconds),
+            last_commit_round=rs.last_commit.round
+            if rs.last_commit is not None else -1)
+
+    def _on_new_step(self, rs: RoundState) -> None:
+        if self.switch is not None:
+            self.switch.broadcast(STATE_CHANNEL,
+                                  encode_p2p(self._new_round_step_msg()))
+
+    # ------------------------------------------------------------------
+    # gossip routines (reference: reactor.go:594,654,718)
+
+    @property
+    def _sleep_s(self) -> float:
+        return self.cs.config.peer_gossip_sleep_duration_ns / 1e9
+
+    async def _gossip_data_routine(self, ps: PeerState) -> None:
+        peer = ps.peer
+        try:
+            while True:
+                rs = self.cs.rs
+                prs = ps.prs
+                # send proposal block parts the peer is missing
+                if (rs.proposal_block_parts is not None and
+                        rs.height == prs.height and
+                        rs.round == prs.round and
+                        prs.proposal_block_parts is not None and
+                        rs.proposal_block_parts.header() ==
+                        prs.proposal_block_parts_header):
+                    sent = False
+                    for i in range(rs.proposal_block_parts.total):
+                        if rs.proposal_block_parts.has_part(i) and \
+                                not prs.proposal_block_parts \
+                                .get_index(i):
+                            part = rs.proposal_block_parts.get_part(i)
+                            if peer.send(DATA_CHANNEL, encode_p2p(
+                                    BlockPartMessage(
+                                        height=rs.height,
+                                        round=rs.round, part=part))):
+                                prs.proposal_block_parts.set_index(
+                                    i, True)
+                                sent = True
+                            break
+                    if sent:
+                        await asyncio.sleep(0)  # keep the loop fair
+                        continue
+                # peer is on an older height: catch up from block store
+                if prs.height and prs.height < rs.height and \
+                        prs.height >= self.cs.block_store.base:
+                    if await self._gossip_catchup(ps):
+                        await asyncio.sleep(0)  # keep the loop fair
+                        continue
+                # send the proposal if peer lacks it
+                if (rs.proposal is not None and rs.height == prs.height
+                        and rs.round == prs.round and
+                        not prs.proposal):
+                    if peer.send(DATA_CHANNEL,
+                                 encode_p2p(ProposalMessage(
+                                     rs.proposal))):
+                        ps.apply_proposal(ProposalMessage(rs.proposal))
+                    if rs.proposal.pol_round >= 0:
+                        pv = rs.votes.prevotes(rs.proposal.pol_round)
+                        if pv is not None:
+                            peer.send(DATA_CHANNEL, encode_p2p(
+                                ProposalPOLMessage(
+                                    height=rs.height,
+                                    proposal_pol_round=rs.proposal
+                                    .pol_round,
+                                    proposal_pol=pv.bit_array())))
+                    continue
+                await asyncio.sleep(self._sleep_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("gossip data routine died",
+                              peer=peer.id[:12], err=str(e))
+
+    async def _gossip_catchup(self, ps: PeerState) -> bool:
+        """Send a block part from the store for a lagging peer
+        (reference: gossipDataForCatchup)."""
+        prs = ps.prs
+        if prs.proposal_block_parts is None:
+            # init from stored block meta
+            meta = self.cs.block_store.load_block_meta(prs.height)
+            if meta is None:
+                return False
+            prs.proposal_block_parts_header = \
+                meta.block_id.part_set_header
+            prs.proposal_block_parts = BitArray(
+                meta.block_id.part_set_header.total)
+        for i in range(prs.proposal_block_parts_header.total):
+            if not prs.proposal_block_parts.get_index(i):
+                part = self.cs.block_store.load_block_part(
+                    prs.height, i)
+                if part is None:
+                    return False
+                if ps.peer.send(DATA_CHANNEL, encode_p2p(
+                        BlockPartMessage(height=prs.height,
+                                         round=prs.round, part=part))):
+                    prs.proposal_block_parts.set_index(i, True)
+                    return True
+                # peer's send queue is full — let it drain
+                return False
+        return False
+
+    async def _gossip_votes_routine(self, ps: PeerState) -> None:
+        peer = ps.peer
+        try:
+            while True:
+                rs = self.cs.rs
+                prs = ps.prs
+                if rs.height == prs.height:
+                    if await self._gossip_votes_for_height(rs, ps):
+                        continue
+                # peer is on the previous height: send our last commit
+                if (prs.height != 0 and
+                        rs.height == prs.height + 1 and
+                        rs.last_commit is not None):
+                    if self._pick_send_vote(ps, rs.last_commit):
+                        continue
+                # peer further behind: send precommits from stored
+                # commit
+                if (prs.height != 0 and
+                        rs.height >= prs.height + 2 and
+                        prs.height >= self.cs.block_store.base):
+                    commit = self.cs.block_store.load_block_commit(
+                        prs.height)
+                    if commit is not None and \
+                            self._pick_send_commit_vote(ps, commit):
+                        continue
+                await asyncio.sleep(self._sleep_s)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("gossip votes routine died",
+                              peer=peer.id[:12], err=str(e))
+
+    async def _gossip_votes_for_height(self, rs, ps: PeerState) -> bool:
+        """Reference: gossipVotesForHeight."""
+        prs = ps.prs
+        # catchup: peer's round is behind ours
+        if prs.step == STEP_NEW_HEIGHT and prs.round == -1:
+            pass
+        if prs.proposal_pol_round != -1:
+            pv = rs.votes.prevotes(prs.proposal_pol_round)
+            if pv is not None and self._pick_send_vote(ps, pv):
+                return True
+        if prs.step <= STEP_PROPOSE and prs.round != -1 and \
+                prs.round <= rs.round:
+            pv = rs.votes.prevotes(prs.round)
+            if pv is not None and self._pick_send_vote(ps, pv):
+                return True
+        if prs.step <= STEP_PREVOTE + 1 and prs.round != -1 and \
+                prs.round <= rs.round:
+            pv = rs.votes.prevotes(prs.round)
+            if pv is not None and self._pick_send_vote(ps, pv):
+                return True
+        if prs.round != -1 and prs.round <= rs.round:
+            pc = rs.votes.precommits(prs.round)
+            if pc is not None and self._pick_send_vote(ps, pc):
+                return True
+        if prs.catchup_commit_round != -1:
+            pc = rs.votes.precommits(prs.catchup_commit_round)
+            if pc is not None and self._pick_send_vote(ps, pc):
+                return True
+        return False
+
+    def _pick_send_vote(self, ps: PeerState, vote_set) -> bool:
+        """Send one vote the peer lacks (reference: PickSendVote)."""
+        ours = vote_set.bit_array()
+        theirs = ps._votes_bitarray(vote_set.height, vote_set.round,
+                                    vote_set.signed_msg_type)
+        if theirs is None:
+            theirs = BitArray(ours.size())
+        missing = ours.sub(theirs)
+        idx = missing.pick_random()
+        if idx is None:
+            return False
+        vote = vote_set.get_by_index(idx)
+        if vote is None:
+            return False
+        if ps.peer.send(VOTE_CHANNEL, encode_p2p(VoteMessage(vote))):
+            ps.set_has_vote(vote.height, vote.round, vote.type,
+                            vote.validator_index)
+            return True
+        return False
+
+    def _pick_send_commit_vote(self, ps: PeerState, commit) -> bool:
+        prs = ps.prs
+        ps.ensure_catchup_commit_round(
+            prs.height, commit.round,
+            len(commit.signatures))
+        theirs = prs.catchup_commit
+        if theirs is None:
+            return False
+        for i, sig in enumerate(commit.signatures):
+            if sig.absent_flag() or theirs.get_index(i):
+                continue
+            vote = commit.get_vote(i)
+            if ps.peer.send(VOTE_CHANNEL,
+                            encode_p2p(VoteMessage(vote))):
+                theirs.set_index(i, True)
+                return True
+        return False
+
+    async def _query_maj23_routine(self, ps: PeerState) -> None:
+        """Periodically ask the peer for votes we might be missing
+        (reference: queryMaj23Routine)."""
+        peer = ps.peer
+        sleep_s = self.cs.config \
+            .peer_query_maj23_sleep_duration_ns / 1e9
+        try:
+            while True:
+                await asyncio.sleep(sleep_s)
+                rs = self.cs.rs
+                prs = ps.prs
+                if rs.height != prs.height or rs.votes is None:
+                    continue
+                for type_, vs in ((canonical.PREVOTE_TYPE,
+                                   rs.votes.prevotes(prs.round)),
+                                  (canonical.PRECOMMIT_TYPE,
+                                   rs.votes.precommits(prs.round))):
+                    if vs is None:
+                        continue
+                    bid, ok = vs.two_thirds_majority()
+                    if ok:
+                        peer.send(STATE_CHANNEL, encode_p2p(
+                            VoteSetMaj23Message(
+                                height=prs.height, round=prs.round,
+                                type=type_, block_id=bid)))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("query maj23 routine died",
+                              peer=peer.id[:12], err=str(e))

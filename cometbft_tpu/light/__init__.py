@@ -1,0 +1,14 @@
+"""Light client: header verification with sequential or skipping
+(bisection) modes, provider abstraction, trusted store, attack detection.
+"""
+from .verifier import (
+    DEFAULT_TRUST_LEVEL, LightClientError, header_expired,
+    validate_trust_level, verify, verify_adjacent, verify_backwards,
+    verify_non_adjacent,
+)
+
+__all__ = [
+    "DEFAULT_TRUST_LEVEL", "LightClientError", "header_expired",
+    "validate_trust_level", "verify", "verify_adjacent",
+    "verify_backwards", "verify_non_adjacent",
+]

@@ -1,0 +1,7 @@
+"""Mempool: pending transactions with priority lanes."""
+from .mempool import (
+    CListMempool, Mempool, MempoolError, NopMempool, TxCache,
+)
+
+__all__ = ["CListMempool", "Mempool", "MempoolError", "NopMempool",
+           "TxCache"]

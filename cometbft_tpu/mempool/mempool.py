@@ -1,0 +1,388 @@
+"""CListMempool: the concurrent pool with app-defined priority lanes.
+
+Reference: mempool/clist_mempool.go:34 — per-lane lists, CheckTx through
+the mempool ABCI connection, LRU dedup cache (cache.go), recheck after
+commit, interleaved-weighted-round-robin reaping (iterators.go IWRR),
+TxsAvailable notification; mempool/mempool.go:27 (interface);
+nop_mempool.go (disabled variant).
+
+Tx validity (incl. signatures) is the APP's job via CheckTx — the pool
+itself never inspects tx contents (SURVEY §2.5 note).
+"""
+from __future__ import annotations
+
+import abc
+import asyncio
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from typing import Callable, Optional, Sequence
+
+from ..abci import types as abci
+from ..config import MempoolConfig
+from ..libs.log import Logger, new_logger
+from ..types.tx import tx_key
+
+
+class MempoolError(Exception):
+    pass
+
+
+class TxInCacheError(MempoolError):
+    pass
+
+
+class MempoolFullError(MempoolError):
+    pass
+
+
+class InvalidTxError(MempoolError):
+    def __init__(self, code: int, log: str = ""):
+        super().__init__(f"tx rejected by CheckTx: code {code} {log}")
+        self.code = code
+
+
+class TxCache:
+    """LRU of recently seen tx keys (reference: mempool/cache.go)."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._m: OrderedDict[bytes, None] = OrderedDict()
+
+    def push(self, key: bytes) -> bool:
+        """Returns False if already present."""
+        if key in self._m:
+            self._m.move_to_end(key)
+            return False
+        self._m[key] = None
+        if len(self._m) > self._size:
+            self._m.popitem(last=False)
+        return True
+
+    def remove(self, key: bytes) -> None:
+        self._m.pop(key, None)
+
+    def has(self, key: bytes) -> bool:
+        return key in self._m
+
+    def reset(self) -> None:
+        self._m.clear()
+
+
+@dataclass
+class MempoolTx:
+    tx: bytes
+    key: bytes
+    height: int          # height at which the tx was validated
+    gas_wanted: int
+    lane: str
+    senders: set = field(default_factory=set)
+    seq: int = 0         # global FIFO sequence for cross-lane ordering
+
+
+class Mempool(abc.ABC):
+    """Reference: mempool/mempool.go Mempool interface (:27-100)."""
+
+    @abc.abstractmethod
+    async def check_tx(self, tx: bytes, sender: str = ""
+                       ) -> abci.CheckTxResponse: ...
+
+    @abc.abstractmethod
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> list[bytes]: ...
+
+    @abc.abstractmethod
+    async def update(self, height: int, txs: Sequence[bytes],
+                     tx_results: Sequence[abci.ExecTxResult],
+                     pre_check=None, post_check=None) -> None: ...
+
+    def lock(self) -> None: ...
+
+    def unlock(self) -> None: ...
+
+    def pre_update(self) -> None: ...
+
+    async def flush_app_conn(self) -> None: ...
+
+    def size(self) -> int:
+        return 0
+
+    def size_bytes(self) -> int:
+        return 0
+
+
+class CListMempool(Mempool):
+    def __init__(self, config: MempoolConfig, proxy_app,
+                 lanes: Optional[dict[str, int]] = None,
+                 default_lane: str = "",
+                 height: int = 0,
+                 logger: Optional[Logger] = None):
+        """proxy_app: the mempool ABCI connection.  lanes: lane id →
+        priority from the app's InfoResponse; empty → single implicit
+        lane (priority 0)."""
+        if lanes and not default_lane:
+            raise MempoolError("lanes set but no default lane")
+        if lanes and default_lane not in lanes:
+            raise MempoolError("default lane not in lane list")
+        self.config = config
+        self.proxy_app = proxy_app
+        self.logger = logger if logger is not None else \
+            new_logger("mempool")
+        self.lanes = dict(lanes or {"": 0})
+        self.default_lane = default_lane if lanes else ""
+        # per-lane insertion-ordered maps: key -> MempoolTx
+        self._lane_txs: dict[str, OrderedDict[bytes, MempoolTx]] = {
+            lane: OrderedDict() for lane in self.lanes}
+        self.cache = TxCache(config.cache_size)
+        self.height = height
+        self._seq = 0
+        self._size_bytes = 0
+        # commit-time exclusion: while locked, check_tx waits so no tx
+        # can slip in unvalidated between FinalizeBlock and recheck
+        self._unlocked = asyncio.Event()
+        self._unlocked.set()
+        self._txs_available: Optional[asyncio.Event] = None
+        self._notified_txs_available = False
+        self._recheck_cursor: Optional[int] = None
+
+    # ------------------------------------------------------------------
+    def enable_txs_available(self) -> None:
+        self._txs_available = asyncio.Event()
+
+    def txs_available(self) -> asyncio.Event:
+        if self._txs_available is None:
+            raise MempoolError("txs_available not enabled")
+        return self._txs_available
+
+    def _notify_txs_available(self) -> None:
+        if self.size() == 0:
+            return
+        if self._txs_available is not None and \
+                not self._notified_txs_available:
+            self._notified_txs_available = True
+            self._txs_available.set()
+
+    # ------------------------------------------------------------------
+    def lock(self) -> None:
+        """Block new check_tx admissions (reference: Mempool.Lock held
+        across app Commit + Update)."""
+        self._unlocked.clear()
+
+    def unlock(self) -> None:
+        self._unlocked.set()
+
+    def pre_update(self) -> None:
+        pass
+
+    async def flush_app_conn(self) -> None:
+        await self.proxy_app.flush()
+
+    def size(self) -> int:
+        return sum(len(d) for d in self._lane_txs.values())
+
+    def size_bytes(self) -> int:
+        return self._size_bytes
+
+    def lane_sizes(self, lane: str) -> tuple[int, int]:
+        d = self._lane_txs.get(lane, {})
+        return len(d), sum(len(e.tx) for e in d.values())
+
+    def contains(self, key: bytes) -> bool:
+        return any(key in d for d in self._lane_txs.values())
+
+    def get_tx_by_hash(self, h: bytes) -> Optional[bytes]:
+        for d in self._lane_txs.values():
+            e = d.get(h)
+            if e is not None:
+                return e.tx
+        return None
+
+    def flush(self) -> None:
+        """Remove everything (reference: Flush)."""
+        for d in self._lane_txs.values():
+            d.clear()
+        self._size_bytes = 0
+        self.cache.reset()
+
+    # ------------------------------------------------------------------
+    async def check_tx(self, tx: bytes, sender: str = ""
+                       ) -> abci.CheckTxResponse:
+        """Validate a tx via the app and add it to the pool.
+
+        Reference: CheckTx (:347) + handleCheckTxResponse (:407)."""
+        if len(tx) > self.config.max_tx_bytes:
+            raise MempoolError(
+                f"tx too large: {len(tx)} > {self.config.max_tx_bytes}")
+        # wait out any in-progress commit/update cycle
+        while not self._unlocked.is_set():
+            await self._unlocked.wait()
+        self._check_full(len(tx))
+        key = tx_key(tx)
+        if not self.cache.push(key):
+            # record the extra sender for dedup/gossip routing
+            for d in self._lane_txs.values():
+                e = d.get(key)
+                if e is not None and sender:
+                    e.senders.add(sender)
+            raise TxInCacheError("tx already exists in cache")
+        try:
+            res = await self.proxy_app.check_tx(
+                abci.CheckTxRequest(tx=tx, type=abci.CHECK_TX_TYPE_CHECK))
+        except Exception:
+            self.cache.remove(key)
+            raise
+        if res.code != abci.CODE_TYPE_OK:
+            if not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            raise InvalidTxError(res.code, res.log)
+        lane = self._resolve_lane(res.lane_id)
+        self._add_tx(tx, key, res.gas_wanted, lane, sender)
+        return res
+
+    def _resolve_lane(self, lane_id: str) -> str:
+        if not lane_id:
+            return self.default_lane
+        if lane_id not in self.lanes:
+            raise MempoolError(f"app assigned unknown lane {lane_id!r}")
+        return lane_id
+
+    def _check_full(self, tx_size: int) -> None:
+        if self.size() >= self.config.size or \
+                self._size_bytes + tx_size > self.config.max_txs_bytes:
+            raise MempoolFullError(
+                f"mempool is full: {self.size()} txs, "
+                f"{self._size_bytes} bytes")
+
+    def _add_tx(self, tx: bytes, key: bytes, gas_wanted: int,
+                lane: str, sender: str) -> None:
+        if self.contains(key):
+            return
+        # capacity may have changed across the CheckTx await
+        # (reference: isFull re-check in handleCheckTxResponse)
+        self._check_full(len(tx))
+        self._seq += 1
+        entry = MempoolTx(tx=tx, key=key, height=self.height,
+                          gas_wanted=gas_wanted, lane=lane,
+                          senders={sender} if sender else set(),
+                          seq=self._seq)
+        self._lane_txs[lane][key] = entry
+        self._size_bytes += len(tx)
+        self.logger.debug("Added tx", lane=lane,
+                          tx=key.hex().upper()[:12])
+        self._notify_txs_available()
+
+    def remove_tx_by_key(self, key: bytes) -> None:
+        for d in self._lane_txs.values():
+            e = d.pop(key, None)
+            if e is not None:
+                self._size_bytes -= len(e.tx)
+                return
+        raise MempoolError("transaction not found in mempool")
+
+    # ------------------------------------------------------------------
+    def _iwrr_order(self) -> list[MempoolTx]:
+        """Interleaved weighted round-robin across lanes by priority
+        (reference: iterators.go IWRRIterator)."""
+        queues = {lane: list(d.values())
+                  for lane, d in self._lane_txs.items() if d}
+        if not queues:
+            return []
+        out: list[MempoolTx] = []
+        # each full round grants each lane `priority` slots, interleaved
+        while queues:
+            for lane in sorted(queues,
+                               key=lambda ln: -self.lanes.get(ln, 0)):
+                weight = max(1, self.lanes.get(lane, 0))
+                q = queues.get(lane)
+                if q is None:
+                    continue
+                take = min(weight, len(q))
+                out.extend(q[:take])
+                del q[:take]
+                if not q:
+                    del queues[lane]
+        return out
+
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> list[bytes]:
+        """Reference: ReapMaxBytesMaxGas (:690)."""
+        txs: list[bytes] = []
+        total_bytes = 0
+        total_gas = 0
+        for e in self._iwrr_order():
+            nb = total_bytes + len(e.tx)
+            if max_bytes > -1 and nb > max_bytes:
+                break
+            ng = total_gas + e.gas_wanted
+            if max_gas > -1 and ng > max_gas:
+                break
+            txs.append(e.tx)
+            total_bytes, total_gas = nb, ng
+        return txs
+
+    def reap_max_txs(self, n: int) -> list[bytes]:
+        order = self._iwrr_order()
+        if n < 0:
+            n = len(order)
+        return [e.tx for e in order[:n]]
+
+    def iter_entries(self) -> list[MempoolTx]:
+        """Gossip order: same IWRR order the reaper uses."""
+        return self._iwrr_order()
+
+    # ------------------------------------------------------------------
+    async def update(self, height: int, txs: Sequence[bytes],
+                     tx_results: Sequence[abci.ExecTxResult],
+                     pre_check: Optional[Callable] = None,
+                     post_check: Optional[Callable] = None) -> None:
+        """Remove committed txs, then recheck the remainder.
+
+        Reference: Update (:767) — caller must hold the mempool lock
+        (BlockExecutor.commit does)."""
+        self.height = height
+        self._notified_txs_available = False
+        if self._txs_available is not None:
+            self._txs_available.clear()
+        for tx, res in zip(txs, tx_results):
+            key = tx_key(tx)
+            if res.code == abci.CODE_TYPE_OK:
+                self.cache.push(key)   # committed: keep in cache forever
+            elif not self.config.keep_invalid_txs_in_cache:
+                self.cache.remove(key)
+            try:
+                self.remove_tx_by_key(key)
+            except MempoolError:
+                pass
+        if self.config.recheck and self.size() > 0:
+            await self._recheck_txs()
+        self._notify_txs_available()
+
+    async def _recheck_txs(self) -> None:
+        """Re-validate every pooled tx at the new height (reference:
+        recheckTxs + handleRecheckTxResponse :618)."""
+        for lane, d in self._lane_txs.items():
+            for key in list(d.keys()):
+                e = d.get(key)
+                if e is None:
+                    continue
+                res = await self.proxy_app.check_tx(abci.CheckTxRequest(
+                    tx=e.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+                if res.code != abci.CODE_TYPE_OK:
+                    d.pop(key, None)
+                    self._size_bytes -= len(e.tx)
+                    if not self.config.keep_invalid_txs_in_cache:
+                        self.cache.remove(key)
+
+
+class NopMempool(Mempool):
+    """Disabled mempool (reference: nop_mempool.go)."""
+
+    async def check_tx(self, tx: bytes, sender: str = ""):
+        raise MempoolError("mempool is disabled")
+
+    def reap_max_bytes_max_gas(self, max_bytes: int,
+                               max_gas: int) -> list[bytes]:
+        return []
+
+    async def update(self, height, txs, tx_results, pre_check=None,
+                     post_check=None) -> None:
+        pass

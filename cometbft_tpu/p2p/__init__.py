@@ -1,0 +1,14 @@
+"""P2P: the distributed communication backend.
+
+Reference: p2p/ — Switch (peer lifecycle + reactor registry),
+MConnection (multiplexed prioritized streams over one TCP conn),
+SecretConnection (authenticated encryption), PEX/address book.
+
+Validators are WAN peers: this host-side socket stack carries consensus;
+TPU ICI/DCN is used only inside the crypto offload (SURVEY §5).
+"""
+from .key import NodeKey, node_id_from_pub_key
+from .switch import Reactor, Switch, Peer
+
+__all__ = ["NodeKey", "node_id_from_pub_key", "Reactor", "Switch",
+           "Peer"]

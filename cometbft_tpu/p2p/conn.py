@@ -1,0 +1,231 @@
+"""MConnection: multiplexed prioritized streams over one connection.
+
+Reference: p2p/transport/tcp/conn/connection.go:68 — per-channel send
+queues, priority-weighted least-ratio scheduling, 1024-byte packet
+payloads, ping/pong keepalive, flow control.  Packets here ride the
+SecretConnection's message frames; the scheduler picks the channel with
+the lowest sent-bytes/priority ratio, exactly the reference's
+least-ratio rule.
+"""
+from __future__ import annotations
+
+import asyncio
+import struct
+from dataclasses import dataclass, field
+from typing import Awaitable, Callable, Optional
+
+from ..libs.log import Logger, new_logger
+
+MAX_PACKET_PAYLOAD_SIZE = 1024
+_PING_INTERVAL_S = 60.0
+_PONG_TIMEOUT_S = 45.0
+_FLUSH_THROTTLE_S = 0.01
+
+# packet types
+_PKT_PING = 0x01
+_PKT_PONG = 0x02
+_PKT_MSG = 0x03
+
+
+class MConnectionError(Exception):
+    pass
+
+
+@dataclass
+class ChannelDescriptor:
+    """Reference: conn.ChannelDescriptor."""
+    id: int
+    priority: int = 1
+    send_queue_capacity: int = 100
+    recv_message_capacity: int = 22 * 1024 * 1024
+
+
+class _Channel:
+    def __init__(self, desc: ChannelDescriptor):
+        self.desc = desc
+        self.send_queue: asyncio.Queue[bytes] = asyncio.Queue(
+            desc.send_queue_capacity)
+        self.sending: bytes = b""
+        self.sent_pos = 0
+        self.recv_buffer = bytearray()
+        self.recently_sent = 0   # for least-ratio scheduling
+
+    def is_send_pending(self) -> bool:
+        return bool(self.sending) or not self.send_queue.empty()
+
+    def next_packet(self) -> tuple[bytes, bool]:
+        """(payload, eof) for the next packet of the current message."""
+        if not self.sending:
+            self.sending = self.send_queue.get_nowait()
+            self.sent_pos = 0
+        chunk = self.sending[self.sent_pos:
+                             self.sent_pos + MAX_PACKET_PAYLOAD_SIZE]
+        self.sent_pos += len(chunk)
+        eof = self.sent_pos >= len(self.sending)
+        if eof:
+            self.sending = b""
+            self.sent_pos = 0
+        self.recently_sent += len(chunk)
+        return chunk, eof
+
+    def recv_packet(self, payload: bytes, eof: bool,
+                    max_size: int) -> Optional[bytes]:
+        self.recv_buffer += payload
+        if len(self.recv_buffer) > max_size:
+            raise MConnectionError(
+                f"recv message exceeds {max_size} bytes on channel "
+                f"{self.desc.id}")
+        if eof:
+            msg = bytes(self.recv_buffer)
+            self.recv_buffer.clear()
+            return msg
+        return None
+
+
+class MConnection:
+    """on_receive(channel_id, msg_bytes) is awaited for every complete
+    message; on_error(exc) fires once when the connection dies."""
+
+    def __init__(self, sconn, channels: list[ChannelDescriptor],
+                 on_receive: Callable[[int, bytes], Awaitable[None]],
+                 on_error: Callable[[Exception], None],
+                 logger: Optional[Logger] = None):
+        self._sconn = sconn
+        self._channels = {d.id: _Channel(d) for d in channels}
+        self._on_receive = on_receive
+        self._on_error = on_error
+        self.logger = logger if logger is not None else \
+            new_logger("mconn")
+        self._send_event = asyncio.Event()
+        self._pong_pending = False
+        self._tasks: list[asyncio.Task] = []
+        self._closed = False
+
+    def start(self) -> None:
+        loop = asyncio.get_running_loop()
+        self._tasks = [
+            loop.create_task(self._send_routine()),
+            loop.create_task(self._recv_routine()),
+            loop.create_task(self._ping_routine()),
+        ]
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        for t in self._tasks:
+            t.cancel()
+        self._sconn.close()
+
+    # ------------------------------------------------------------------
+    def send(self, channel_id: int, msg: bytes) -> bool:
+        """Queue a message; False when the channel queue is full
+        (reference: Peer.TrySend semantics)."""
+        ch = self._channels.get(channel_id)
+        if ch is None or self._closed:
+            return False
+        try:
+            ch.send_queue.put_nowait(msg)
+        except asyncio.QueueFull:
+            return False
+        self._send_event.set()
+        return True
+
+    async def send_blocking(self, channel_id: int, msg: bytes) -> bool:
+        ch = self._channels.get(channel_id)
+        if ch is None or self._closed:
+            return False
+        await ch.send_queue.put(msg)
+        self._send_event.set()
+        return True
+
+    # ------------------------------------------------------------------
+    def _pick_channel(self) -> Optional[_Channel]:
+        """Least sent-bytes/priority ratio wins (reference:
+        sendPacketMsg)."""
+        best, best_ratio = None, None
+        for ch in self._channels.values():
+            if not ch.is_send_pending():
+                continue
+            ratio = ch.recently_sent / max(1, ch.desc.priority)
+            if best_ratio is None or ratio < best_ratio:
+                best, best_ratio = ch, ratio
+        return best
+
+    async def _send_routine(self) -> None:
+        try:
+            while not self._closed:
+                ch = self._pick_channel()
+                if ch is None:
+                    self._send_event.clear()
+                    if self._pong_pending:
+                        self._pong_pending = False
+                        await self._sconn.write_msg(
+                            bytes([_PKT_PONG]))
+                        continue
+                    await self._send_event.wait()
+                    continue
+                payload, eof = ch.next_packet()
+                pkt = bytes([_PKT_MSG, ch.desc.id,
+                             1 if eof else 0]) + payload
+                await self._sconn.write_msg(pkt)
+                # decay the ratio counters periodically
+                if ch.recently_sent > 1 << 20:
+                    for c in self._channels.values():
+                        c.recently_sent //= 2
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    async def _recv_routine(self) -> None:
+        try:
+            while not self._closed:
+                msg = await self._sconn.read_msg()
+                if not msg:
+                    raise MConnectionError("empty packet")
+                ptype = msg[0]
+                if ptype == _PKT_PING:
+                    self._pong_pending = True
+                    self._send_event.set()
+                elif ptype == _PKT_PONG:
+                    pass
+                elif ptype == _PKT_MSG:
+                    if len(msg) < 3:
+                        raise MConnectionError("short msg packet")
+                    chan_id, eof = msg[1], bool(msg[2])
+                    ch = self._channels.get(chan_id)
+                    if ch is None:
+                        raise MConnectionError(
+                            f"unknown channel {chan_id:#x}")
+                    complete = ch.recv_packet(
+                        msg[3:], eof, ch.desc.recv_message_capacity)
+                    if complete is not None:
+                        await self._on_receive(chan_id, complete)
+                else:
+                    raise MConnectionError(
+                        f"unknown packet type {ptype:#x}")
+        except asyncio.CancelledError:
+            raise
+        except (asyncio.IncompleteReadError, ConnectionError, OSError,
+                Exception) as e:
+            self._fail(e)
+
+    async def _ping_routine(self) -> None:
+        try:
+            while not self._closed:
+                await asyncio.sleep(_PING_INTERVAL_S)
+                await self._sconn.write_msg(bytes([_PKT_PING]))
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self._fail(e)
+
+    def _fail(self, e: Exception) -> None:
+        if self._closed:
+            return
+        self.close()
+        try:
+            self._on_error(e)
+        except Exception:
+            pass

@@ -1,0 +1,311 @@
+"""Mempool (lanes, cache, recheck) and light-client verifier tests."""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import DEFAULT_LANES, KVStoreApplication
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.light import (
+    verify, verify_adjacent, verify_backwards, verify_non_adjacent,
+)
+from cometbft_tpu.light.verifier import (
+    InvalidHeaderError, LightClientError, NewValSetCantBeTrustedError,
+    OldHeaderExpiredError,
+)
+from cometbft_tpu.mempool import (
+    CListMempool, MempoolError, NopMempool, TxCache,
+)
+from cometbft_tpu.mempool.mempool import InvalidTxError, TxInCacheError
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import SignedHeader
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import Commit, CommitSig
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validation import Fraction
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+
+_S = 1_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _mk_mempool(**cfg_kw):
+    app = KVStoreApplication()
+    conns = AppConns(app)
+    cfg = MempoolConfig(**cfg_kw)
+    mp = CListMempool(cfg, conns.mempool, lanes=DEFAULT_LANES,
+                      default_lane="default")
+    return mp, app, conns
+
+
+class TestMempool:
+    def test_check_tx_and_reap(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            await mp.check_tx(b"a=1")
+            await mp.check_tx(b"b=2")
+            assert mp.size() == 2
+            txs = mp.reap_max_bytes_max_gas(-1, -1)
+            assert sorted(txs) == [b"a=1", b"b=2"]
+        run(go())
+
+    def test_duplicate_rejected_via_cache(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            await mp.check_tx(b"a=1")
+            with pytest.raises(TxInCacheError):
+                await mp.check_tx(b"a=1")
+            assert mp.size() == 1
+        run(go())
+
+    def test_invalid_tx_rejected(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            with pytest.raises(InvalidTxError):
+                await mp.check_tx(b"garbage-no-sep")
+            assert mp.size() == 0
+        run(go())
+
+    def test_lane_assignment_and_priority_order(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            # key 22 -> lane foo (prio 7); key 9 -> bar (1); key 5 -> default (3)
+            await mp.check_tx(b"9=x")
+            await mp.check_tx(b"5=x")
+            await mp.check_tx(b"22=x")
+            assert mp.lane_sizes("foo") == (1, 4)
+            assert mp.lane_sizes("bar") == (1, 3)
+            order = mp.reap_max_bytes_max_gas(-1, -1)
+            # highest priority lane first in the IWRR order
+            assert order[0] == b"22=x"
+            assert order.index(b"22=x") < order.index(b"5=x") < \
+                order.index(b"9=x")
+        run(go())
+
+    def test_reap_respects_max_bytes(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            for i in range(10):
+                await mp.check_tx(f"k{i}=v{i}".encode())
+            txs = mp.reap_max_bytes_max_gas(12, -1)
+            assert sum(len(t) for t in txs) <= 12
+            assert len(txs) >= 1
+        run(go())
+
+    def test_full_rejected(self):
+        async def go():
+            mp, app, conns = _mk_mempool(size=2)
+            await mp.check_tx(b"a=1")
+            await mp.check_tx(b"b=2")
+            with pytest.raises(MempoolError, match="full"):
+                await mp.check_tx(b"c=3")
+        run(go())
+
+    def test_update_removes_committed_and_rechecks(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            await mp.check_tx(b"a=1")
+            await mp.check_tx(b"b=2")
+            ok = abci.ExecTxResult(code=0)
+            await mp.update(5, [b"a=1"], [ok])
+            assert mp.size() == 1
+            assert mp.get_tx_by_hash(
+                __import__("cometbft_tpu.types.tx",
+                           fromlist=["tx_key"]).tx_key(b"b=2")) == b"b=2"
+            # committed tx stays cached: re-submission rejected
+            with pytest.raises(TxInCacheError):
+                await mp.check_tx(b"a=1")
+        run(go())
+
+    def test_txs_available_notification(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+            mp.enable_txs_available()
+            ev = mp.txs_available()
+            assert not ev.is_set()
+            await mp.check_tx(b"a=1")
+            assert ev.is_set()
+            await mp.update(1, [b"a=1"], [abci.ExecTxResult(code=0)])
+            assert not ev.is_set()
+        run(go())
+
+    def test_nop_mempool(self):
+        async def go():
+            mp = NopMempool()
+            assert mp.reap_max_bytes_max_gas(-1, -1) == []
+            with pytest.raises(MempoolError):
+                await mp.check_tx(b"a=1")
+        run(go())
+
+
+class TestTxCache:
+    def test_lru_eviction(self):
+        c = TxCache(2)
+        assert c.push(b"a")
+        assert c.push(b"b")
+        assert not c.push(b"a")     # refreshes a
+        assert c.push(b"c")         # evicts b
+        assert c.has(b"a")
+        assert not c.has(b"b")
+        assert c.has(b"c")
+
+
+# ---------------------------------------------------------------------------
+
+
+def _light_fixture(n=4, power=10, chain_id="light-test"):
+    pvs = [new_mock_pv() for _ in range(n)]
+    vals = [Validator.new(pv.get_pub_key(), power) for pv in pvs]
+    pairs = sorted(zip(vals, pvs),
+                   key=lambda vp: (-vp[0].voting_power, vp[0].address))
+    vset = ValidatorSet([p[0] for p in pairs])
+    pv_by_addr = {p[1].get_pub_key().address(): p[1] for p in pairs}
+    return vset, pv_by_addr
+
+
+def _signed_header(chain_id, height, time_s, vset, pv_by_addr,
+                   next_vset=None, signers=None):
+    doc = GenesisDoc(chain_id=chain_id,
+                     genesis_time=Timestamp(1700000000, 0),
+                     validators=[])
+    state = make_genesis_state(doc)
+    from cometbft_tpu.types.block import Header
+    header = Header(
+        chain_id=chain_id, height=height,
+        time=Timestamp(time_s, 0),
+        last_block_id=BlockID(hash=b"\x01" * 32,
+                              part_set_header=PartSetHeader(1,
+                                                            b"\x02" * 32)),
+        validators_hash=vset.hash(),
+        next_validators_hash=(next_vset or vset).hash(),
+        consensus_hash=b"\x03" * 32,
+        proposer_address=vset.validators[0].address,
+        last_commit_hash=b"\x04" * 32,
+        data_hash=b"\x05" * 32,
+    )
+    bid = BlockID(hash=header.hash(),
+                  part_set_header=PartSetHeader(1, b"\x06" * 32))
+    sigs = []
+    for i, v in enumerate(vset.validators):
+        if signers is not None and i not in signers:
+            sigs.append(CommitSig.absent())
+            continue
+        ts = Timestamp(time_s, 0)
+        vote = Vote(type=canonical.PRECOMMIT_TYPE, height=height,
+                    round=0, block_id=bid, timestamp=ts,
+                    validator_address=v.address, validator_index=i)
+        pv_by_addr[v.address].sign_vote(chain_id, vote,
+                                        sign_extension=False)
+        sigs.append(CommitSig(block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                              validator_address=v.address, timestamp=ts,
+                              signature=vote.signature))
+    commit = Commit(height=height, round=0, block_id=bid,
+                    signatures=sigs)
+    return SignedHeader(header=header, commit=commit)
+
+
+class TestLightVerifier:
+    def test_verify_adjacent_ok(self):
+        vset, pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h2 = _signed_header("light-test", 2, 1700000200, vset, pvs)
+        verify_adjacent(h1, h2, vset, trusting_period_ns=3600 * _S,
+                        now=Timestamp(1700000300, 0),
+                        max_clock_drift_ns=10 * _S)
+
+    def test_verify_non_adjacent_ok(self):
+        vset, pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h5 = _signed_header("light-test", 5, 1700000500, vset, pvs)
+        verify_non_adjacent(h1, vset, h5, vset,
+                            trusting_period_ns=3600 * _S,
+                            now=Timestamp(1700000600, 0),
+                            max_clock_drift_ns=10 * _S)
+
+    def test_expired_trusted_header(self):
+        vset, pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h5 = _signed_header("light-test", 5, 1700000500, vset, pvs)
+        with pytest.raises(OldHeaderExpiredError):
+            verify_non_adjacent(h1, vset, h5, vset,
+                                trusting_period_ns=100 * _S,
+                                now=Timestamp(1700010000, 0),
+                                max_clock_drift_ns=10 * _S)
+
+    def test_insufficient_trust(self):
+        # new valset disjoint from trusted: 1/3 trust check must fail
+        vset, pvs = _light_fixture()
+        new_vset, new_pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h5 = _signed_header("light-test", 5, 1700000500, new_vset,
+                            new_pvs)
+        with pytest.raises(NewValSetCantBeTrustedError):
+            verify_non_adjacent(h1, vset, h5, new_vset,
+                                trusting_period_ns=3600 * _S,
+                                now=Timestamp(1700000600, 0),
+                                max_clock_drift_ns=10 * _S)
+
+    def test_insufficient_new_signatures(self):
+        vset, pvs = _light_fixture(4)
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        # only 2 of 4 sign height 5 (50% < 2/3)
+        h5 = _signed_header("light-test", 5, 1700000500, vset, pvs,
+                            signers={0, 1})
+        with pytest.raises(InvalidHeaderError):
+            verify_non_adjacent(h1, vset, h5, vset,
+                                trusting_period_ns=3600 * _S,
+                                now=Timestamp(1700000600, 0),
+                                max_clock_drift_ns=10 * _S)
+
+    def test_adjacent_requires_valhash_continuity(self):
+        vset, pvs = _light_fixture()
+        other_vset, other_pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h2 = _signed_header("light-test", 2, 1700000200, other_vset,
+                            other_pvs)
+        with pytest.raises(InvalidHeaderError):
+            verify_adjacent(h1, h2, other_vset,
+                            trusting_period_ns=3600 * _S,
+                            now=Timestamp(1700000300, 0),
+                            max_clock_drift_ns=10 * _S)
+
+    def test_verify_dispatches(self):
+        vset, pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h2 = _signed_header("light-test", 2, 1700000200, vset, pvs)
+        verify(h1, vset, h2, vset, 3600 * _S,
+               Timestamp(1700000300, 0), 10 * _S, Fraction(1, 3))
+
+    def test_verify_backwards(self):
+        vset, pvs = _light_fixture()
+        h1 = _signed_header("light-test", 1, 1700000100, vset, pvs)
+        h2 = _signed_header("light-test", 2, 1700000200, vset, pvs)
+        h2.header.last_block_id = BlockID(
+            hash=h1.header.hash(),
+            part_set_header=PartSetHeader(1, b"\x06" * 32))
+        verify_backwards(h1.header, h2.header)
+        h1.header.time = Timestamp(1800000000, 0)
+        with pytest.raises(InvalidHeaderError):
+            verify_backwards(h1.header, h2.header)

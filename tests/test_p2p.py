@@ -1,0 +1,194 @@
+"""P2P tests: secret connection, mconnection multiplexing, switch."""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.p2p.conn import ChannelDescriptor, MConnection
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.secret_connection import (
+    AuthFailureError, SecretConnection,
+)
+from cometbft_tpu.p2p.switch import NodeInfo, Reactor, Switch
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+async def _pipe_pair():
+    """Two connected (reader, writer) pairs over a localhost socket."""
+    server_side = asyncio.Queue()
+
+    async def on_conn(r, w):
+        await server_side.put((r, w))
+
+    server = await asyncio.start_server(on_conn, "127.0.0.1", 0)
+    port = server.sockets[0].getsockname()[1]
+    cr, cw = await asyncio.open_connection("127.0.0.1", port)
+    sr, sw = await server_side.get()
+    return (cr, cw), (sr, sw), server
+
+
+class TestSecretConnection:
+    def test_handshake_and_roundtrip(self):
+        async def go():
+            (cr, cw), (sr, sw), server = await _pipe_pair()
+            k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+            sc1, sc2 = await asyncio.gather(
+                SecretConnection.make(cr, cw, k1),
+                SecretConnection.make(sr, sw, k2))
+            # mutual authentication
+            assert sc1.remote_pub_key == k2.pub_key()
+            assert sc2.remote_pub_key == k1.pub_key()
+            # small message
+            await sc1.write_msg(b"hello")
+            assert await sc2.read_msg() == b"hello"
+            # exact-multiple-of-frame message
+            big = b"\xab" * 2048
+            await sc2.write_msg(big)
+            assert await sc1.read_msg() == big
+            # large multi-frame message
+            big2 = bytes(range(256)) * 40
+            await sc1.write_msg(big2)
+            assert await sc2.read_msg() == big2
+            server.close()
+        run(go())
+
+    def test_tampered_ciphertext_rejected(self):
+        async def go():
+            (cr, cw), (sr, sw), server = await _pipe_pair()
+            k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+            sc1, sc2 = await asyncio.gather(
+                SecretConnection.make(cr, cw, k1),
+                SecretConnection.make(sr, sw, k2))
+            # write garbage straight to the transport
+            sw.write(b"\x00" * 1044)
+            await sw.drain()
+            with pytest.raises(Exception):
+                await sc1.read_msg()
+            server.close()
+        run(go())
+
+
+class TestMConnection:
+    def test_multiplexed_channels(self):
+        async def go():
+            (cr, cw), (sr, sw), server = await _pipe_pair()
+            k1, k2 = ed25519.gen_priv_key(), ed25519.gen_priv_key()
+            sc1, sc2 = await asyncio.gather(
+                SecretConnection.make(cr, cw, k1),
+                SecretConnection.make(sr, sw, k2))
+            chans = [ChannelDescriptor(id=0x20, priority=5),
+                     ChannelDescriptor(id=0x21, priority=1)]
+            got = asyncio.Queue()
+
+            async def recv2(cid, msg):
+                await got.put((cid, msg))
+
+            async def recv1(cid, msg):
+                pass
+
+            m1 = MConnection(sc1, chans, recv1, lambda e: None)
+            m2 = MConnection(sc2, chans, recv2, lambda e: None)
+            m1.start()
+            m2.start()
+            assert m1.send(0x20, b"on-chan-20")
+            assert m1.send(0x21, b"x" * 5000)   # multi-packet
+            out = {}
+            for _ in range(2):
+                cid, msg = await asyncio.wait_for(got.get(), 5)
+                out[cid] = msg
+            assert out[0x20] == b"on-chan-20"
+            assert out[0x21] == b"x" * 5000
+            m1.close()
+            m2.close()
+            server.close()
+        run(go())
+
+
+class EchoReactor(Reactor):
+    CHAN = 0x77
+
+    def __init__(self, name="echo"):
+        super().__init__(name)
+        self.received = asyncio.Queue()
+        self.peers = []
+
+    def get_channels(self):
+        return [ChannelDescriptor(id=self.CHAN, priority=1)]
+
+    async def add_peer(self, peer):
+        self.peers.append(peer)
+
+    async def receive(self, chan_id, peer, msg_bytes):
+        await self.received.put((peer.id, msg_bytes))
+
+
+class TestSwitch:
+    def test_two_switches_exchange(self):
+        async def go():
+            nk1, nk2 = NodeKey.generate(), NodeKey.generate()
+            s1 = Switch(nk1, "testnet", listen_addr="127.0.0.1:0")
+            s2 = Switch(nk2, "testnet", listen_addr="127.0.0.1:0")
+            r1, r2 = EchoReactor("echo"), EchoReactor("echo")
+            s1.add_reactor(r1)
+            s2.add_reactor(r2)
+            await s1.start()
+            await s2.start()
+            await s2.dial_peer(s1.listen_addr)
+            await asyncio.sleep(0.05)
+            assert s1.num_peers() == 1
+            assert s2.num_peers() == 1
+            # authenticated identity matches node keys
+            assert list(s1.peers)[0] == nk2.id
+            assert list(s2.peers)[0] == nk1.id
+            # message flows through the reactor
+            s2.broadcast(EchoReactor.CHAN, b"hello-from-2")
+            pid, msg = await asyncio.wait_for(r1.received.get(), 5)
+            assert pid == nk2.id
+            assert msg == b"hello-from-2"
+            await s1.stop()
+            await s2.stop()
+        run(go())
+
+    def test_network_mismatch_rejected(self):
+        async def go():
+            nk1, nk2 = NodeKey.generate(), NodeKey.generate()
+            s1 = Switch(nk1, "chain-A", listen_addr="127.0.0.1:0")
+            s2 = Switch(nk2, "chain-B", listen_addr="127.0.0.1:0")
+            s1.add_reactor(EchoReactor())
+            s2.add_reactor(EchoReactor())
+            await s1.start()
+            await s2.start()
+            with pytest.raises(Exception, match="network|incompatible"):
+                await s2.dial_peer(s1.listen_addr)
+            await asyncio.sleep(0.05)
+            assert s1.num_peers() == 0
+            await s1.stop()
+            await s2.stop()
+        run(go())
+
+    def test_self_dial_rejected(self):
+        async def go():
+            nk = NodeKey.generate()
+            s = Switch(nk, "net", listen_addr="127.0.0.1:0")
+            s.add_reactor(EchoReactor())
+            await s.start()
+            with pytest.raises(Exception, match="self"):
+                await s.dial_peer(s.listen_addr)
+            await s.stop()
+        run(go())
+
+
+class TestNodeKey:
+    def test_save_load(self, tmp_path):
+        p = str(tmp_path / "node_key.json")
+        nk = NodeKey.load_or_gen(p)
+        nk2 = NodeKey.load_or_gen(p)
+        assert nk.id == nk2.id
+        assert len(nk.id) == 40

@@ -10,7 +10,7 @@ import pytest
 from cometbft_tpu.abci import types as abci
 from cometbft_tpu.abci.client import AppConns
 from cometbft_tpu.abci.kvstore import KVStoreApplication
-from cometbft_tpu.config import test_config as test_config  # noqa
+from cometbft_tpu.config import test_config as _test_config
 from cometbft_tpu.consensus.replay import Handshaker, catchup_replay
 from cometbft_tpu.consensus.state import ConsensusState
 from cometbft_tpu.consensus.wal import WAL
@@ -85,7 +85,7 @@ class TestHandshake:
             conns = AppConns(app)
             ss, bs = Store(MemDB()), BlockStore(MemDB())
             ss.save(state)
-            cfg = test_config().consensus
+            cfg = _test_config().consensus
             exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
             cs = ConsensusState(cfg, state, exec_, bs,
                                 priv_validator=pvs[0])
@@ -117,7 +117,7 @@ class TestHandshake:
             conns = AppConns(app)
             ss, bs = Store(MemDB()), BlockStore(MemDB())
             ss.save(state)
-            cfg = test_config().consensus
+            cfg = _test_config().consensus
             exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
             cs = ConsensusState(cfg, state, exec_, bs,
                                 priv_validator=pvs[0])
@@ -151,7 +151,7 @@ class TestWALCatchup:
             conns = AppConns(app)
             ss, bs = Store(sdb), BlockStore(bdb)
             ss.save(state)
-            cfg = test_config().consensus
+            cfg = _test_config().consensus
             exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
             cs = ConsensusState(cfg, state, exec_, bs,
                                 priv_validator=pvs[0],
