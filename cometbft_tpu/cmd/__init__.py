@@ -1,0 +1,1 @@
+"""CLI entry point: python -m cometbft_tpu.cmd <command>."""

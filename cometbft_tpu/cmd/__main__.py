@@ -1,0 +1,263 @@
+"""The node operator CLI.
+
+Reference: cmd/cometbft/ — init, start, show-node-id, show-validator,
+gen-node-key, gen-validator, unsafe-reset-all, testnet, version,
+rollback (cmd/cometbft/commands/).
+"""
+from __future__ import annotations
+
+import argparse
+import asyncio
+import json
+import os
+import shutil
+import sys
+
+
+def _load_config(home: str):
+    from ..config import Config
+    cfg = Config()
+    cfg.base.home = home
+    cfg_path = os.path.join(home, "config", "config.json")
+    if os.path.exists(cfg_path):
+        with open(cfg_path) as f:
+            overrides = json.load(f)
+        for section, values in overrides.items():
+            target = getattr(cfg, section, None)
+            if target is None:
+                continue
+            for k, v in values.items():
+                if hasattr(target, k):
+                    setattr(target, k, v)
+    return cfg
+
+
+def cmd_init(args) -> int:
+    from ..node import init_files
+    cfg = _load_config(args.home)
+    doc = init_files(cfg, chain_id=args.chain_id)
+    print(f"Initialized node in {args.home} "
+          f"(chain_id={doc.chain_id})")
+    return 0
+
+
+def cmd_start(args) -> int:
+    from ..node import Node
+    cfg = _load_config(args.home)
+    if args.proxy_app:
+        cfg.base.proxy_app = args.proxy_app
+    if args.p2p_laddr:
+        cfg.p2p.laddr = args.p2p_laddr
+    if args.rpc_laddr:
+        cfg.rpc.laddr = args.rpc_laddr
+    if args.persistent_peers:
+        cfg.p2p.persistent_peers = args.persistent_peers
+    if args.log_level:
+        cfg.base.log_level = args.log_level
+
+    async def main():
+        node = Node(cfg)
+        await node.start()
+        stop = asyncio.Event()
+        try:
+            import signal
+            loop = asyncio.get_running_loop()
+            for sig in (signal.SIGINT, signal.SIGTERM):
+                loop.add_signal_handler(sig, stop.set)
+        except (NotImplementedError, ImportError):
+            pass
+        await stop.wait()
+        await node.stop()
+
+    asyncio.run(main())
+    return 0
+
+
+def cmd_show_node_id(args) -> int:
+    from ..p2p.key import NodeKey
+    cfg = _load_config(args.home)
+    nk = NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    print(nk.id)
+    return 0
+
+
+def cmd_show_validator(args) -> int:
+    from ..privval import FilePV
+    cfg = _load_config(args.home)
+    pv = FilePV.load_or_generate(
+        cfg.base.path(cfg.base.priv_validator_key_file),
+        cfg.base.path(cfg.base.priv_validator_state_file))
+    pub = pv.get_pub_key()
+    import base64
+    print(json.dumps({"type": "tendermint/PubKeyEd25519",
+                      "value": base64.b64encode(
+                          pub.bytes()).decode()}))
+    return 0
+
+
+def cmd_gen_node_key(args) -> int:
+    from ..p2p.key import NodeKey
+    cfg = _load_config(args.home)
+    path = cfg.base.path(cfg.base.node_key_file)
+    if os.path.exists(path):
+        print(f"node key already exists at {path}", file=sys.stderr)
+        return 1
+    nk = NodeKey.generate()
+    nk.save_as(path)
+    print(nk.id)
+    return 0
+
+
+def cmd_unsafe_reset_all(args) -> int:
+    """Reference: commands/reset.go — wipe data, keep keys, reset
+    priv validator state."""
+    from ..privval import FilePV
+    cfg = _load_config(args.home)
+    data_dir = cfg.base.path(cfg.base.db_dir)
+    if os.path.isdir(data_dir):
+        shutil.rmtree(data_dir)
+    os.makedirs(data_dir, exist_ok=True)
+    key_file = cfg.base.path(cfg.base.priv_validator_key_file)
+    if os.path.exists(key_file):
+        pv = FilePV.load(key_file,
+                         cfg.base.path(
+                             cfg.base.priv_validator_state_file))
+        pv.reset()
+    print(f"Reset {data_dir}")
+    return 0
+
+
+def cmd_testnet(args) -> int:
+    """Generate configs/genesis for an N-validator local testnet
+    (reference: commands/testnet.go)."""
+    from ..config import Config
+    from ..node import init_files
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+    from ..types.timestamp import Timestamp
+    from ..p2p.key import NodeKey
+
+    n = args.v
+    out = args.o
+    pvs, node_ids = [], []
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        cfg = Config()
+        cfg.base.home = home
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pv = FilePV.load_or_generate(
+            cfg.base.path(cfg.base.priv_validator_key_file),
+            cfg.base.path(cfg.base.priv_validator_state_file))
+        nk = NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+        pvs.append(pv)
+        node_ids.append(nk.id)
+    doc = GenesisDoc(
+        chain_id=args.chain_id or "local-testnet",
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(address=b"",
+                                     pub_key=pv.get_pub_key(),
+                                     power=1)
+                    for pv in pvs])
+    doc.validate_and_complete()
+    base_p2p, base_rpc = args.starting_p2p_port, args.starting_rpc_port
+    for i in range(n):
+        home = os.path.join(out, f"node{i}")
+        doc.save_as(os.path.join(home, "config", "genesis.json"))
+        peers = ",".join(
+            f"{node_ids[j]}@127.0.0.1:{base_p2p + j}"
+            for j in range(n) if j != i)
+        with open(os.path.join(home, "config", "config.json"),
+                  "w") as f:
+            json.dump({
+                "p2p": {"laddr": f"tcp://127.0.0.1:{base_p2p + i}",
+                        "persistent_peers": peers},
+                "rpc": {"laddr": f"tcp://127.0.0.1:{base_rpc + i}"},
+            }, f, indent=2)
+    print(f"Successfully initialized {n} node directories in {out}")
+    return 0
+
+
+def cmd_version(args) -> int:
+    from .. import version
+    print(version.CMT_SEM_VER)
+    return 0
+
+
+def cmd_rollback(args) -> int:
+    """Reference: commands/rollback.go + state/rollback.go."""
+    from ..db import new_db
+    from ..state.rollback import rollback_state
+    from ..state.store import Store
+    from ..store import BlockStore
+    cfg = _load_config(args.home)
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    bs = BlockStore(new_db("blockstore", cfg.base.db_backend, db_dir))
+    ss = Store(new_db("state", cfg.base.db_backend, db_dir))
+    height, app_hash = rollback_state(ss, bs,
+                                      remove_block=args.hard)
+    print(f"Rolled back state to height {height} and hash "
+          f"{app_hash.hex().upper()}")
+    return 0
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="cometbft-tpu",
+        description="TPU-native BFT consensus node")
+    p.add_argument("--home", default=os.path.expanduser("~/.cometbft"),
+                   help="node home directory")
+    sub = p.add_subparsers(dest="command", required=True)
+
+    sp = sub.add_parser("init", help="initialize files for a node")
+    sp.add_argument("--chain-id", default="")
+    sp.set_defaults(fn=cmd_init)
+
+    sp = sub.add_parser("start", help="run the node")
+    sp.add_argument("--proxy_app", default="")
+    sp.add_argument("--p2p.laddr", dest="p2p_laddr", default="")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.add_argument("--p2p.persistent_peers",
+                    dest="persistent_peers", default="")
+    sp.add_argument("--log_level", default="")
+    sp.set_defaults(fn=cmd_start)
+
+    sp = sub.add_parser("show-node-id", help="show the node ID")
+    sp.set_defaults(fn=cmd_show_node_id)
+
+    sp = sub.add_parser("show-validator",
+                        help="show the validator pubkey")
+    sp.set_defaults(fn=cmd_show_validator)
+
+    sp = sub.add_parser("gen-node-key", help="generate a node key")
+    sp.set_defaults(fn=cmd_gen_node_key)
+
+    sp = sub.add_parser("unsafe-reset-all",
+                        help="wipe data, keep keys")
+    sp.set_defaults(fn=cmd_unsafe_reset_all)
+
+    sp = sub.add_parser("testnet",
+                        help="generate a local testnet")
+    sp.add_argument("--v", type=int, default=4,
+                    help="number of validators")
+    sp.add_argument("--o", default="./mytestnet",
+                    help="output directory")
+    sp.add_argument("--chain-id", default="")
+    sp.add_argument("--starting-p2p-port", type=int, default=26656)
+    sp.add_argument("--starting-rpc-port", type=int, default=26657)
+    sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser("rollback", help="roll back one height")
+    sp.add_argument("--hard", action="store_true",
+                    help="also remove the block")
+    sp.set_defaults(fn=cmd_rollback)
+
+    sp = sub.add_parser("version", help="show version")
+    sp.set_defaults(fn=cmd_version)
+
+    args = p.parse_args(argv)
+    return args.fn(args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

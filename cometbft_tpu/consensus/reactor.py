@@ -424,6 +424,8 @@ class ConsensusReactor(Reactor):
         except Exception as e:
             self.logger.error("gossip data routine died",
                               peer=peer.id[:12], err=str(e))
+            if self.switch is not None:
+                await self.switch.stop_peer(peer, str(e))
 
     async def _gossip_catchup(self, ps: PeerState) -> bool:
         """Send a block part from the store for a lagging peer
@@ -484,13 +486,17 @@ class ConsensusReactor(Reactor):
         except Exception as e:
             self.logger.error("gossip votes routine died",
                               peer=peer.id[:12], err=str(e))
+            if self.switch is not None:
+                await self.switch.stop_peer(peer, str(e))
 
     async def _gossip_votes_for_height(self, rs, ps: PeerState) -> bool:
         """Reference: gossipVotesForHeight."""
         prs = ps.prs
-        # catchup: peer's round is behind ours
-        if prs.step == STEP_NEW_HEIGHT and prs.round == -1:
-            pass
+        # peer just committed the previous height: our last commit helps
+        # it finish (reference: gossipVotesForHeight lastCommit branch)
+        if prs.step == STEP_NEW_HEIGHT and rs.last_commit is not None:
+            if self._pick_send_vote(ps, rs.last_commit):
+                return True
         if prs.proposal_pol_round != -1:
             pv = rs.votes.prevotes(prs.proposal_pol_round)
             if pv is not None and self._pick_send_vote(ps, pv):
@@ -583,3 +589,5 @@ class ConsensusReactor(Reactor):
         except Exception as e:
             self.logger.error("query maj23 routine died",
                               peer=peer.id[:12], err=str(e))
+            if self.switch is not None:
+                await self.switch.stop_peer(peer, str(e))

@@ -97,3 +97,10 @@ def nop_logger() -> Logger:
 def set_module_level(module: str, level: str) -> None:
     """Per-module level filter (reference: libs/log/filter.go)."""
     logging.getLogger(f"cometbft.{module}").setLevel(getattr(logging, level.upper()))
+
+
+def set_level(level: str) -> None:
+    """Set the root cometbft logger level (config: base.log_level)."""
+    _configure_root()
+    logging.getLogger("cometbft").setLevel(
+        getattr(logging, level.upper(), logging.INFO))

@@ -234,8 +234,14 @@ class CListMempool(Mempool):
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
             raise InvalidTxError(res.code, res.log)
-        lane = self._resolve_lane(res.lane_id)
-        self._add_tx(tx, key, res.gas_wanted, lane, sender)
+        try:
+            lane = self._resolve_lane(res.lane_id)
+            self._add_tx(tx, key, res.gas_wanted, lane, sender)
+        except MempoolError:
+            # a tx never admitted to the pool must not stay cached, or
+            # it becomes unsubmittable until LRU eviction
+            self.cache.remove(key)
+            raise
         return res
 
     def _resolve_lane(self, lane_id: str) -> str:

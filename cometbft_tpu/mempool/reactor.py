@@ -1,0 +1,102 @@
+"""Mempool reactor: transaction gossip.
+
+Reference: mempool/reactor.go — one per-peer goroutine walking the lane
+iterators, Receive → TryAddTx; senders tracked so a tx never bounces
+straight back to where it came from.  Wire: cometbft.mempool.v2.Txs
+inside Message (proto/cometbft/mempool/v2/types.proto).
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+from ..config import MempoolConfig
+from ..libs.log import Logger
+from ..p2p.conn import ChannelDescriptor
+from ..p2p.switch import Peer, Reactor
+from ..wire.proto import F, Msg, encode, decode
+from .mempool import CListMempool, MempoolError
+
+MEMPOOL_CHANNEL = 0x30
+
+TXS = Msg("cometbft.mempool.v2.Txs",
+          F(1, "txs", "bytes", repeated=True))
+MESSAGE = Msg("cometbft.mempool.v2.Message",
+              F(1, "txs", "msg", msg=TXS))
+
+
+class MempoolReactor(Reactor):
+    def __init__(self, mempool: CListMempool, config: MempoolConfig,
+                 logger: Optional[Logger] = None):
+        super().__init__("MEMPOOL")
+        self.mempool = mempool
+        self.config = config
+        if logger is not None:
+            self.logger = logger
+        self._gossip_tasks: dict[str, asyncio.Task] = {}
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5,
+                                  send_queue_capacity=1000)]
+
+    async def add_peer(self, peer: Peer) -> None:
+        if not self.config.broadcast:
+            return
+        self._gossip_tasks[peer.id] = \
+            asyncio.get_running_loop().create_task(
+                self._gossip_routine(peer))
+
+    async def remove_peer(self, peer: Peer, reason: str) -> None:
+        t = self._gossip_tasks.pop(peer.id, None)
+        if t is not None:
+            t.cancel()
+
+    async def receive(self, chan_id: int, peer: Peer,
+                      msg_bytes: bytes) -> None:
+        """Reference: reactor.go Receive → TryAddTx."""
+        try:
+            d = decode(MESSAGE, msg_bytes)
+        except Exception as e:
+            self.logger.error("bad mempool message", err=str(e))
+            return
+        for tx in (d.get("txs") or {}).get("txs", []):
+            try:
+                await self.mempool.check_tx(tx, sender=peer.id)
+            except MempoolError:
+                pass   # dupes/invalid/full are not peer faults
+
+    async def _gossip_routine(self, peer: Peer) -> None:
+        """Send txs the peer hasn't seen, advancing a sequence cursor
+        so an unchanged pool costs nothing per tick (reference:
+        per-peer broadcastTxRoutine over persistent lane iterators)."""
+        sent: set[bytes] = set()
+        last_seq = -1
+        try:
+            while True:
+                if self.mempool._seq == last_seq:
+                    await asyncio.sleep(0.05)
+                    continue
+                progress = False
+                for d in self.mempool._lane_txs.values():
+                    for e in list(d.values()):
+                        if e.key in sent or peer.id in e.senders:
+                            continue
+                        if peer.send(MEMPOOL_CHANNEL, encode(
+                                MESSAGE, {"txs": {"txs": [e.tx]}})):
+                            sent.add(e.key)
+                            progress = True
+                last_seq = self.mempool._seq
+                # bound the dedup set by live pool content
+                if len(sent) > 4 * max(1, self.mempool.size()):
+                    live = {e.key for d in
+                            self.mempool._lane_txs.values()
+                            for e in d.values()}
+                    sent &= live
+                await asyncio.sleep(0.02 if progress else 0.05)
+        except asyncio.CancelledError:
+            raise
+        except Exception as e:
+            self.logger.error("mempool gossip died", peer=peer.id[:12],
+                              err=str(e))
+            if self.switch is not None:
+                await self.switch.stop_peer(peer, str(e))

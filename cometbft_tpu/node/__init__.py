@@ -1,0 +1,4 @@
+"""Node assembly: wiring every subsystem into one process."""
+from .node import Node, init_files
+
+__all__ = ["Node", "init_files"]

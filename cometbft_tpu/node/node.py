@@ -1,0 +1,247 @@
+"""Node: assembles DBs, state, ABCI, mempool, consensus, p2p, RPC.
+
+Reference: node/node.go:53 (Node struct), node/setup.go:102-750 (the
+constructors), boot order in OnStart (:~370): RPC listeners → transport
+listen → switch start (dials peers) → consensus.
+"""
+from __future__ import annotations
+
+import asyncio
+import os
+from typing import Optional
+
+from ..abci.client import AppConns, ClientCreator
+from ..abci.kvstore import KVStoreApplication
+from ..config import Config
+from ..consensus.reactor import ConsensusReactor
+from ..consensus.replay import Handshaker, catchup_replay
+from ..consensus.state import ConsensusState
+from ..consensus.wal import WAL
+from ..db import new_db
+from ..libs.log import Logger, new_logger, set_level
+from ..mempool import CListMempool
+from ..mempool.reactor import MempoolReactor
+from ..p2p.key import NodeKey
+from ..p2p.switch import Switch
+from ..privval import FilePV
+from ..state import make_genesis_state
+from ..state.execution import BlockExecutor
+from ..state.store import Store
+from ..store import BlockStore
+from ..types.events import EventBus
+from ..types.genesis import GenesisDoc
+from ..abci import types as abci
+
+
+class NodeError(Exception):
+    pass
+
+
+def init_files(config: Config, chain_id: str = "") -> GenesisDoc:
+    """`cometbft init`: write node key, priv validator, genesis
+    (reference: cmd/cometbft/commands/init.go)."""
+    import secrets as _secrets
+    from ..types.genesis import GenesisValidator
+    from ..types.timestamp import Timestamp
+
+    home = config.base.home
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+
+    pv = FilePV.load_or_generate(
+        config.base.path(config.base.priv_validator_key_file),
+        config.base.path(config.base.priv_validator_state_file))
+    NodeKey.load_or_gen(config.base.path(config.base.node_key_file))
+
+    genesis_path = config.base.path(config.base.genesis_file)
+    if os.path.exists(genesis_path):
+        return GenesisDoc.from_file(genesis_path)
+    doc = GenesisDoc(
+        chain_id=chain_id or f"test-chain-{_secrets.token_hex(3)}",
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(
+            address=b"", pub_key=pv.get_pub_key(), power=10)],
+    )
+    doc.validate_and_complete()
+    doc.save_as(genesis_path)
+    return doc
+
+
+class Node:
+    def __init__(self, config: Config,
+                 app=None,
+                 genesis_doc: Optional[GenesisDoc] = None,
+                 logger: Optional[Logger] = None):
+        self.config = config
+        self.logger = logger if logger is not None else \
+            new_logger("node")
+        set_level(config.base.log_level)
+        home = config.base.home
+        db_dir = config.base.path(config.base.db_dir)
+
+        # --- genesis & identity -----------------------------------------
+        self.genesis_doc = genesis_doc if genesis_doc is not None else \
+            GenesisDoc.from_file(config.base.path(
+                config.base.genesis_file))
+        self.node_key = NodeKey.load_or_gen(
+            config.base.path(config.base.node_key_file))
+        self.priv_validator = FilePV.load_or_generate(
+            config.base.path(config.base.priv_validator_key_file),
+            config.base.path(config.base.priv_validator_state_file))
+
+        # --- storage ----------------------------------------------------
+        backend = config.base.db_backend
+        self.block_store = BlockStore(new_db("blockstore", backend,
+                                             db_dir))
+        self.state_store = Store(new_db("state", backend, db_dir))
+
+        # --- application ------------------------------------------------
+        if app is None:
+            if config.base.proxy_app in ("kvstore", "persistent_kvstore"):
+                app = KVStoreApplication(
+                    db=new_db("app", backend, db_dir))
+            else:
+                raise NodeError(
+                    f"unknown proxy_app {config.base.proxy_app!r} "
+                    f"(pass an Application instance for custom apps)")
+        self.app = app
+        self.app_conns = ClientCreator(
+            app=app, transport=config.base.abci).new_app_conns()
+
+        # --- state ------------------------------------------------------
+        state = self.state_store.load()
+        if state is None:
+            state = make_genesis_state(self.genesis_doc)
+            self.state_store.save(state)
+        self.initial_state = state
+
+        # --- event bus --------------------------------------------------
+        self.event_bus = EventBus()
+
+        # --- mempool ----------------------------------------------------
+        self.mempool: Optional[CListMempool] = None
+        self.mempool_reactor: Optional[MempoolReactor] = None
+
+        # --- consensus (created in start after handshake) ---------------
+        self.consensus_state: Optional[ConsensusState] = None
+        self.consensus_reactor: Optional[ConsensusReactor] = None
+
+        # --- p2p --------------------------------------------------------
+        self.switch = Switch(
+            self.node_key, self.genesis_doc.chain_id,
+            listen_addr=config.p2p.laddr.replace("tcp://", ""),
+            moniker=config.base.moniker)
+
+        self._rpc_server = None
+        self._started = False
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        """Boot order mirrors node.OnStart."""
+        cfg = self.config
+
+        # ABCI handshake reconciles app and store
+        handshaker = Handshaker(self.state_store, self.initial_state,
+                                self.block_store, self.genesis_doc,
+                                logger=new_logger("handshaker"))
+        await handshaker.handshake(self.app_conns)
+        state = self.state_store.load() or self.initial_state
+
+        # mempool (lanes from the app's Info)
+        info = await self.app_conns.query.info(abci.InfoRequest())
+        self.mempool = CListMempool(
+            cfg.mempool, self.app_conns.mempool,
+            lanes=info.lane_priorities or None,
+            default_lane=info.default_lane,
+            height=state.last_block_height)
+
+        block_exec = BlockExecutor(
+            self.state_store, self.app_conns.consensus,
+            mempool=self.mempool, event_bus=self.event_bus,
+            block_store=self.block_store)
+
+        wal_path = cfg.base.path(cfg.consensus.wal_file)
+        self.consensus_state = ConsensusState(
+            cfg.consensus, state, block_exec, self.block_store,
+            priv_validator=self.priv_validator,
+            event_bus=self.event_bus, wal=WAL(wal_path))
+        await catchup_replay(self.consensus_state, wal_path)
+
+        self.consensus_reactor = ConsensusReactor(self.consensus_state)
+        self.switch.add_reactor(self.consensus_reactor)
+        self.mempool_reactor = MempoolReactor(self.mempool, cfg.mempool)
+        self.switch.add_reactor(self.mempool_reactor)
+
+        # RPC before p2p (reference: OnStart order)
+        if cfg.rpc.laddr:
+            from ..rpc.server import RPCServer
+            self._rpc_server = RPCServer(self, cfg.rpc)
+            await self._rpc_server.start()
+
+        await self.switch.start()
+        if cfg.p2p.persistent_peers:
+            addrs = [a.strip() for a in
+                     cfg.p2p.persistent_peers.split(",") if a.strip()]
+            self.switch.dial_peers_async(
+                [a.split("@")[-1] for a in addrs])
+
+        await self.consensus_state.start()
+        self._started = True
+        self.logger.info("Node started",
+                         node_id=self.node_key.id[:12],
+                         chain=self.genesis_doc.chain_id)
+
+    async def stop(self) -> None:
+        if self.consensus_state is not None:
+            await self.consensus_state.stop()
+        await self.switch.stop()
+        if self._rpc_server is not None:
+            await self._rpc_server.stop()
+        self._started = False
+        self.logger.info("Node stopped")
+
+    # ------------------------------------------------------------------
+    @property
+    def height(self) -> int:
+        return self.block_store.height
+
+    def status(self) -> dict:
+        state = self.state_store.load()
+        latest_meta = self.block_store.load_block_meta(
+            self.block_store.height)
+        pub = self.priv_validator.get_pub_key()
+        return {
+            "node_info": {
+                "id": self.node_key.id,
+                "listen_addr": self.switch.listen_addr,
+                "network": self.genesis_doc.chain_id,
+                "moniker": self.config.base.moniker,
+            },
+            "sync_info": {
+                "latest_block_hash":
+                    latest_meta.block_id.hash.hex().upper()
+                    if latest_meta else "",
+                "latest_app_hash":
+                    (state.app_hash.hex().upper() if state else ""),
+                "latest_block_height": str(self.block_store.height),
+                "latest_block_time":
+                    latest_meta.header.time.rfc3339()
+                    if latest_meta else "",
+                "earliest_block_height": str(self.block_store.base),
+                "catching_up": False,
+            },
+            "validator_info": {
+                "address": pub.address().hex().upper(),
+                "pub_key": {"type": "tendermint/PubKeyEd25519",
+                            "value": __import__("base64").b64encode(
+                                pub.bytes()).decode()},
+                "voting_power": str(_voting_power(state, pub)),
+            },
+        }
+
+
+def _voting_power(state, pub) -> int:
+    if state is None or state.validators is None:
+        return 0
+    _, val = state.validators.get_by_address(pub.address())
+    return val.voting_power if val else 0

@@ -19,7 +19,6 @@ from ..libs.log import Logger, new_logger
 MAX_PACKET_PAYLOAD_SIZE = 1024
 _PING_INTERVAL_S = 60.0
 _PONG_TIMEOUT_S = 45.0
-_FLUSH_THROTTLE_S = 0.01
 
 # packet types
 _PKT_PING = 0x01
@@ -97,12 +96,13 @@ class MConnection:
         self.logger = logger if logger is not None else \
             new_logger("mconn")
         self._send_event = asyncio.Event()
-        self._pong_pending = False
         self._tasks: list[asyncio.Task] = []
         self._closed = False
+        self._last_recv = 0.0
 
     def start(self) -> None:
         loop = asyncio.get_running_loop()
+        self._last_recv = loop.time()
         self._tasks = [
             loop.create_task(self._send_routine()),
             loop.create_task(self._recv_routine()),
@@ -158,11 +158,6 @@ class MConnection:
                 ch = self._pick_channel()
                 if ch is None:
                     self._send_event.clear()
-                    if self._pong_pending:
-                        self._pong_pending = False
-                        await self._sconn.write_msg(
-                            bytes([_PKT_PONG]))
-                        continue
                     await self._send_event.wait()
                     continue
                 payload, eof = ch.next_packet()
@@ -182,12 +177,15 @@ class MConnection:
         try:
             while not self._closed:
                 msg = await self._sconn.read_msg()
+                self._last_recv = asyncio.get_running_loop().time()
                 if not msg:
                     raise MConnectionError("empty packet")
                 ptype = msg[0]
                 if ptype == _PKT_PING:
-                    self._pong_pending = True
-                    self._send_event.set()
+                    # reply immediately — write_msg buffers whole
+                    # frames synchronously, so it interleaves safely
+                    # with the send routine at frame granularity
+                    await self._sconn.write_msg(bytes([_PKT_PONG]))
                 elif ptype == _PKT_PONG:
                     pass
                 elif ptype == _PKT_MSG:
@@ -212,10 +210,18 @@ class MConnection:
             self._fail(e)
 
     async def _ping_routine(self) -> None:
+        """Keepalive + dead-link detection: if nothing at all has been
+        received for a ping interval plus the pong timeout, the link is
+        declared dead (reference: pongTimeout teardown)."""
         try:
             while not self._closed:
                 await asyncio.sleep(_PING_INTERVAL_S)
                 await self._sconn.write_msg(bytes([_PKT_PING]))
+                now = asyncio.get_running_loop().time()
+                if now - self._last_recv > \
+                        _PING_INTERVAL_S + _PONG_TIMEOUT_S:
+                    raise MConnectionError(
+                        "pong timeout: connection is dead")
         except asyncio.CancelledError:
             raise
         except Exception as e:

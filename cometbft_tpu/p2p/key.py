@@ -42,6 +42,7 @@ class NodeKey:
                         self.priv_key.bytes()).decode(),
                 }
             }, f, indent=2)
+        os.chmod(path, 0o600)   # private key: owner-only
 
     @classmethod
     def load(cls, path: str) -> "NodeKey":

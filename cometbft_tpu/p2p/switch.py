@@ -280,23 +280,44 @@ class Switch:
                 self._dial_loop(addr, persistent)))
 
     async def _dial_loop(self, addr: str, persistent: bool) -> None:
+        """Dial with backoff; persistent peers are re-dialed forever
+        after any disconnect (reference: reconnectToPeer)."""
         backoff = 0.2
         while True:
+            peer = None
             try:
-                await self.dial_peer(addr)
-                return
+                peer = await self.dial_peer(addr)
             except SwitchError as e:
-                if "duplicate peer" in str(e) or \
-                        "connected to self" in str(e):
+                if "connected to self" in str(e):
                     return
+                if "duplicate peer" in str(e):
+                    peer = "duplicate"
             except (ConnectionError, OSError):
                 pass
             except asyncio.CancelledError:
                 raise
+            if peer is None:
+                if not persistent:
+                    return
+                await asyncio.sleep(backoff)
+                backoff = min(backoff * 2, 10.0)
+                continue
             if not persistent:
                 return
-            await asyncio.sleep(backoff)
-            backoff = min(backoff * 2, 10.0)
+            backoff = 0.2
+            # watch for disconnect, then re-dial
+            peer_id = peer.id if isinstance(peer, Peer) else None
+            while True:
+                await asyncio.sleep(1.0)
+                if peer_id is not None:
+                    if peer_id not in self.peers:
+                        break
+                else:
+                    # duplicate: find the live peer for this addr
+                    if not any(p.remote_addr == addr or
+                               p.node_info.listen_addr == addr
+                               for p in self.peers.values()):
+                        break
 
 
 def _split_addr(addr: str) -> tuple[str, int]:

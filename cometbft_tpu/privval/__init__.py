@@ -1,0 +1,4 @@
+"""Validator key management and signing with double-sign protection."""
+from .file import FilePV, DoubleSignError, PrivValidatorError
+
+__all__ = ["FilePV", "DoubleSignError", "PrivValidatorError"]

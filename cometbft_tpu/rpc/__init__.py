@@ -1,0 +1,1 @@
+"""RPC: JSON-RPC 2.0 over HTTP (POST + URI GET) and the method table."""

@@ -1,0 +1,459 @@
+"""RPC method implementations.
+
+Reference: rpc/core/ — env.go (the Environment), routes.go (the method
+table), {status,blocks,mempool,abci,consensus,net}.go.  JSON shapes
+follow the reference's response schemas (hex block hashes, base64 tx
+bytes, stringified int64s).
+"""
+from __future__ import annotations
+
+import base64
+from typing import Optional
+
+from ..abci import types as abci
+from ..mempool.mempool import InvalidTxError, MempoolError, TxInCacheError
+from ..types.tx import tx_hash
+
+
+class Environment:
+    """Reference: rpc/core/env.go — references into the node."""
+
+    def __init__(self, node):
+        self.node = node
+
+    @property
+    def block_store(self):
+        return self.node.block_store
+
+    @property
+    def state_store(self):
+        return self.node.state_store
+
+    @property
+    def mempool(self):
+        return self.node.mempool
+
+    @property
+    def consensus(self):
+        return self.node.consensus_state
+
+
+def routes(env: Environment) -> dict:
+    """Reference: rpc/core/routes.go:15."""
+    return {
+        "health": lambda: _health(env),
+        "status": lambda: _status(env),
+        "net_info": lambda: _net_info(env),
+        "genesis": lambda: _genesis(env),
+        "abci_info": lambda: _abci_info(env),
+        "abci_query": lambda path="", data="", height="0",
+        prove=False: _abci_query(env, path, data, height, prove),
+        "broadcast_tx_sync": lambda tx="":
+            _broadcast_tx_sync(env, tx),
+        "broadcast_tx_async": lambda tx="":
+            _broadcast_tx_async(env, tx),
+        "broadcast_tx_commit": lambda tx="":
+            _broadcast_tx_commit(env, tx),
+        "unconfirmed_txs": lambda limit="30":
+            _unconfirmed_txs(env, limit),
+        "num_unconfirmed_txs": lambda: _num_unconfirmed_txs(env),
+        "block": lambda height="0": _block(env, height),
+        "block_by_hash": lambda hash="": _block_by_hash(env, hash),
+        "block_results": lambda height="0": _block_results(env, height),
+        "commit": lambda height="0": _commit(env, height),
+        "blockchain": lambda minHeight="0", maxHeight="0":
+            _blockchain(env, minHeight, maxHeight),
+        "validators": lambda height="0", page="1", per_page="30":
+            _validators(env, height, page, per_page),
+        "consensus_state": lambda: _consensus_state(env),
+        "consensus_params": lambda height="0":
+            _consensus_params(env, height),
+    }
+
+
+async def _health(env):
+    return {}
+
+
+async def _status(env):
+    return env.node.status()
+
+
+async def _net_info(env):
+    sw = env.node.switch
+    return {
+        "listening": bool(sw.listen_addr),
+        "listeners": [sw.listen_addr],
+        "n_peers": str(sw.num_peers()),
+        "peers": [
+            {"node_info": {"id": p.id,
+                           "moniker": p.node_info.moniker,
+                           "network": p.node_info.network},
+             "is_outbound": p.outbound,
+             "remote_ip": p.remote_addr.rsplit(":", 1)[0]}
+            for p in sw.peers.values()
+        ],
+    }
+
+
+async def _genesis(env):
+    import json as _json
+    return {"genesis": _json.loads(env.node.genesis_doc.to_json())}
+
+
+async def _abci_info(env):
+    res = await env.node.app_conns.query.info(abci.InfoRequest())
+    return {"response": {
+        "data": res.data, "version": res.version,
+        "app_version": str(res.app_version),
+        "last_block_height": str(res.last_block_height),
+        "last_block_app_hash": base64.b64encode(
+            res.last_block_app_hash).decode(),
+    }}
+
+
+async def _abci_query(env, path, data, height, prove):
+    raw = _decode_hex_or_str(data)
+    res = await env.node.app_conns.query.query(abci.QueryRequest(
+        data=raw, path=path, height=int(height),
+        prove=_parse_bool(prove)))
+    return {"response": {
+        "code": res.code, "log": res.log, "info": res.info,
+        "index": str(res.index),
+        "key": base64.b64encode(res.key).decode(),
+        "value": base64.b64encode(res.value).decode(),
+        "height": str(res.height), "codespace": res.codespace,
+    }}
+
+
+def _check_tx_result(tx: bytes, res) -> dict:
+    return {
+        "code": res.code, "data": base64.b64encode(res.data).decode(),
+        "log": res.log, "codespace": res.codespace,
+        "hash": tx_hash(tx).hex().upper(),
+    }
+
+
+async def _broadcast_tx_sync(env, tx):
+    raw = _decode_tx(tx)
+    try:
+        res = await env.mempool.check_tx(raw)
+    except InvalidTxError as e:
+        return {"code": e.code, "data": "", "log": str(e),
+                "codespace": "", "hash": tx_hash(raw).hex().upper()}
+    except TxInCacheError:
+        from .server import RPCError
+        raise RPCError(-32603, "tx already exists in cache")
+    except MempoolError as e:
+        from .server import RPCError
+        raise RPCError(-32603, str(e))
+    return _check_tx_result(raw, res)
+
+
+async def _broadcast_tx_async(env, tx):
+    import asyncio as _asyncio
+    raw = _decode_tx(tx)
+
+    async def _bg():
+        try:
+            await env.mempool.check_tx(raw)
+        except MempoolError:
+            pass
+    _asyncio.get_running_loop().create_task(_bg())
+    return {"code": 0, "data": "", "log": "", "codespace": "",
+            "hash": tx_hash(raw).hex().upper()}
+
+
+async def _broadcast_tx_commit(env, tx):
+    """CheckTx, then wait for the tx to land in a block (reference:
+    rpc/core/mempool.go BroadcastTxCommit via event subscription)."""
+    import asyncio as _asyncio
+    raw = _decode_tx(tx)
+    key = tx_hash(raw)
+    sub = env.node.event_bus.subscribe(
+        f"rpc-tx-{key.hex()[:16]}",
+        f"tm.event = 'Tx' AND tx.hash = '{key.hex().upper()}'")
+    try:
+        try:
+            check = await env.mempool.check_tx(raw)
+        except InvalidTxError as e:
+            return {"check_tx": {"code": e.code, "log": str(e)},
+                    "tx_result": {}, "hash": key.hex().upper(),
+                    "height": "0"}
+        timeout = env.node.config.rpc \
+            .timeout_broadcast_tx_commit_ns / 1e9
+        try:
+            msg = await _asyncio.wait_for(sub.next(), timeout)
+        except _asyncio.TimeoutError:
+            from .server import RPCError
+            raise RPCError(-32603,
+                           "timed out waiting for tx to be included "
+                           "in a block")
+        payload = msg.data.payload
+        res = payload["result"]
+        return {
+            "check_tx": _check_tx_result(raw, check),
+            "tx_result": {
+                "code": res.code,
+                "data": base64.b64encode(res.data).decode(),
+                "log": res.log,
+                "gas_wanted": str(res.gas_wanted),
+                "gas_used": str(res.gas_used),
+            },
+            "hash": key.hex().upper(),
+            "height": str(payload["height"]),
+        }
+    finally:
+        try:
+            env.node.event_bus.unsubscribe_all(
+                f"rpc-tx-{key.hex()[:16]}")
+        except Exception:
+            pass
+
+
+async def _unconfirmed_txs(env, limit):
+    txs = env.mempool.reap_max_txs(int(limit))
+    return {
+        "n_txs": str(len(txs)),
+        "total": str(env.mempool.size()),
+        "total_bytes": str(env.mempool.size_bytes()),
+        "txs": [base64.b64encode(t).decode() for t in txs],
+    }
+
+
+async def _num_unconfirmed_txs(env):
+    return {"n_txs": str(env.mempool.size()),
+            "total": str(env.mempool.size()),
+            "total_bytes": str(env.mempool.size_bytes())}
+
+
+def _normalize_height(env, height) -> int:
+    h = int(height)
+    if h <= 0:
+        return env.block_store.height
+    return h
+
+
+async def _block(env, height):
+    h = _normalize_height(env, height)
+    block = env.block_store.load_block(h)
+    meta = env.block_store.load_block_meta(h)
+    if block is None or meta is None:
+        from .server import RPCError
+        raise RPCError(-32603, f"block at height {h} not found")
+    return {"block_id": _block_id_json(meta.block_id),
+            "block": _block_json(block)}
+
+
+async def _block_by_hash(env, hash):
+    raw = _decode_hex_or_str(hash)
+    block = env.block_store.load_block_by_hash(raw)
+    meta = env.block_store.load_block_meta_by_hash(raw)
+    if block is None or meta is None:
+        from .server import RPCError
+        raise RPCError(-32603, "block not found")
+    return {"block_id": _block_id_json(meta.block_id),
+            "block": _block_json(block)}
+
+
+async def _block_results(env, height):
+    h = _normalize_height(env, height)
+    resp = env.state_store.load_finalize_block_response(h)
+    if resp is None:
+        from .server import RPCError
+        raise RPCError(-32603, f"no results for height {h}")
+    return {
+        "height": str(h),
+        "txs_results": [
+            {"code": r.code,
+             "data": base64.b64encode(r.data).decode(),
+             "log": r.log, "gas_wanted": str(r.gas_wanted),
+             "gas_used": str(r.gas_used),
+             "events": _events_json(r.events)}
+            for r in resp.tx_results],
+        "finalize_block_events": _events_json(resp.events),
+        "validator_updates": [
+            {"pub_key_type": v.pub_key_type,
+             "pub_key_bytes": base64.b64encode(
+                 v.pub_key_bytes).decode(),
+             "power": str(v.power)}
+            for v in resp.validator_updates],
+        "app_hash": resp.app_hash.hex().upper(),
+    }
+
+
+async def _commit(env, height):
+    h = _normalize_height(env, height)
+    meta = env.block_store.load_block_meta(h)
+    commit = env.block_store.load_block_commit(h)
+    canonical = True
+    if commit is None:
+        commit = env.block_store.load_seen_commit(h)
+        canonical = False
+    if meta is None or commit is None:
+        from .server import RPCError
+        raise RPCError(-32603, f"commit for height {h} not found")
+    return {
+        "signed_header": {
+            "header": _header_json(meta.header),
+            "commit": _commit_json(commit),
+        },
+        "canonical": canonical,
+    }
+
+
+async def _blockchain(env, min_height, max_height):
+    base, height = env.block_store.base, env.block_store.height
+    min_h = max(int(min_height) or base, base)
+    max_h = min(int(max_height) or height, height)
+    metas = []
+    for h in range(max_h, min_h - 1, -1):
+        m = env.block_store.load_block_meta(h)
+        if m is not None:
+            metas.append({
+                "block_id": _block_id_json(m.block_id),
+                "block_size": str(m.block_size),
+                "header": _header_json(m.header),
+                "num_txs": str(m.num_txs),
+            })
+    return {"last_height": str(height), "block_metas": metas}
+
+
+async def _validators(env, height, page, per_page):
+    h = _normalize_height(env, height)
+    vals = env.state_store.load_validators(h)
+    page_i, per = max(1, int(page)), min(100, int(per_page))
+    start = (page_i - 1) * per
+    sel = vals.validators[start:start + per]
+    return {
+        "block_height": str(h),
+        "validators": [
+            {"address": v.address.hex().upper(),
+             "pub_key": {"type": "tendermint/PubKeyEd25519",
+                         "value": base64.b64encode(
+                             v.pub_key.bytes()).decode()},
+             "voting_power": str(v.voting_power),
+             "proposer_priority": str(v.proposer_priority)}
+            for v in sel],
+        "count": str(len(sel)),
+        "total": str(vals.size()),
+    }
+
+
+async def _consensus_state(env):
+    rs = env.consensus.rs
+    return {"round_state": {
+        "height/round/step":
+            f"{rs.height}/{rs.round}/{rs.step}",
+        "start_time": rs.start_time.rfc3339(),
+        "proposal_block_hash":
+            rs.proposal_block.hash().hex().upper()
+            if rs.proposal_block else "",
+        "locked_block_hash":
+            rs.locked_block.hash().hex().upper()
+            if rs.locked_block else "",
+        "valid_block_hash":
+            rs.valid_block.hash().hex().upper()
+            if rs.valid_block else "",
+    }}
+
+
+async def _consensus_params(env, height):
+    h = _normalize_height(env, height)
+    params = env.state_store.load_consensus_params(h)
+    return {"block_height": str(h), "consensus_params": {
+        "block": {"max_bytes": str(params.block.max_bytes),
+                  "max_gas": str(params.block.max_gas)},
+        "evidence": {
+            "max_age_num_blocks":
+                str(params.evidence.max_age_num_blocks),
+            "max_age_duration":
+                str(params.evidence.max_age_duration_ns),
+            "max_bytes": str(params.evidence.max_bytes)},
+        "validator": {"pub_key_types":
+                      list(params.validator.pub_key_types)},
+    }}
+
+
+# ---------------------------------------------------------------------------
+# JSON shaping helpers
+
+
+def _block_id_json(bid) -> dict:
+    return {"hash": bid.hash.hex().upper(),
+            "parts": {"total": bid.part_set_header.total,
+                      "hash": bid.part_set_header.hash.hex().upper()}}
+
+
+def _header_json(h) -> dict:
+    return {
+        "version": {"block": str(h.version.block),
+                    "app": str(h.version.app)},
+        "chain_id": h.chain_id,
+        "height": str(h.height),
+        "time": h.time.rfc3339(),
+        "last_block_id": _block_id_json(h.last_block_id),
+        "last_commit_hash": h.last_commit_hash.hex().upper(),
+        "data_hash": h.data_hash.hex().upper(),
+        "validators_hash": h.validators_hash.hex().upper(),
+        "next_validators_hash": h.next_validators_hash.hex().upper(),
+        "consensus_hash": h.consensus_hash.hex().upper(),
+        "app_hash": h.app_hash.hex().upper(),
+        "last_results_hash": h.last_results_hash.hex().upper(),
+        "evidence_hash": h.evidence_hash.hex().upper(),
+        "proposer_address": h.proposer_address.hex().upper(),
+    }
+
+
+def _commit_json(c) -> dict:
+    return {
+        "height": str(c.height), "round": c.round,
+        "block_id": _block_id_json(c.block_id),
+        "signatures": [
+            {"block_id_flag": s.block_id_flag,
+             "validator_address": s.validator_address.hex().upper(),
+             "timestamp": s.timestamp.rfc3339(),
+             "signature": base64.b64encode(s.signature).decode()
+             if s.signature else None}
+            for s in c.signatures],
+    }
+
+
+def _block_json(b) -> dict:
+    return {
+        "header": _header_json(b.header),
+        "data": {"txs": [base64.b64encode(t).decode()
+                         for t in b.data.txs]},
+        "evidence": {"evidence": []},
+        "last_commit": _commit_json(b.last_commit)
+        if b.last_commit is not None else None,
+    }
+
+
+def _events_json(events) -> list:
+    return [{"type": e.type, "attributes": [
+        {"key": a.key, "value": a.value, "index": a.index}
+        for a in e.attributes]} for e in events or []]
+
+
+def _decode_tx(tx) -> bytes:
+    """Txs arrive base64 (JSON-RPC) or 0x-hex (URI)."""
+    if isinstance(tx, bytes):
+        return tx
+    if tx.startswith("0x"):
+        return bytes.fromhex(tx[2:])
+    return base64.b64decode(tx)
+
+
+def _decode_hex_or_str(v) -> bytes:
+    if isinstance(v, bytes):
+        return v
+    if v.startswith("0x"):
+        return bytes.fromhex(v[2:])
+    return v.encode()
+
+
+def _parse_bool(v) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).lower() in ("true", "1")

@@ -1,0 +1,166 @@
+"""JSON-RPC 2.0 server over HTTP.
+
+Reference: rpc/jsonrpc/server/ (http_json_handler.go POST dispatch,
+http_uri_handler.go GET-with-query-params), rpc/core/routes.go (method
+table), rpc/core/env.go (the environment of store/mempool/consensus
+references the methods close over).  asyncio-native minimal HTTP/1.1 —
+the RPC surface, not a general web server.
+"""
+from __future__ import annotations
+
+import asyncio
+import base64
+import json
+from typing import Optional
+from urllib.parse import parse_qsl, urlsplit
+
+from ..config import RPCConfig
+from ..libs.log import new_logger
+from . import core
+
+
+class RPCError(Exception):
+    def __init__(self, code: int, message: str, data: str = ""):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+        self.data = data
+
+
+class RPCServer:
+    def __init__(self, node, config: RPCConfig):
+        self.node = node
+        self.config = config
+        self.logger = new_logger("rpc")
+        self.env = core.Environment(node)
+        self.routes = core.routes(self.env)
+        self._server: Optional[asyncio.base_events.Server] = None
+        self.listen_addr = ""
+
+    async def start(self) -> None:
+        addr = self.config.laddr.replace("tcp://", "")
+        host, port = addr.rsplit(":", 1)
+        self._server = await asyncio.start_server(
+            self._handle_conn, host or "127.0.0.1", int(port))
+        sock = self._server.sockets[0].getsockname()
+        self.listen_addr = f"{sock[0]}:{sock[1]}"
+        self.logger.info("RPC listening", addr=self.listen_addr)
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    @property
+    def port(self) -> int:
+        return int(self.listen_addr.rsplit(":", 1)[1])
+
+    # ------------------------------------------------------------------
+    async def _handle_conn(self, reader: asyncio.StreamReader,
+                           writer: asyncio.StreamWriter) -> None:
+        try:
+            while True:
+                request_line = await reader.readline()
+                if not request_line:
+                    return
+                try:
+                    method, target, _ = \
+                        request_line.decode().strip().split(" ", 2)
+                except ValueError:
+                    return
+                headers = {}
+                while True:
+                    line = await reader.readline()
+                    if line in (b"\r\n", b"\n", b""):
+                        break
+                    k, _, v = line.decode().partition(":")
+                    headers[k.strip().lower()] = v.strip()
+                body = b""
+                clen = int(headers.get("content-length", 0) or 0)
+                if clen:
+                    if clen > self.config.max_body_bytes:
+                        return
+                    body = await reader.readexactly(clen)
+                resp = await self._dispatch(method, target, body)
+                payload = json.dumps(resp).encode()
+                writer.write(
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    b"Content-Length: " +
+                    str(len(payload)).encode() + b"\r\n"
+                    b"Connection: keep-alive\r\n\r\n" + payload)
+                await writer.drain()
+                if headers.get("connection", "").lower() == "close":
+                    break
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+        finally:
+            try:
+                writer.close()
+            except Exception:
+                pass
+
+    async def _dispatch(self, http_method: str, target: str,
+                        body: bytes) -> dict:
+        if http_method == "POST":
+            try:
+                req = json.loads(body or b"{}")
+            except json.JSONDecodeError as e:
+                return _err_response(None, -32700,
+                                     "Parse error", str(e))
+            if isinstance(req, list):
+                return [await self._call_one(r) for r in req]
+            return await self._call_one(req)
+        # URI over GET: /method?param=value
+        parts = urlsplit(target)
+        name = parts.path.lstrip("/")
+        if not name:
+            return _err_response(
+                None, -32601, "Method not found",
+                "available: " + ", ".join(sorted(self.routes)))
+        params = {k: _parse_uri_value(v)
+                  for k, v in parse_qsl(parts.query)}
+        return await self._call(name, params, rpc_id=-1)
+
+    async def _call_one(self, req: dict) -> dict:
+        rpc_id = req.get("id")
+        name = req.get("method", "")
+        params = req.get("params") or {}
+        if isinstance(params, list):
+            return _err_response(rpc_id, -32602,
+                                 "Invalid params",
+                                 "positional params not supported")
+        return await self._call(name, params, rpc_id)
+
+    async def _call(self, name: str, params: dict, rpc_id) -> dict:
+        fn = self.routes.get(name)
+        if fn is None:
+            return _err_response(
+                rpc_id, -32601, "Method not found",
+                "available: " + ", ".join(sorted(self.routes)))
+        try:
+            result = await fn(**params)
+        except RPCError as e:
+            return _err_response(rpc_id, e.code, e.message, e.data)
+        except TypeError as e:
+            return _err_response(rpc_id, -32602, "Invalid params",
+                                 str(e))
+        except Exception as e:
+            self.logger.error("RPC method failed", method=name,
+                              err=str(e))
+            return _err_response(rpc_id, -32603, "Internal error",
+                                 str(e))
+        return {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+
+
+def _err_response(rpc_id, code: int, message: str,
+                  data: str = "") -> dict:
+    return {"jsonrpc": "2.0", "id": rpc_id,
+            "error": {"code": code, "message": message, "data": data}}
+
+
+def _parse_uri_value(v: str):
+    """URI params: 0x-hex → bytes-as-hex-string, quoted strings
+    unquoted (reference: http_uri_handler parsing)."""
+    if v.startswith('"') and v.endswith('"'):
+        return v[1:-1]
+    return v
