@@ -1,0 +1,5 @@
+"""Blocksync (fast sync): catch up by downloading committed blocks."""
+from .reactor import BlocksyncReactor
+from .pool import BlockPool
+
+__all__ = ["BlocksyncReactor", "BlockPool"]

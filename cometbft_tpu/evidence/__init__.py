@@ -1,0 +1,5 @@
+"""Evidence: pool + verification + gossip of validator misbehavior."""
+from .pool import EvidencePool, EvidenceError
+from .reactor import EvidenceReactor
+
+__all__ = ["EvidencePool", "EvidenceError", "EvidenceReactor"]

@@ -1,0 +1,228 @@
+"""Evidence pool: db-backed pending misbehavior evidence.
+
+Reference: internal/evidence/pool.go — pending evidence keyed by
+(height, hash), committed markers, expiry by age (blocks AND duration),
+ReportConflictingVotes from consensus, verification (verify.go).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..db import DB
+from ..libs.log import Logger, new_logger
+from ..state.state import State as SMState
+from ..types.evidence import (
+    DuplicateVoteEvidence, Evidence, LightClientAttackEvidence,
+    evidence_from_proto_wrapped,
+)
+from ..types.timestamp import Timestamp
+from ..types.vote import Vote
+from ..wire import pb, encode, decode
+
+_PENDING = b"\x00"
+_COMMITTED = b"\x01"
+
+
+def _key(prefix: bytes, height: int, ev_hash: bytes) -> bytes:
+    return prefix + struct.pack(">q", height) + ev_hash
+
+
+class EvidenceError(Exception):
+    pass
+
+
+class EvidencePool:
+    def __init__(self, db: DB, state_store, block_store,
+                 logger: Optional[Logger] = None):
+        self._db = db
+        self.state_store = state_store
+        self.block_store = block_store
+        self.logger = logger if logger is not None else \
+            new_logger("evidence")
+        self.state: Optional[SMState] = state_store.load()
+        # bumped whenever the pending set changes, so reactors can skip
+        # rescans when nothing moved
+        self.version = 0
+        # evidence from our own conflicting-vote reports awaiting
+        # block time assignment
+        self._consensus_buffer: list[tuple[Vote, Vote]] = []
+
+    # ------------------------------------------------------------------
+    def report_conflicting_votes(self, vote_a: Vote,
+                                 vote_b: Vote) -> None:
+        """Called by consensus on detected equivocation (reference:
+        pool.ReportConflictingVotes; processed on the next Update)."""
+        self._consensus_buffer.append((vote_a, vote_b))
+
+    def add_evidence(self, ev: Evidence) -> None:
+        """Verify + persist gossiped/rpc evidence (reference:
+        AddEvidence)."""
+        if self._is_pending(ev) or self._is_committed(ev):
+            return
+        self.verify(ev)
+        self._add_pending(ev)
+        self.logger.info("Verified new evidence of byzantine behavior",
+                         evidence=ev.hash().hex().upper()[:12])
+
+    # ------------------------------------------------------------------
+    def verify(self, ev: Evidence) -> None:
+        """Reference: internal/evidence/verify.go."""
+        state = self.state or self.state_store.load()
+        if state is None:
+            raise EvidenceError("no state to verify evidence against")
+        height = state.last_block_height
+        ev_params = state.consensus_params.evidence
+
+        block_meta = self.block_store.load_block_meta(ev.height)
+        if block_meta is None:
+            raise EvidenceError(
+                f"don't have header at height {ev.height}")
+        ev_time = block_meta.header.time
+
+        # expiry: BOTH age thresholds must pass for expiry
+        age_blocks = height - ev.height
+        age_ns = Timestamp.now().unix_ns() - ev_time.unix_ns()
+        if age_blocks > ev_params.max_age_num_blocks and \
+                age_ns > ev_params.max_age_duration_ns:
+            raise EvidenceError(
+                f"evidence from height {ev.height} is too old")
+
+        if isinstance(ev, DuplicateVoteEvidence):
+            self._verify_duplicate_vote(ev, state, ev_time)
+        elif isinstance(ev, LightClientAttackEvidence):
+            # full light-client attack reconstruction arrives with the
+            # light client detector wiring
+            raise EvidenceError(
+                "light client attack evidence verification requires "
+                "the light client detector")
+        else:
+            raise EvidenceError(f"unknown evidence type {type(ev)}")
+
+    def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence,
+                               state: SMState,
+                               ev_time: Timestamp) -> None:
+        """Reference: verify.go VerifyDuplicateVote."""
+        val_set = self.state_store.load_validators(ev.height)
+        _, val = val_set.get_by_address(
+            ev.vote_a.validator_address)
+        if val is None:
+            raise EvidenceError(
+                "address not a validator at evidence height")
+        ev.validate_basic()
+        ev.validate_abci()
+        if ev.total_voting_power != val_set.total_voting_power():
+            raise EvidenceError(
+                f"total voting power mismatch: "
+                f"{ev.total_voting_power} vs "
+                f"{val_set.total_voting_power()}")
+        if ev.validator_power != val.voting_power:
+            raise EvidenceError("validator power mismatch")
+        if ev.timestamp != ev_time:
+            raise EvidenceError("evidence time mismatch")
+        ev.vote_a.verify(state.chain_id, val.pub_key)
+        ev.vote_b.verify(state.chain_id, val.pub_key)
+
+    # ------------------------------------------------------------------
+    def pending_evidence(self, max_bytes: int
+                         ) -> tuple[list[Evidence], int]:
+        """Reference: PendingEvidence — for block proposal."""
+        out, size = [], 0
+        for _, raw in self._db.iterator(_PENDING,
+                                        _PENDING + b"\xff" * 9):
+            ev = evidence_from_proto_wrapped(
+                decode(pb.EVIDENCE, raw))
+            n = len(raw)
+            if max_bytes >= 0 and size + n > max_bytes:
+                break
+            out.append(ev)
+            size += n
+        return out, size
+
+    def check_evidence(self, evidence: list) -> None:
+        """Validate a proposed block's evidence list (reference:
+        CheckEvidence)."""
+        seen = set()
+        for ev in evidence:
+            h = ev.hash()
+            if h in seen:
+                raise EvidenceError("duplicate evidence in block")
+            seen.add(h)
+            if self._is_committed(ev):
+                raise EvidenceError("evidence was already committed")
+            if not self._is_pending(ev):
+                self.verify(ev)
+
+    def update(self, state: SMState, evidence: list) -> None:
+        """Post-commit: mark committed, prune expired, flush consensus
+        buffer (reference: pool.Update)."""
+        self.state = state
+        for ev in evidence:
+            self._mark_committed(ev)
+        self._process_consensus_buffer(state)
+        self._prune_expired(state)
+
+    def _process_consensus_buffer(self, state: SMState) -> None:
+        buf, self._consensus_buffer = self._consensus_buffer, []
+        for vote_a, vote_b in buf:
+            try:
+                block_meta = self.block_store.load_block_meta(
+                    vote_a.height)
+                if block_meta is None:
+                    continue
+                val_set = self.state_store.load_validators(
+                    vote_a.height)
+                ev = DuplicateVoteEvidence.new(
+                    vote_a, vote_b, block_meta.header.time, val_set)
+                if not self._is_pending(ev) and \
+                        not self._is_committed(ev):
+                    self._add_pending(ev)
+                    self.logger.info(
+                        "Generated duplicate-vote evidence",
+                        height=vote_a.height)
+            except Exception as e:
+                self.logger.error(
+                    "failed to generate evidence from conflicting "
+                    "votes", err=str(e))
+
+    # ------------------------------------------------------------------
+    def _bump_version(self) -> None:
+        self.version += 1
+
+    def _add_pending(self, ev: Evidence) -> None:
+        raw = encode(pb.EVIDENCE, ev.to_proto_wrapped())
+        self._db.set(_key(_PENDING, ev.height, ev.hash()), raw)
+        self._bump_version()
+
+    def _is_pending(self, ev: Evidence) -> bool:
+        return self._db.has(_key(_PENDING, ev.height, ev.hash()))
+
+    def _is_committed(self, ev: Evidence) -> bool:
+        return self._db.has(_key(_COMMITTED, ev.height, ev.hash()))
+
+    def _mark_committed(self, ev: Evidence) -> None:
+        self._db.set(_key(_COMMITTED, ev.height, ev.hash()), b"\x01")
+        self._db.delete(_key(_PENDING, ev.height, ev.hash()))
+        self._bump_version()
+
+    def _prune_expired(self, state: SMState) -> None:
+        """Expiry requires BOTH age thresholds (blocks AND duration) to
+        pass, same as verify (reference: isExpired)."""
+        params = state.consensus_params.evidence
+        height = state.last_block_height
+        now_ns = Timestamp.now().unix_ns()
+        for k, raw in list(self._db.iterator(
+                _PENDING, _PENDING + b"\xff" * 9)):
+            ev_height = struct.unpack(">q", k[1:9])[0]
+            if height - ev_height <= params.max_age_num_blocks:
+                continue
+            meta = self.block_store.load_block_meta(ev_height)
+            ev_time_ns = meta.header.time.unix_ns() \
+                if meta is not None else 0
+            if now_ns - ev_time_ns > params.max_age_duration_ns:
+                self._db.delete(k)
+                self._bump_version()
+
+    def all_pending(self) -> list[Evidence]:
+        out, _ = self.pending_evidence(-1)
+        return out

@@ -155,9 +155,18 @@ class Node:
             default_lane=info.default_lane,
             height=state.last_block_height)
 
+        # evidence pool
+        from ..evidence import EvidencePool
+        from ..evidence.reactor import EvidenceReactor
+        self.evidence_pool = EvidencePool(
+            new_db("evidence", cfg.base.db_backend,
+                   cfg.base.path(cfg.base.db_dir)),
+            self.state_store, self.block_store)
+
         block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
-            mempool=self.mempool, event_bus=self.event_bus,
+            mempool=self.mempool, evpool=self.evidence_pool,
+            event_bus=self.event_bus,
             block_store=self.block_store)
 
         wal_path = cfg.base.path(cfg.consensus.wal_file)
@@ -166,11 +175,45 @@ class Node:
             priv_validator=self.priv_validator,
             event_bus=self.event_bus, wal=WAL(wal_path))
         await catchup_replay(self.consensus_state, wal_path)
+        # WAL catchup can itself finalize a block — use the freshest
+        # state for the blocksync decision and reactor
+        state = self.state_store.load() or state
 
-        self.consensus_reactor = ConsensusReactor(self.consensus_state)
+        # blocksync decision (reference: setup.go — sync unless we are
+        # the only validator)
+        run_blocksync = (cfg.blocksync.enable and
+                         not _only_validator_is_us(
+                             state, self.priv_validator.get_pub_key()))
+
+        self.consensus_reactor = ConsensusReactor(
+            self.consensus_state, wait_sync=run_blocksync)
         self.switch.add_reactor(self.consensus_reactor)
         self.mempool_reactor = MempoolReactor(self.mempool, cfg.mempool)
         self.switch.add_reactor(self.mempool_reactor)
+        self.switch.add_reactor(EvidenceReactor(self.evidence_pool))
+
+        from ..blocksync import BlocksyncReactor
+
+        async def _switch_to_consensus(new_state, height):
+            """Reference: consensus.Reactor.SwitchToConsensus —
+            reconstruct LastCommit from the stored seen commit before
+            updating to the synced state."""
+            self.consensus_reactor.wait_sync = False
+            if new_state.last_block_height > 0:
+                self.consensus_state.rs.last_commit = None
+                self.consensus_state._reconstruct_last_commit_if_needed(
+                    new_state)
+            self.consensus_state.update_to_state(new_state)
+            await self.consensus_state.start()
+            self.logger.info("Switched from blocksync to consensus",
+                             height=height)
+
+        self.blocksync_reactor = BlocksyncReactor(
+            state, block_exec, self.block_store,
+            active=run_blocksync,
+            on_caught_up=_switch_to_consensus)
+        self.switch.add_reactor(self.blocksync_reactor)
+        self._run_blocksync = run_blocksync
 
         # RPC before p2p (reference: OnStart order)
         if cfg.rpc.laddr:
@@ -185,7 +228,10 @@ class Node:
             self.switch.dial_peers_async(
                 [a.split("@")[-1] for a in addrs])
 
-        await self.consensus_state.start()
+        if self._run_blocksync:
+            await self.blocksync_reactor.start_sync()
+        else:
+            await self.consensus_state.start()
         self._started = True
         self.logger.info("Node started",
                          node_id=self.node_key.id[:12],
@@ -245,3 +291,10 @@ def _voting_power(state, pub) -> int:
         return 0
     _, val = state.validators.get_by_address(pub.address())
     return val.voting_power if val else 0
+
+
+def _only_validator_is_us(state, pub) -> bool:
+    """Reference: node/setup.go onlyValidatorIsUs."""
+    if state.validators is None or state.validators.size() != 1:
+        return False
+    return state.validators.validators[0].address == pub.address()

@@ -1,0 +1,211 @@
+"""Blocksync (fast sync over sockets) and evidence pool tests."""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.blocksync import BlocksyncReactor
+from cometbft_tpu.config import test_config as _test_config
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.db import MemDB
+from cometbft_tpu.evidence import EvidenceError, EvidencePool
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import Vote
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+def _genesis(n=1):
+    pvs = [new_mock_pv() for _ in range(n)]
+    doc = GenesisDoc(
+        chain_id="bsync-test",
+        genesis_time=Timestamp(1700000000, 0),
+        validators=[GenesisValidator(address=b"",
+                                     pub_key=pv.get_pub_key(),
+                                     power=10) for pv in pvs])
+    return doc, pvs
+
+
+async def _grow_chain(doc, pv, n_blocks):
+    """Produce a chain with a running validator, then stop it."""
+    app = KVStoreApplication()
+    conns = AppConns(app)
+    ss, bs = Store(MemDB()), BlockStore(MemDB())
+    state = make_genesis_state(doc)
+    ss.save(state)
+    ex = BlockExecutor(ss, conns.consensus, block_store=bs)
+    cs = ConsensusState(_test_config().consensus, state, ex, bs,
+                        priv_validator=pv)
+    await cs.start()
+    while bs.height < n_blocks:
+        await asyncio.sleep(0.01)
+    await cs.stop()
+    return ss, bs, cs
+
+
+class TestBlocksync:
+    def test_fresh_node_syncs_from_peer(self):
+        async def go():
+            doc, pvs = _genesis(1)
+            src_ss, src_bs, src_cs = await _grow_chain(doc, pvs[0], 8)
+            target = src_bs.height
+
+            # source node: serves blocks only (no consensus running)
+            src_switch = Switch(NodeKey.generate(), doc.chain_id,
+                                listen_addr="127.0.0.1:0")
+            src_state = src_ss.load()
+            src_app = KVStoreApplication()
+            src_ex = BlockExecutor(src_ss,
+                                   AppConns(src_app).consensus,
+                                   block_store=src_bs)
+            src_reactor = BlocksyncReactor(src_state, src_ex, src_bs,
+                                           active=False)
+            src_switch.add_reactor(src_reactor)
+            await src_switch.start()
+
+            # fresh node: must replay the app too, so fresh app+stores
+            dst_app = KVStoreApplication()
+            dst_conns = AppConns(dst_app)
+            dst_ss, dst_bs = Store(MemDB()), BlockStore(MemDB())
+            dst_state = make_genesis_state(doc)
+            dst_ss.save(dst_state)
+            await dst_conns.consensus.init_chain(
+                __import__("cometbft_tpu.abci.types",
+                           fromlist=["InitChainRequest"])
+                .InitChainRequest(chain_id=doc.chain_id))
+            dst_ex = BlockExecutor(dst_ss, dst_conns.consensus,
+                                   block_store=dst_bs)
+            caught_up = asyncio.Event()
+            result = {}
+
+            async def on_caught_up(state, height):
+                result["state"] = state
+                result["height"] = height
+                caught_up.set()
+
+            dst_switch = Switch(NodeKey.generate(), doc.chain_id,
+                                listen_addr="127.0.0.1:0")
+            dst_reactor = BlocksyncReactor(dst_state, dst_ex, dst_bs,
+                                           active=True,
+                                           on_caught_up=on_caught_up)
+            dst_switch.add_reactor(dst_reactor)
+            await dst_switch.start()
+            await dst_reactor.start_sync()
+            await dst_switch.dial_peer(src_switch.listen_addr)
+
+            try:
+                await asyncio.wait_for(caught_up.wait(), 30)
+                assert dst_bs.height >= target - 1
+                # blocks match the source chain
+                for h in range(1, dst_bs.height + 1):
+                    assert dst_bs.load_block(h).hash() == \
+                        src_bs.load_block(h).hash()
+                # state advanced through execution
+                assert result["state"].last_block_height == \
+                    dst_bs.height
+            finally:
+                await dst_reactor.stop_sync()
+                await dst_switch.stop()
+                await src_switch.stop()
+        run(go())
+
+
+def _make_duplicate_votes(doc, pvs, state, height, store):
+    pv = pvs[0]
+    addr = pv.get_pub_key().address()
+    bids = [BlockID(hash=bytes([i]) * 32,
+                    part_set_header=PartSetHeader(1, bytes([i + 10]) * 32))
+            for i in (1, 2)]
+    votes = []
+    for bid in bids:
+        v = Vote(type=canonical.PREVOTE_TYPE, height=height, round=0,
+                 block_id=bid, timestamp=Timestamp(1700000050, 0),
+                 validator_address=addr, validator_index=0)
+        pv.sign_vote(doc.chain_id, v, sign_extension=False)
+        votes.append(v)
+    return votes
+
+
+class TestEvidencePool:
+    def test_conflicting_votes_become_evidence(self):
+        async def go():
+            doc, pvs = _genesis(1)
+            ss, bs, cs = await _grow_chain(doc, pvs[0], 3)
+            state = ss.load()
+            pool = EvidencePool(MemDB(), ss, bs)
+            v1, v2 = _make_duplicate_votes(doc, pvs, state, 2, bs)
+            pool.report_conflicting_votes(v1, v2)
+            pool.update(state, [])
+            pending, size = pool.pending_evidence(1 << 20)
+            assert len(pending) == 1
+            assert size > 0
+            ev = pending[0]
+            assert ev.height == 2
+            # the evidence round-trips verification
+            pool2 = EvidencePool(MemDB(), ss, bs)
+            pool2.add_evidence(ev)
+            assert len(pool2.all_pending()) == 1
+        run(go())
+
+    def test_check_evidence_rejects_committed(self):
+        async def go():
+            doc, pvs = _genesis(1)
+            ss, bs, cs = await _grow_chain(doc, pvs[0], 3)
+            state = ss.load()
+            pool = EvidencePool(MemDB(), ss, bs)
+            v1, v2 = _make_duplicate_votes(doc, pvs, state, 2, bs)
+            pool.report_conflicting_votes(v1, v2)
+            pool.update(state, [])
+            ev = pool.all_pending()[0]
+            pool.check_evidence([ev])   # pending: ok
+            pool.update(state, [ev])    # commit it
+            with pytest.raises(EvidenceError, match="committed"):
+                pool.check_evidence([ev])
+            assert pool.all_pending() == []
+        run(go())
+
+    def test_tampered_evidence_rejected(self):
+        async def go():
+            doc, pvs = _genesis(1)
+            ss, bs, cs = await _grow_chain(doc, pvs[0], 3)
+            state = ss.load()
+            pool = EvidencePool(MemDB(), ss, bs)
+            v1, v2 = _make_duplicate_votes(doc, pvs, state, 2, bs)
+            v2.signature = bytes(64)
+            from cometbft_tpu.types.evidence import (
+                DuplicateVoteEvidence,
+            )
+            meta = bs.load_block_meta(2)
+            vals = ss.load_validators(2)
+            ev = DuplicateVoteEvidence.new(
+                v1, v2, meta.header.time, vals)
+            with pytest.raises(Exception):
+                pool.add_evidence(ev)
+            assert pool.all_pending() == []
+        run(go())
