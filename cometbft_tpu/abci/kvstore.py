@@ -112,9 +112,12 @@ def assign_lane(tx: bytes) -> str:
 
 class KVStoreApplication(abci.Application):
     def __init__(self, db: Optional[DB] = None,
-                 lane_priorities: Optional[dict[str, int]] = DEFAULT_LANES):
+                 lane_priorities: Optional[dict[str, int]] = DEFAULT_LANES,
+                 snapshot_interval: int = 0):
         self.db = db if db is not None else MemDB()
         self.lane_priorities = dict(lane_priorities or {})
+        self.snapshot_interval = snapshot_interval
+        self._snapshots: dict[int, bytes] = {}
         self.retain_blocks = 0
         self.logger = new_logger("kvstore")
         self._staged_txs: list[bytes] = []
@@ -280,10 +283,81 @@ class KVStoreApplication(abci.Application):
                 raise RuntimeError(f"unexpected tx format: {tx!r}")
             self.db.set(_KV_PREFIX + parts[0], parts[1])
         self._save_state()
+        if self.snapshot_interval > 0 and self._height > 0 and \
+                self._height % self.snapshot_interval == 0:
+            self._snapshots[self._height] = self._serialize_state()
         resp = abci.CommitResponse()
         if self.retain_blocks > 0 and self._height >= self.retain_blocks:
             resp.retain_height = self._height - self.retain_blocks + 1
         return resp
+
+    # ------------------------------------------------------------------
+    # snapshots (reference: the e2e app's snapshot support; single-chunk
+    # full-state snapshots keyed by height)
+
+    def _serialize_state(self) -> bytes:
+        import json as _json
+        items = [[k.hex(), v.hex()] for k, v in self.db.iterator()]
+        return _json.dumps({"height": self._height,
+                            "size": self._size,
+                            "items": items}).encode()
+
+    def _restore_state(self, raw: bytes) -> None:
+        import json as _json
+        d = _json.loads(raw)
+        for k, _ in list(self.db.iterator()):
+            self.db.delete(k)
+        for k, v in d["items"]:
+            self.db.set(bytes.fromhex(k), bytes.fromhex(v))
+        self._load_state()
+        # rebuild the validator pubkey map from restored entries
+        self._val_addr_to_pubkey.clear()
+        for key, raw_power in self.db.iterator():
+            if key.startswith(VALIDATOR_PREFIX.encode()):
+                pub_b64 = key[len(VALIDATOR_PREFIX):]
+                pub = base64.b64decode(pub_b64)
+                from ..crypto import encoding as crypto_encoding
+                pk = crypto_encoding.pub_key_from_type_and_bytes(
+                    "ed25519", pub)
+                self._val_addr_to_pubkey[pk.address()] = ("ed25519",
+                                                          pub)
+
+    async def list_snapshots(self, req: abci.ListSnapshotsRequest
+                             ) -> abci.ListSnapshotsResponse:
+        from ..crypto import tmhash
+        snaps = [abci.Snapshot(height=h, format=1, chunks=1,
+                               hash=tmhash.sum(raw))
+                 for h, raw in sorted(self._snapshots.items())]
+        return abci.ListSnapshotsResponse(snapshots=snaps)
+
+    async def offer_snapshot(self, req: abci.OfferSnapshotRequest
+                             ) -> abci.OfferSnapshotResponse:
+        s = req.snapshot
+        if s is None or s.format != 1 or s.chunks != 1:
+            return abci.OfferSnapshotResponse(
+                result=abci.OFFER_SNAPSHOT_RESULT_REJECT_FORMAT)
+        self._restoring = s
+        return abci.OfferSnapshotResponse(
+            result=abci.OFFER_SNAPSHOT_RESULT_ACCEPT)
+
+    async def load_snapshot_chunk(self, req: abci.LoadSnapshotChunkRequest
+                                  ) -> abci.LoadSnapshotChunkResponse:
+        raw = self._snapshots.get(req.height, b"")
+        return abci.LoadSnapshotChunkResponse(chunk=raw)
+
+    async def apply_snapshot_chunk(self,
+                                   req: abci.ApplySnapshotChunkRequest
+                                   ) -> abci.ApplySnapshotChunkResponse:
+        from ..crypto import tmhash
+        restoring = getattr(self, "_restoring", None)
+        if restoring is None or \
+                tmhash.sum(req.chunk) != restoring.hash:
+            return abci.ApplySnapshotChunkResponse(
+                result=abci.APPLY_SNAPSHOT_CHUNK_RESULT_REJECT_SNAPSHOT)
+        self._restore_state(req.chunk)
+        self._restoring = None
+        return abci.ApplySnapshotChunkResponse(
+            result=abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT)
 
     async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
         if req.path == "/val":

@@ -1,0 +1,169 @@
+"""KV tx/block indexers.
+
+Reference: state/txindex/kv/kv.go (TxIndexer over events with the
+pubsub query language) and state/indexer/block/kv (block events).
+Records: tx hash → TxResult proto; composite event key
+(type.attr/value/height/index) → tx hash or block height.
+"""
+from __future__ import annotations
+
+import struct
+from typing import Optional
+
+from ..abci import types as abci
+from ..db import DB
+from ..libs.pubsub import Query
+from ..wire import abci_pb, encode, decode
+
+_TX_RESULT = b"tx/"
+_TX_EVENT = b"te/"
+_BLOCK_EVENT = b"be/"
+_BLOCK_HEIGHT_KEY = "block.height"
+_TX_HEIGHT_KEY = "tx.height"
+_TX_HASH_KEY = "tx.hash"
+
+
+def _event_key(prefix: bytes, composite: str, value: str,
+               height: int, tie: bytes) -> bytes:
+    return (prefix + composite.encode() + b"\x00" + value.encode() +
+            b"\x00" + struct.pack(">q", height) + b"\x00" + tie)
+
+
+class TxIndexer:
+    """Reference: state/txindex/indexer.go:24 TxIndexer interface."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    def index(self, tx_result: abci.TxResult) -> None:
+        from ..types.tx import tx_hash
+        h = tx_hash(tx_result.tx)
+        raw = encode(abci_pb.TX_RESULT, {
+            **({"height": tx_result.height}
+               if tx_result.height else {}),
+            **({"index": tx_result.index} if tx_result.index else {}),
+            **({"tx": tx_result.tx} if tx_result.tx else {}),
+            "result": _exec_result_proto(tx_result.result),
+        })
+        batch = self._db.new_batch()
+        batch.set(_TX_RESULT + h, raw)
+        # implicit tx.height/tx.hash attributes + app events
+        for composite, value in _iter_event_attrs(
+                tx_result.result.events):
+            batch.set(_event_key(_TX_EVENT, composite, value,
+                                 tx_result.height, h), h)
+        batch.set(_event_key(_TX_EVENT, _TX_HEIGHT_KEY,
+                             str(tx_result.height), tx_result.height,
+                             h), h)
+        batch.write()
+
+    def get(self, tx_hash_: bytes) -> Optional[abci.TxResult]:
+        raw = self._db.get(_TX_RESULT + tx_hash_)
+        if raw is None:
+            return None
+        d = decode(abci_pb.TX_RESULT, raw)
+        return abci.TxResult(
+            height=d.get("height", 0), index=d.get("index", 0),
+            tx=d.get("tx", b""),
+            result=_exec_result_from_proto(d.get("result") or {}))
+
+    def search(self, query: Query, limit: int = 100) -> list[bytes]:
+        """Tx hashes whose indexed events satisfy the query (AND of
+        conditions, like the reference's kv search)."""
+        result: Optional[set[bytes]] = None
+        for cond in query.conditions:
+            matches = set()
+            prefix = _TX_EVENT + cond.key.encode() + b"\x00"
+            for k, v in self._db.iterator(prefix,
+                                          prefix + b"\xff" * 64):
+                rest = k[len(prefix):]
+                value = rest.split(b"\x00", 1)[0].decode(
+                    errors="replace")
+                if cond.matches_value(value):
+                    matches.add(v)
+            result = matches if result is None else result & matches
+            if not result:
+                return []
+        return list(result or [])[:limit]
+
+
+class BlockIndexer:
+    """Reference: state/indexer/block/kv."""
+
+    def __init__(self, db: DB):
+        self._db = db
+
+    def index(self, height: int, events: list) -> None:
+        batch = self._db.new_batch()
+        tie = struct.pack(">q", height)
+        batch.set(_event_key(_BLOCK_EVENT, _BLOCK_HEIGHT_KEY,
+                             str(height), height, tie), tie)
+        for composite, value in _iter_event_attrs(events):
+            batch.set(_event_key(_BLOCK_EVENT, composite, value,
+                                 height, tie), tie)
+        batch.write()
+
+    def search(self, query: Query, limit: int = 100) -> list[int]:
+        result: Optional[set[int]] = None
+        for cond in query.conditions:
+            matches = set()
+            prefix = _BLOCK_EVENT + cond.key.encode() + b"\x00"
+            for k, v in self._db.iterator(prefix,
+                                          prefix + b"\xff" * 64):
+                rest = k[len(prefix):]
+                value = rest.split(b"\x00", 1)[0].decode(
+                    errors="replace")
+                if cond.matches_value(value):
+                    matches.add(struct.unpack(">q", v)[0])
+            result = matches if result is None else result & matches
+            if not result:
+                return []
+        return sorted(result or [])[:limit]
+
+
+def _iter_event_attrs(events):
+    for ev in events or []:
+        for attr in ev.attributes:
+            if attr.index and ev.type and attr.key:
+                yield f"{ev.type}.{attr.key}", attr.value
+
+
+def _exec_result_proto(r: abci.ExecTxResult) -> dict:
+    d: dict = {}
+    if r.code:
+        d["code"] = r.code
+    if r.data:
+        d["data"] = r.data
+    if r.log:
+        d["log"] = r.log
+    if r.gas_wanted:
+        d["gas_wanted"] = r.gas_wanted
+    if r.gas_used:
+        d["gas_used"] = r.gas_used
+    if r.events:
+        d["events"] = [{
+            **({"type": e.type} if e.type else {}),
+            "attributes": [
+                {**({"key": a.key} if a.key else {}),
+                 **({"value": a.value} if a.value else {}),
+                 **({"index": True} if a.index else {})}
+                for a in e.attributes]} for e in r.events]
+    if r.codespace:
+        d["codespace"] = r.codespace
+    return d
+
+
+def _exec_result_from_proto(d: dict) -> abci.ExecTxResult:
+    return abci.ExecTxResult(
+        code=d.get("code", 0), data=d.get("data", b""),
+        log=d.get("log", ""),
+        gas_wanted=d.get("gas_wanted", 0),
+        gas_used=d.get("gas_used", 0),
+        events=[abci.Event(
+            type=e.get("type", ""),
+            attributes=[abci.EventAttribute(
+                key=a.get("key", ""), value=a.get("value", ""),
+                index=a.get("index", False))
+                for a in e.get("attributes", [])])
+            for e in d.get("events", [])],
+        codespace=d.get("codespace", ""))

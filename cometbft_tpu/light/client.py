@@ -1,0 +1,252 @@
+"""Light client: trusted-store-backed header tracker.
+
+Reference: light/client.go (:1179) — sequential or skipping (bisection)
+verification against a primary provider, witness cross-checking
+(detector.go), trust-period handling, backwards verification below the
+trusted root.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs.log import Logger, new_logger
+from ..types.block import LightBlock
+from ..types.evidence import LightClientAttackEvidence
+from ..types.timestamp import Timestamp
+from ..types.validation import Fraction
+from .provider import LightBlockNotFoundError, Provider, ProviderError
+from .store import TrustedStore
+from .verifier import (
+    DEFAULT_TRUST_LEVEL, LightClientError, header_expired,
+    validate_trust_level, verify, verify_backwards,
+)
+
+_S = 1_000_000_000
+DEFAULT_MAX_CLOCK_DRIFT_NS = 10 * _S
+
+SEQUENTIAL = "sequential"
+SKIPPING = "skipping"
+
+
+class DivergenceError(LightClientError):
+    """A witness disagrees with the primary — possible attack
+    (reference: detector.go ErrConflictingHeaders)."""
+
+    def __init__(self, witness: Provider, evidence=None):
+        super().__init__(f"witness {witness.id()} diverges from primary")
+        self.witness = witness
+        self.evidence = evidence
+
+
+class TrustOptions:
+    """Reference: light.TrustOptions — period + (height, hash) root."""
+
+    def __init__(self, period_ns: int, height: int, header_hash: bytes):
+        self.period_ns = period_ns
+        self.height = height
+        self.hash = header_hash
+
+
+class Client:
+    def __init__(self, chain_id: str, trust_options: TrustOptions,
+                 primary: Provider, witnesses: list[Provider],
+                 trusted_store: TrustedStore,
+                 verification_mode: str = SKIPPING,
+                 trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                 max_clock_drift_ns: int = DEFAULT_MAX_CLOCK_DRIFT_NS,
+                 logger: Optional[Logger] = None):
+        validate_trust_level(trust_level)
+        self.chain_id = chain_id
+        self.trust_options = trust_options
+        self.primary = primary
+        self.witnesses = list(witnesses)
+        self.store = trusted_store
+        self.mode = verification_mode
+        self.trust_level = trust_level
+        self.max_clock_drift_ns = max_clock_drift_ns
+        self.logger = logger if logger is not None else \
+            new_logger("light")
+
+    # ------------------------------------------------------------------
+    async def initialize(self,
+                         now: Optional[Timestamp] = None) -> LightBlock:
+        """Fetch + pin the trust root (reference: initializeWithTrustOptions)."""
+        now = now or Timestamp.now()
+        existing = self.store.light_block(self.trust_options.height)
+        if existing is not None:
+            return existing
+        lb = await self.primary.light_block(self.trust_options.height)
+        if lb.signed_header.header.hash() != self.trust_options.hash:
+            raise LightClientError(
+                "trusted header hash does not match the trust options")
+        lb.validate_basic(self.chain_id)
+        if header_expired(lb.signed_header,
+                          self.trust_options.period_ns, now):
+            raise LightClientError("trusted header is expired")
+        self.store.save_light_block(lb)
+        return lb
+
+    # ------------------------------------------------------------------
+    async def verify_light_block_at_height(
+            self, height: int,
+            now: Optional[Timestamp] = None) -> LightBlock:
+        """Reference: VerifyLightBlockAtHeight."""
+        now = now or Timestamp.now()
+        if height <= 0:
+            raise LightClientError("height must be positive")
+        existing = self.store.light_block(height)
+        if existing is not None:
+            return existing
+        latest = self.store.latest()
+        if latest is None:
+            raise LightClientError("client not initialized")
+        if height < latest.height:
+            first = self.store.first()
+            if first is not None and height < first.height:
+                return await self._backwards(first, height)
+            # between stored roots: verify forward from the closest
+            # lower stored block
+            base = self._closest_below(height)
+            return await self._verify_forward(base, height, now)
+        return await self._verify_forward(latest, height, now)
+
+    async def update(self, now: Optional[Timestamp] = None
+                     ) -> Optional[LightBlock]:
+        """Verify the primary's latest header (reference: Update)."""
+        now = now or Timestamp.now()
+        latest = self.store.latest()
+        if latest is None:
+            raise LightClientError("client not initialized")
+        new = await self.primary.light_block(0)
+        if new.height <= latest.height:
+            return None
+        return await self._verify_forward(latest, new.height, now,
+                                          prefetched=new)
+
+    def trusted_light_block(self, height: int) -> Optional[LightBlock]:
+        return self.store.light_block(height)
+
+    # ------------------------------------------------------------------
+    def _closest_below(self, height: int) -> LightBlock:
+        best = None
+        for h in self.store.heights():
+            if h <= height:
+                best = h
+        if best is None:
+            raise LightClientError("no trusted block below target")
+        return self.store.light_block(best)
+
+    async def _verify_forward(self, trusted: LightBlock, height: int,
+                              now: Timestamp,
+                              prefetched: Optional[LightBlock] = None
+                              ) -> LightBlock:
+        if self.mode == SEQUENTIAL:
+            lb = await self._verify_sequential(trusted, height, now)
+        else:
+            lb = await self._verify_skipping(trusted, height, now,
+                                             prefetched)
+        await self._detect_divergence(lb, now)
+        return lb
+
+    async def _verify_sequential(self, trusted: LightBlock,
+                                 height: int,
+                                 now: Timestamp) -> LightBlock:
+        """Verify every header between trusted and height (reference:
+        verifySequential)."""
+        current = trusted
+        for h in range(trusted.height + 1, height + 1):
+            nxt = await self.primary.light_block(h)
+            verify(current.signed_header, current.validator_set,
+                   nxt.signed_header, nxt.validator_set,
+                   self.trust_options.period_ns, now,
+                   self.max_clock_drift_ns, self.trust_level)
+            self.store.save_light_block(nxt)
+            current = nxt
+        return current
+
+    async def _verify_skipping(self, trusted: LightBlock, height: int,
+                               now: Timestamp,
+                               prefetched: Optional[LightBlock] = None
+                               ) -> LightBlock:
+        """Bisection (reference: verifySkipping): try to jump straight
+        to the target; on insufficient trust, bisect."""
+        target = prefetched if prefetched is not None and \
+            prefetched.height == height else \
+            await self.primary.light_block(height)
+        verified = trusted
+        pivots = [target]
+        while pivots:
+            candidate = pivots[-1]
+            try:
+                verify(verified.signed_header, verified.validator_set,
+                       candidate.signed_header, candidate.validator_set,
+                       self.trust_options.period_ns, now,
+                       self.max_clock_drift_ns, self.trust_level)
+                self.store.save_light_block(candidate)
+                verified = candidate
+                pivots.pop()
+            except LightClientError as e:
+                from .verifier import NewValSetCantBeTrustedError
+                if not isinstance(e, NewValSetCantBeTrustedError):
+                    raise
+                # can't jump that far: bisect
+                pivot_height = (verified.height + candidate.height) // 2
+                if pivot_height in (verified.height, candidate.height):
+                    raise LightClientError(
+                        "bisection failed: no trust path to target"
+                    ) from e
+                pivots.append(
+                    await self.primary.light_block(pivot_height))
+        return verified
+
+    async def _backwards(self, first: LightBlock,
+                         height: int) -> LightBlock:
+        """Verify below the oldest trusted header via hash links
+        (reference: backwards)."""
+        current = first
+        for h in range(first.height - 1, height - 1, -1):
+            older = await self.primary.light_block(h)
+            verify_backwards(older.signed_header.header,
+                             current.signed_header.header)
+            self.store.save_light_block(older)
+            current = older
+        return current
+
+    # ------------------------------------------------------------------
+    async def _detect_divergence(self, verified: LightBlock,
+                                 now: Timestamp) -> None:
+        """Cross-check the verified header against witnesses
+        (reference: detector.go detectDivergence)."""
+        if not self.witnesses:
+            return
+        h = verified.height
+        target_hash = verified.signed_header.header.hash()
+        bad: list[Provider] = []
+        for w in self.witnesses:
+            try:
+                wlb = await w.light_block(h)
+            except (ProviderError, LightBlockNotFoundError):
+                continue
+            if wlb.signed_header.header.hash() != target_hash:
+                # divergence: build attack evidence against the witness
+                # trace and report to both sides (reference:
+                # examineConflictingHeaderAgainstTrace)
+                common = self.store.latest()
+                ev = LightClientAttackEvidence(
+                    conflicting_block=wlb,
+                    common_height=min(common.height, h) if common
+                    else h,
+                    byzantine_validators=[],
+                    total_voting_power=verified.validator_set
+                    .total_voting_power(),
+                    timestamp=verified.signed_header.header.time)
+                try:
+                    await self.primary.report_evidence(ev)
+                    await w.report_evidence(ev)
+                except ProviderError:
+                    pass
+                bad.append(w)
+        if bad:
+            for w in bad:
+                self.witnesses.remove(w)
+            raise DivergenceError(bad[0])

@@ -163,6 +163,21 @@ class Node:
                    cfg.base.path(cfg.base.db_dir)),
             self.state_store, self.block_store)
 
+        # indexers + service (reference: setup.go createAndStartIndexerService)
+        from ..indexer import BlockIndexer, IndexerService, TxIndexer
+        if cfg.tx_index.indexer == "kv":
+            idx_db = new_db("tx_index", cfg.base.db_backend,
+                            cfg.base.path(cfg.base.db_dir))
+            self.tx_indexer = TxIndexer(idx_db)
+            self.block_indexer = BlockIndexer(idx_db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus)
+            await self.indexer_service.start()
+        else:
+            self.tx_indexer = None
+            self.block_indexer = None
+            self.indexer_service = None
+
         block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
             mempool=self.mempool, evpool=self.evidence_pool,
@@ -238,6 +253,8 @@ class Node:
                          chain=self.genesis_doc.chain_id)
 
     async def stop(self) -> None:
+        if getattr(self, "indexer_service", None) is not None:
+            await self.indexer_service.stop()
         if self.consensus_state is not None:
             await self.consensus_state.stop()
         await self.switch.stop()

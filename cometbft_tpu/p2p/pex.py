@@ -1,0 +1,208 @@
+"""PEX: peer exchange + address book.
+
+Reference: p2p/pex/ — pex_reactor.go (:756, PexChannel 0x00, address
+requests/responses, seed crawl mode) and addrbook.go (:921, bucketed
+address book with persistence).  The book here keeps the same contract
+(routable addresses, last-seen tracking, JSON persistence, random
+selection) with a flat table in place of the old/new bucket machinery.
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..libs.log import Logger
+from .conn import ChannelDescriptor
+from .switch import Peer, Reactor
+from ..wire import encode, decode
+from ..wire.proto import F, Msg
+
+PEX_CHANNEL = 0x00
+_REQUEST_INTERVAL_S = 30.0
+_MAX_ADDRS_PER_MSG = 100
+
+PEX_ADDR = Msg("cometbft.p2p.v1.PexAddress",
+               F(1, "id", "string"), F(2, "ip", "string"),
+               F(3, "port", "uint32"))
+PEX_REQUEST = Msg("cometbft.p2p.v1.PexRequest")
+PEX_ADDRS = Msg("cometbft.p2p.v1.PexAddrs",
+                F(1, "addrs", "msg", msg=PEX_ADDR, repeated=True))
+PEX_MESSAGE = Msg("cometbft.p2p.v1.Message",
+                  F(1, "pex_request", "msg", msg=PEX_REQUEST),
+                  F(2, "pex_addrs", "msg", msg=PEX_ADDRS))
+
+
+@dataclass
+class KnownAddress:
+    node_id: str
+    ip: str
+    port: int
+    last_seen: float = field(default_factory=time.time)
+    attempts: int = 0
+
+    @property
+    def dial_addr(self) -> str:
+        return f"{self.ip}:{self.port}"
+
+
+class AddrBook:
+    """Reference: p2p/pex/addrbook.go — persistence + random pick."""
+
+    def __init__(self, path: str = "", strict: bool = True):
+        self.path = path
+        self.strict = strict
+        self._addrs: dict[str, KnownAddress] = {}
+        if path and os.path.exists(path):
+            self._load()
+
+    def add_address(self, node_id: str, ip: str, port: int) -> bool:
+        if not node_id or port <= 0:
+            return False
+        if self.strict and not _routable(ip):
+            return False
+        ka = self._addrs.get(node_id)
+        if ka is None:
+            self._addrs[node_id] = KnownAddress(node_id, ip, port)
+            return True
+        ka.ip, ka.port = ip, port
+        ka.last_seen = time.time()
+        return False
+
+    def mark_good(self, node_id: str) -> None:
+        ka = self._addrs.get(node_id)
+        if ka is not None:
+            ka.attempts = 0
+            ka.last_seen = time.time()
+
+    def mark_attempt(self, node_id: str) -> None:
+        ka = self._addrs.get(node_id)
+        if ka is not None:
+            ka.attempts += 1
+
+    def remove(self, node_id: str) -> None:
+        self._addrs.pop(node_id, None)
+
+    def pick_addresses(self, n: int,
+                       exclude: Optional[set] = None
+                       ) -> list[KnownAddress]:
+        pool = [a for a in self._addrs.values()
+                if not exclude or a.node_id not in exclude]
+        random.shuffle(pool)
+        return pool[:n]
+
+    def size(self) -> int:
+        return len(self._addrs)
+
+    def save(self) -> None:
+        if not self.path:
+            return
+        os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        with open(self.path, "w") as f:
+            json.dump([{"id": a.node_id, "ip": a.ip, "port": a.port,
+                        "last_seen": a.last_seen}
+                       for a in self._addrs.values()], f, indent=2)
+
+    def _load(self) -> None:
+        try:
+            with open(self.path) as f:
+                for d in json.load(f):
+                    self._addrs[d["id"]] = KnownAddress(
+                        d["id"], d["ip"], int(d["port"]),
+                        d.get("last_seen", 0.0))
+        except (json.JSONDecodeError, KeyError, OSError):
+            pass
+
+
+def _routable(ip: str) -> bool:
+    # local addresses are fine for testnets when strict=False; strict
+    # mode refuses the obvious non-routables except RFC1918 (validators
+    # commonly peer over private networks)
+    return not ip.startswith(("0.", "255."))
+
+
+class PexReactor(Reactor):
+    def __init__(self, book: AddrBook, seed_mode: bool = False,
+                 max_outbound: int = 10,
+                 logger: Optional[Logger] = None):
+        super().__init__("PEX")
+        if logger is not None:
+            self.logger = logger
+        self.book = book
+        self.seed_mode = seed_mode
+        self.max_outbound = max_outbound
+        self._task: Optional[asyncio.Task] = None
+
+    def get_channels(self) -> list[ChannelDescriptor]:
+        return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
+                                  send_queue_capacity=10)]
+
+    async def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._ensure_peers_routine())
+
+    async def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+        self.book.save()
+
+    # ------------------------------------------------------------------
+    async def add_peer(self, peer: Peer) -> None:
+        # record the peer's self-reported listen address
+        la = peer.node_info.listen_addr
+        if la and ":" in la:
+            ip, port = la.rsplit(":", 1)
+            self.book.add_address(peer.id, ip, int(port))
+            self.book.mark_good(peer.id)
+        # ask it for more peers
+        peer.send(PEX_CHANNEL,
+                  encode(PEX_MESSAGE, {"pex_request": {}}))
+
+    async def receive(self, chan_id: int, peer: Peer,
+                      msg_bytes: bytes) -> None:
+        d = decode(PEX_MESSAGE, msg_bytes)
+        if "pex_request" in d:
+            addrs = self.book.pick_addresses(
+                _MAX_ADDRS_PER_MSG, exclude={peer.id})
+            peer.send(PEX_CHANNEL, encode(PEX_MESSAGE, {"pex_addrs": {
+                "addrs": [{"id": a.node_id, "ip": a.ip,
+                           "port": a.port} for a in addrs]}}))
+            # seed nodes hang up after serving addresses
+            if self.seed_mode and self.switch is not None:
+                await self.switch.stop_peer(peer, "seed served addrs")
+        elif "pex_addrs" in d:
+            for a in d["pex_addrs"].get("addrs", []):
+                self.book.add_address(a.get("id", ""),
+                                      a.get("ip", ""),
+                                      a.get("port", 0))
+
+    # ------------------------------------------------------------------
+    async def _ensure_peers_routine(self) -> None:
+        """Dial book addresses while below the outbound target
+        (reference: ensurePeersRoutine)."""
+        try:
+            while True:
+                await asyncio.sleep(1.0)
+                sw = self.switch
+                if sw is None:
+                    continue
+                out = sum(1 for p in sw.peers.values() if p.outbound)
+                if out >= self.max_outbound:
+                    continue
+                connected = set(sw.peers)
+                connected.add(sw.node_key.id)
+                for ka in self.book.pick_addresses(
+                        self.max_outbound - out, exclude=connected):
+                    self.book.mark_attempt(ka.node_id)
+                    try:
+                        await sw.dial_peer(ka.dial_addr)
+                        self.book.mark_good(ka.node_id)
+                    except Exception:
+                        if ka.attempts > 10:
+                            self.book.remove(ka.node_id)
+        except asyncio.CancelledError:
+            raise

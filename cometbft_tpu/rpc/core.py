@@ -68,6 +68,11 @@ def routes(env: Environment) -> dict:
         "consensus_state": lambda: _consensus_state(env),
         "consensus_params": lambda height="0":
             _consensus_params(env, height),
+        "tx": lambda hash="", prove=False: _tx(env, hash),
+        "tx_search": lambda query="", page="1", per_page="30",
+        order_by="asc": _tx_search(env, query, page, per_page),
+        "block_search": lambda query="", page="1", per_page="30",
+        order_by="asc": _block_search(env, query, page, per_page),
     }
 
 
@@ -373,6 +378,68 @@ async def _consensus_params(env, height):
         "validator": {"pub_key_types":
                       list(params.validator.pub_key_types)},
     }}
+
+
+def _tx_result_json(tr) -> dict:
+    from ..types.tx import tx_hash
+    return {
+        "hash": tx_hash(tr.tx).hex().upper(),
+        "height": str(tr.height),
+        "index": tr.index,
+        "tx_result": {
+            "code": tr.result.code,
+            "data": base64.b64encode(tr.result.data).decode(),
+            "log": tr.result.log,
+            "gas_wanted": str(tr.result.gas_wanted),
+            "gas_used": str(tr.result.gas_used),
+            "events": _events_json(tr.result.events),
+        },
+        "tx": base64.b64encode(tr.tx).decode(),
+    }
+
+
+async def _tx(env, hash):
+    from .server import RPCError
+    if env.node.tx_indexer is None:
+        raise RPCError(-32603, "transaction indexing is disabled")
+    raw = hash if isinstance(hash, bytes) else (
+        bytes.fromhex(hash[2:]) if hash.startswith("0x")
+        else bytes.fromhex(hash))
+    tr = env.node.tx_indexer.get(raw)
+    if tr is None:
+        raise RPCError(-32603, f"tx {hash} not found")
+    return _tx_result_json(tr)
+
+
+async def _tx_search(env, query, page, per_page):
+    from ..libs.pubsub import Query
+    from .server import RPCError
+    if env.node.tx_indexer is None:
+        raise RPCError(-32603, "transaction indexing is disabled")
+    hashes = env.node.tx_indexer.search(Query(query))
+    page_i, per = max(1, int(page)), min(100, int(per_page))
+    sel = hashes[(page_i - 1) * per:page_i * per]
+    txs = [env.node.tx_indexer.get(h) for h in sel]
+    return {"txs": [_tx_result_json(t) for t in txs if t],
+            "total_count": str(len(hashes))}
+
+
+async def _block_search(env, query, page, per_page):
+    from ..libs.pubsub import Query
+    from .server import RPCError
+    if env.node.block_indexer is None:
+        raise RPCError(-32603, "block indexing is disabled")
+    heights = env.node.block_indexer.search(Query(query))
+    page_i, per = max(1, int(page)), min(100, int(per_page))
+    sel = heights[(page_i - 1) * per:page_i * per]
+    blocks = []
+    for h in sel:
+        meta = env.block_store.load_block_meta(h)
+        block = env.block_store.load_block(h)
+        if meta and block:
+            blocks.append({"block_id": _block_id_json(meta.block_id),
+                           "block": _block_json(block)})
+    return {"blocks": blocks, "total_count": str(len(heights))}
 
 
 # ---------------------------------------------------------------------------

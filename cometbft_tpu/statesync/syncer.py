@@ -1,0 +1,209 @@
+"""Statesync syncer: discover snapshots → offer → fetch chunks → apply.
+
+Reference: statesync/syncer.go (:144 SyncAny, :236 Sync),
+statesync/chunks.go (queue), statesync/stateprovider.go (light-client
+backed trusted state at the snapshot height).
+"""
+from __future__ import annotations
+
+import asyncio
+from dataclasses import dataclass
+from typing import Optional
+
+from ..abci import types as abci
+from ..libs.log import Logger, new_logger
+from ..state.state import State as SMState, StateVersion
+from ..types.block import ConsensusVersion
+from ..types.block_id import BlockID
+from ..types.commit import Commit
+
+
+class StatesyncError(Exception):
+    pass
+
+
+class RejectSnapshotError(StatesyncError):
+    pass
+
+
+@dataclass(frozen=True)
+class SnapshotKey:
+    height: int
+    format: int
+    chunks: int
+    hash: bytes
+    metadata: bytes = b""
+
+
+class StateProvider:
+    """Trusted state + commit at a height, via the light client
+    (reference: stateprovider.go:29 — the light client runs over RPC;
+    here over any light Provider)."""
+
+    def __init__(self, light_client, chain_id: str,
+                 genesis_doc):
+        self.light_client = light_client
+        self.chain_id = chain_id
+        self.genesis_doc = genesis_doc
+
+    async def app_hash(self, height: int) -> bytes:
+        # header at height+1 carries the app hash AFTER height
+        lb = await self.light_client.verify_light_block_at_height(
+            height + 1)
+        return lb.signed_header.header.app_hash
+
+    async def commit(self, height: int) -> Commit:
+        lb = await self.light_client.verify_light_block_at_height(
+            height)
+        return lb.signed_header.commit
+
+    async def state(self, height: int) -> SMState:
+        """Reconstruct sm.State at `height` (reference:
+        stateprovider State)."""
+        cur = await self.light_client.verify_light_block_at_height(
+            height)
+        nxt = await self.light_client.verify_light_block_at_height(
+            height + 1)
+        nxt2 = await self.light_client.verify_light_block_at_height(
+            height + 2)
+        state = SMState(
+            version=StateVersion(consensus=ConsensusVersion(
+                block=cur.signed_header.header.version.block,
+                app=cur.signed_header.header.version.app)),
+            chain_id=self.chain_id,
+            initial_height=self.genesis_doc.initial_height,
+            last_block_height=cur.height,
+            last_block_id=BlockID(
+                hash=cur.signed_header.header.hash(),
+                part_set_header=nxt.signed_header.commit.block_id
+                .part_set_header),
+            last_block_time=cur.signed_header.header.time,
+            validators=nxt.validator_set,
+            next_validators=nxt2.validator_set,
+            last_validators=cur.validator_set,
+            last_height_validators_changed=cur.height,
+            consensus_params=self.genesis_doc.consensus_params
+            .update(None),
+            last_height_consensus_params_changed=(
+                self.genesis_doc.initial_height),
+            last_results_hash=(
+                nxt.signed_header.header.last_results_hash),
+            app_hash=nxt.signed_header.header.app_hash,
+        )
+        return state
+
+
+class Syncer:
+    """Reference: statesync/syncer.go."""
+
+    def __init__(self, app_conns, state_provider: StateProvider,
+                 request_chunk,
+                 chunk_timeout_s: float = 10.0,
+                 logger: Optional[Logger] = None):
+        """request_chunk(snapshot, index) asks some peer for a chunk;
+        results arrive via add_chunk."""
+        self.app_conns = app_conns
+        self.state_provider = state_provider
+        self.request_chunk = request_chunk
+        self.chunk_timeout_s = chunk_timeout_s
+        self.logger = logger if logger is not None else \
+            new_logger("statesync")
+        self.snapshots: dict[SnapshotKey, set[str]] = {}
+        self._chunks: dict[int, bytes] = {}
+        self._chunk_event = asyncio.Event()
+
+    # ------------------------------------------------------------------
+    def add_snapshot(self, peer_id: str, snap: SnapshotKey) -> None:
+        self.snapshots.setdefault(snap, set()).add(peer_id)
+
+    def add_chunk(self, height: int, format_: int, index: int,
+                  chunk: bytes) -> None:
+        if index not in self._chunks:
+            self._chunks[index] = chunk
+            self._chunk_event.set()
+
+    # ------------------------------------------------------------------
+    async def sync_any(self, discovery_time_s: float = 2.0
+                       ) -> tuple[SMState, Commit]:
+        """Try snapshots best-first until one applies (reference:
+        SyncAny)."""
+        await asyncio.sleep(discovery_time_s)
+        tried: set[SnapshotKey] = set()
+        while True:
+            best = self._best_snapshot(tried)
+            if best is None:
+                raise StatesyncError(
+                    "no viable snapshots (discovered "
+                    f"{len(self.snapshots)})")
+            tried.add(best)
+            try:
+                return await self._sync(best)
+            except RejectSnapshotError as e:
+                self.logger.info("snapshot rejected; trying next",
+                                 height=best.height, err=str(e))
+                continue
+
+    def _best_snapshot(self, tried: set) -> Optional[SnapshotKey]:
+        candidates = [s for s in self.snapshots if s not in tried]
+        if not candidates:
+            return None
+        return max(candidates, key=lambda s: (s.height, -s.format))
+
+    async def _sync(self, snap: SnapshotKey) -> tuple[SMState, Commit]:
+        """Reference: syncer.Sync (:236)."""
+        # verify the app hash for the snapshot height FIRST (trusted
+        # via the light client)
+        app_hash = await self.state_provider.app_hash(snap.height)
+        offer = await self.app_conns.snapshot.offer_snapshot(
+            abci.OfferSnapshotRequest(
+                snapshot=abci.Snapshot(
+                    height=snap.height, format=snap.format,
+                    chunks=snap.chunks, hash=snap.hash,
+                    metadata=snap.metadata),
+                app_hash=app_hash))
+        if offer.result != abci.OFFER_SNAPSHOT_RESULT_ACCEPT:
+            raise RejectSnapshotError(
+                f"app rejected snapshot: {offer.result}")
+
+        self._chunks.clear()
+        # fetch + apply chunks in order
+        applied = 0
+        requested: set[int] = set()
+        while applied < snap.chunks:
+            for i in range(snap.chunks):
+                if i not in self._chunks and i not in requested:
+                    self.request_chunk(snap, i)
+                    requested.add(i)
+            if applied not in self._chunks:
+                self._chunk_event.clear()
+                try:
+                    await asyncio.wait_for(self._chunk_event.wait(),
+                                           self.chunk_timeout_s)
+                except asyncio.TimeoutError:
+                    requested.clear()   # re-request everything missing
+                continue
+            resp = await self.app_conns.snapshot.apply_snapshot_chunk(
+                abci.ApplySnapshotChunkRequest(
+                    index=applied, chunk=self._chunks[applied]))
+            if resp.result == abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT:
+                applied += 1
+            elif resp.result == abci.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY:
+                self._chunks.pop(applied, None)
+                requested.discard(applied)
+            else:
+                raise RejectSnapshotError(
+                    f"chunk apply failed: {resp.result}")
+
+        # verify the app's restored state matches the trusted app hash
+        info = await self.app_conns.query.info(abci.InfoRequest())
+        if info.last_block_app_hash != app_hash:
+            raise RejectSnapshotError(
+                "restored app hash does not match trusted header")
+        if info.last_block_height != snap.height:
+            raise RejectSnapshotError(
+                "restored app height does not match snapshot")
+
+        state = await self.state_provider.state(snap.height)
+        commit = await self.state_provider.commit(snap.height)
+        self.logger.info("Snapshot restored", height=snap.height)
+        return state, commit

@@ -1,0 +1,163 @@
+"""Statesync (snapshot bootstrap over sockets) and PEX tests."""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import test_config as _test_config
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.db import MemDB
+from cometbft_tpu.light.client import (
+    SKIPPING, Client as LightClient, TrustOptions,
+)
+from cometbft_tpu.light.provider import NodeProvider
+from cometbft_tpu.light.store import TrustedStore
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.pex import AddrBook, PexReactor
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.statesync import StateProvider, StatesyncReactor, Syncer
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+
+_S = 1_000_000_000
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestStatesync:
+    def test_snapshot_bootstrap(self):
+        async def go():
+            # source: single validator with snapshots every 4 blocks
+            pv = new_mock_pv()
+            doc = GenesisDoc(
+                chain_id="ss-chain",
+                genesis_time=Timestamp(1700000000, 0),
+                validators=[GenesisValidator(
+                    address=b"", pub_key=pv.get_pub_key(), power=10)])
+            src_app = KVStoreApplication(snapshot_interval=4)
+            src_conns = AppConns(src_app)
+            src_ss, src_bs = Store(MemDB()), BlockStore(MemDB())
+            state = make_genesis_state(doc)
+            src_ss.save(state)
+            ex = BlockExecutor(src_ss, src_conns.consensus,
+                               block_store=src_bs)
+            cs = ConsensusState(_test_config().consensus, state, ex,
+                                src_bs, priv_validator=pv)
+            await cs.start()
+            while src_bs.height < 10:
+                await asyncio.sleep(0.01)
+            # keep producing while the client syncs
+            snaps = (await src_app.list_snapshots(None)).snapshots
+            assert snaps, "source must have taken snapshots"
+
+            src_switch = Switch(NodeKey.generate(), doc.chain_id,
+                                listen_addr="127.0.0.1:0")
+            src_reactor = StatesyncReactor(src_conns)
+            src_switch.add_reactor(src_reactor)
+            await src_switch.start()
+
+            # destination: fresh app; trusted light client over the
+            # source's stores
+            dst_app = KVStoreApplication()
+            dst_conns = AppConns(dst_app)
+            provider = NodeProvider(src_bs, src_ss, doc.chain_id)
+            root = await provider.light_block(1)
+            lc = LightClient(
+                doc.chain_id,
+                TrustOptions(
+                    period_ns=10 * 365 * 24 * 3600 * _S, height=1,
+                    header_hash=root.signed_header.header.hash()),
+                provider, [], TrustedStore(MemDB()),
+                verification_mode=SKIPPING)
+            await lc.initialize()
+            sp = StateProvider(lc, doc.chain_id, doc)
+
+            dst_switch = Switch(NodeKey.generate(), doc.chain_id,
+                                listen_addr="127.0.0.1:0")
+            syncer = Syncer(dst_conns, sp, request_chunk=None)
+            dst_reactor = StatesyncReactor(dst_conns, syncer=syncer)
+            syncer.request_chunk = dst_reactor.request_chunk
+            dst_switch.add_reactor(dst_reactor)
+            await dst_switch.start()
+            await dst_switch.dial_peer(src_switch.listen_addr)
+
+            new_state, commit = await asyncio.wait_for(
+                syncer.sync_any(discovery_time_s=0.3), 30)
+            snap_h = new_state.last_block_height
+            assert snap_h % 4 == 0 and snap_h >= 4
+            assert commit.height == snap_h
+            # the app restored to the snapshot state
+            from cometbft_tpu.abci import types as abci
+            info = await dst_conns.query.info(abci.InfoRequest())
+            assert info.last_block_height == snap_h
+            # bootstrap the state store like node startup would
+            dst_ss = Store(MemDB())
+            dst_ss.bootstrap(new_state)
+            assert dst_ss.load().last_block_height == snap_h
+            await cs.stop()
+            await dst_switch.stop()
+            await src_switch.stop()
+        run(go())
+
+
+class TestPex:
+    def test_addrbook_roundtrip(self, tmp_path):
+        p = str(tmp_path / "addrbook.json")
+        book = AddrBook(p)
+        assert book.add_address("a" * 40, "10.0.0.1", 26656)
+        assert not book.add_address("a" * 40, "10.0.0.1", 26656)
+        assert book.add_address("b" * 40, "10.0.0.2", 26656)
+        book.save()
+        book2 = AddrBook(p)
+        assert book2.size() == 2
+        picked = book2.pick_addresses(10)
+        assert len(picked) == 2
+
+    def test_pex_discovery(self):
+        """C learns about A from B via PEX and dials it."""
+        async def go():
+            async def mk(name):
+                nk = NodeKey.generate()
+                sw = Switch(nk, "pexnet", listen_addr="127.0.0.1:0")
+                pex = PexReactor(AddrBook())
+                sw.add_reactor(pex)
+                await sw.start()
+                await pex.start()
+                return sw, pex
+            a, pex_a = await mk("a")
+            b, pex_b = await mk("b")
+            c, pex_c = await mk("c")
+            # A ↔ B, then C → B; C should discover and dial A
+            await a.dial_peer(b.listen_addr)
+            await asyncio.sleep(0.1)
+            await c.dial_peer(b.listen_addr)
+
+            async def wait():
+                while a.node_key.id not in c.peers:
+                    await asyncio.sleep(0.05)
+            await asyncio.wait_for(wait(), 15)
+            assert c.num_peers() == 2
+            for sw, pex in ((a, pex_a), (b, pex_b), (c, pex_c)):
+                await pex.stop()
+                await sw.stop()
+        run(go())
