@@ -17,6 +17,7 @@ from ..state.state import State as SMState
 from ..types.block import Block
 from ..types.block_id import BlockID
 from ..types.commit import ExtendedCommit
+from ..state.validation import BlockValidationError
 from ..types.validation import VerificationError, verify_commit_light
 from ..wire import pb, encode, decode
 from ..wire.proto import F, Msg
@@ -219,7 +220,12 @@ class BlocksyncReactor(Reactor):
                         self.state.chain_id, self.state.validators,
                         first_id, first.header.height,
                         second.last_commit)
-                except VerificationError as e:
+                    # the commit only certifies the header hash; validate
+                    # the full block (data/evidence hashes, header wiring)
+                    # before persisting/executing it — reference:
+                    # internal/blocksync/reactor.go:552 ValidateBlock
+                    self.block_exec.validate_block(self.state, first)
+                except (VerificationError, BlockValidationError) as e:
                     self.logger.error("invalid block in sync",
                                       height=first.header.height,
                                       err=str(e))
@@ -238,6 +244,17 @@ class BlocksyncReactor(Reactor):
                             height=first.header.height)
                         pool.redo_request(first.header.height,
                                           "missing extended commit")
+                        continue
+                    try:
+                        # reference reactor.go:565 — never persist an
+                        # extended commit missing extension signatures
+                        first_ext.ensure_extensions(True)
+                    except Exception as e:
+                        self.logger.error(
+                            "peer sent extended commit with missing "
+                            "extension signatures",
+                            height=first.header.height, err=str(e))
+                        pool.redo_request(first.header.height, str(e))
                         continue
                     self.block_store.save_block_with_extended_commit(
                         first, first_parts, first_ext)

@@ -23,6 +23,7 @@ from ..types.genesis import GenesisDoc
 from ..types.validator import Validator
 from ..types.validator_set import ValidatorSet
 from .messages import message_from_wal
+from .round_state import TimeoutInfo
 from .wal import WAL
 
 
@@ -323,7 +324,19 @@ async def catchup_replay(cs, wal_path: str) -> int:
     try:
         for record in tail:
             t = record.get("type")
-            if t in ("round_state", "timeout", "end_height"):
+            if t in ("round_state", "end_height"):
+                continue
+            if t == "timeout":
+                # replay timeout-driven step transitions too (reference
+                # replay.go:142 dispatches timeoutInfo to handleTimeout) —
+                # otherwise a node that crashed right after e.g. a
+                # precommit-wait round advance restarts a round behind
+                await cs._handle_timeout(TimeoutInfo(
+                    duration_ns=0,
+                    height=record.get("height", 0),
+                    round=record.get("round", 0),
+                    step=record.get("step", 0)))
+                n += 1
                 continue
             msg = message_from_wal(record)
             await cs._handle_msg(msg, "", internal=False)

@@ -198,8 +198,9 @@ class ConsensusState:
         if ti.height != rs.height or ti.round < rs.round or \
                 (ti.round == rs.round and ti.step < rs.step):
             return
-        self.wal.write({"type": "timeout", "height": ti.height,
-                        "round": ti.round, "step": ti.step})
+        if not self.replay_mode:
+            self.wal.write({"type": "timeout", "height": ti.height,
+                            "round": ti.round, "step": ti.step})
         if ti.step == STEP_NEW_HEIGHT:
             await self._enter_new_round(ti.height, 0)
         elif ti.step == STEP_NEW_ROUND:

@@ -20,7 +20,7 @@ from typing import Callable, Optional, Sequence
 from ..abci import types as abci
 from ..config import MempoolConfig
 from ..libs.log import Logger, new_logger
-from ..types.tx import tx_key
+from ..types.tx import compute_proto_size_overhead, tx_key
 
 
 class MempoolError(Exception):
@@ -315,7 +315,12 @@ class CListMempool(Mempool):
         total_bytes = 0
         total_gas = 0
         for e in self._iwrr_order():
-            nb = total_bytes + len(e.tx)
+            # budget the proto-encoded size (per-tx tag + length varint),
+            # not the raw bytes — reference ReapMaxBytesMaxGas uses
+            # ComputeProtoSizeForTxs so the encoded block stays under
+            # the consensus max_bytes peers enforce
+            nb = total_bytes + len(e.tx) + \
+                compute_proto_size_overhead(len(e.tx))
             if max_bytes > -1 and nb > max_bytes:
                 break
             ng = total_gas + e.gas_wanted

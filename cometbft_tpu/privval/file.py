@@ -190,6 +190,8 @@ class FilePV(PrivValidator):
                 # extensions are non-deterministic; always re-sign them
                 vote.extension_signature = self.priv_key.sign(
                     vote.extension_sign_bytes(chain_id))
+                vote.non_rp_extension_signature = self.priv_key.sign(
+                    vote.non_rp_extension_sign_bytes())
             elif vote.extension or vote.non_rp_extension:
                 raise PrivValidatorError(
                     "unexpected vote extension on non-nil-precommit")

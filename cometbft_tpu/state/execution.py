@@ -17,6 +17,7 @@ from ..types.block_id import BlockID
 from ..types.commit import Commit, ExtendedCommit
 from ..types.events import EventBus, NopEventBus
 from ..types.params import MAX_BLOCK_SIZE_BYTES, ParamsError
+from ..types.tx import compute_proto_size_overhead
 from ..types.validator import Validator
 from ..types.vote import (
     BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, Vote,
@@ -112,7 +113,8 @@ def build_extended_commit_info(ext_commit: ExtendedCommit, val_set,
     for i, ecs in enumerate(ext_commit.extended_signatures):
         val = val_set.validators[i]
         if ext_enabled and ecs.block_id_flag == BLOCK_ID_FLAG_COMMIT \
-                and not ecs.extension_signature:
+                and (not ecs.extension_signature or
+                     not ecs.non_rp_extension_signature):
             raise ExecutionError(
                 f"commit at height {ext_commit.height} received with "
                 f"missing vote extension signature")
@@ -238,7 +240,8 @@ class BlockExecutor:
                 next_validators_hash=block.header.next_validators_hash,
                 proposer_address=block.header.proposer_address,
             ))
-        total = sum(len(tx) for tx in rpp.txs)
+        total = sum(len(tx) + compute_proto_size_overhead(len(tx))
+                    for tx in rpp.txs)
         if total > data_cap:
             raise ExecutionError(
                 f"post-PrepareProposal txs exceed max data bytes "
@@ -290,7 +293,12 @@ class BlockExecutor:
         if self._last_validated_hash != block.hash():
             validate_block(state, block)
             self._last_validated_hash = block.hash()
-        self.evpool.check_evidence(block.evidence)
+        try:
+            self.evpool.check_evidence(block.evidence)
+        except BlockValidationError:
+            raise
+        except Exception as e:  # EvidenceError -> invalid block
+            raise BlockValidationError(f"invalid evidence: {e}") from e
 
     async def apply_block(self, state: State, block_id: BlockID,
                           block: Block,

@@ -18,7 +18,11 @@ class BlockValidationError(Exception):
 
 def validate_block(state: State, block: Block) -> None:
     """Reference: state/validation.go validateBlock."""
-    block.validate_basic()
+    try:
+        block.validate_basic()
+    except Exception as e:  # BlockError and friends -> one error type,
+        # so every caller's "invalid block" handling sees it
+        raise BlockValidationError(f"invalid block: {e}") from e
 
     h = block.header
     # header wiring to state

@@ -102,8 +102,10 @@ class BlockStore:
     def save_block_with_extended_commit(
             self, block: Block, parts: PartSet,
             seen_ext_commit: ExtendedCommit) -> None:
-        """Reference: SaveBlockWithExtendedCommit — keeps extensions for
-        height-H PrepareProposal."""
+        """Reference: SaveBlockWithExtendedCommit (store.go:625) — keeps
+        extensions for height-H PrepareProposal; refuses to persist a
+        commit with missing extension signatures (poison prevention)."""
+        seen_ext_commit.ensure_extensions(True)
         self._save_block(block, parts, seen_ext_commit.to_commit(),
                          ext_commit=seen_ext_commit)
 

@@ -214,13 +214,21 @@ class ExtendedCommitSig(CommitSig):
     non_rp_extension_signature: bytes = b""
 
     def ensure_extension(self, ext_enabled: bool) -> None:
-        """Reference: block.go EnsureExtension (:791)."""
+        """Reference: block.go EnsureExtension (:791) — BOTH signatures
+        (replay-protected and non-RP) required on COMMIT entries."""
         if ext_enabled:
             if self.block_id_flag == BLOCK_ID_FLAG_COMMIT and \
-                    not self.extension_signature:
+                    (not self.extension_signature or
+                     not self.non_rp_extension_signature):
                 raise CommitError(
                     "vote extension signature missing with extensions "
                     "enabled")
+            if self.block_id_flag != BLOCK_ID_FLAG_COMMIT and \
+                    (self.extension or self.non_rp_extension or
+                     self.extension_signature or
+                     self.non_rp_extension_signature):
+                raise CommitError(
+                    "non-commit vote extension (signature) present")
         else:
             if self.extension or self.extension_signature or \
                     self.non_rp_extension or self.non_rp_extension_signature:

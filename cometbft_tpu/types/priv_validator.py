@@ -59,6 +59,8 @@ class MockPV(PrivValidator):
                 not vote.block_id.is_nil():
             vote.extension_signature = self.priv_key.sign(
                 vote.extension_sign_bytes(use_chain_id))
+            vote.non_rp_extension_signature = self.priv_key.sign(
+                vote.non_rp_extension_sign_bytes())
 
     def sign_proposal(self, chain_id: str, proposal: Proposal) -> None:
         use_chain_id = "incorrect-chain-id" if self.break_proposal_sigs \

@@ -56,6 +56,12 @@ class Vote:
         return canonical.vote_extension_sign_bytes(
             chain_id, self.height, self.round, self.extension)
 
+    def non_rp_extension_sign_bytes(self) -> bytes:
+        """Reference: vote.go VoteExtensionSignBytes (:173-183) — the
+        non-replay-protected extension signs its raw bytes (no chain-id /
+        height canonicalization, by design)."""
+        return self.non_rp_extension
+
     def is_nil(self) -> bool:
         return self.block_id.is_nil()
 
@@ -82,12 +88,21 @@ class Vote:
             self.verify_extension(chain_id, pub_key)
 
     def verify_extension(self, chain_id: str, pub_key: PubKey) -> None:
-        """Reference: vote.go VerifyExtension."""
-        if self.type != canonical.PRECOMMIT_TYPE:
+        """Reference: vote.go VerifyExtension (:280-299) — both the
+        replay-protected and the non-RP extension signatures are required
+        and checked for non-nil precommits."""
+        if self.type != canonical.PRECOMMIT_TYPE or self.block_id.is_nil():
             return
+        if not self.extension_signature or \
+                not self.non_rp_extension_signature:
+            raise InvalidSignatureError("vote extension signature missing")
         if not pub_key.verify_signature(self.extension_sign_bytes(chain_id),
                                         self.extension_signature):
             raise InvalidSignatureError("invalid vote extension signature")
+        if not pub_key.verify_signature(self.non_rp_extension_sign_bytes(),
+                                        self.non_rp_extension_signature):
+            raise InvalidSignatureError(
+                "invalid non-RP vote extension signature")
 
     # ------------------------------------------------------------------
     def validate_basic(self) -> None:
@@ -118,9 +133,23 @@ class Vote:
                 raise VoteError("vote extension too big")
             if self.extension and not self.extension_signature:
                 raise VoteError("vote extension signature is missing")
+            if len(self.non_rp_extension) > MAX_VOTE_EXTENSION_SIZE:
+                raise VoteError("non-RP vote extension too big")
+            if len(self.non_rp_extension_signature) > 64:
+                raise VoteError("non-RP extension signature is too big")
+            if self.non_rp_extension and \
+                    not self.non_rp_extension_signature:
+                raise VoteError("non-RP extension signature is missing")
+            # reference vote.go:385 — the two extension signatures come
+            # as a pair: both present (extensions enabled) or neither
+            if bool(self.extension_signature) != \
+                    bool(self.non_rp_extension_signature):
+                raise VoteError(
+                    "extension signatures must both be present or absent")
         else:
             # reference: extensions only allowed on non-nil precommits
-            if self.extension or self.extension_signature:
+            if self.extension or self.extension_signature or \
+                    self.non_rp_extension or self.non_rp_extension_signature:
                 raise VoteError(
                     "unexpected vote extension on non-precommit vote")
 

@@ -1,13 +1,30 @@
-"""Test config: run JAX on a virtual 8-device CPU mesh.
+"""Test config: select the CPU JAX platform *in process*.
 
 Multi-chip hardware isn't available in CI; sharding correctness is validated
-on virtual CPU devices (the driver separately dry-runs the multi-chip path
-via __graft_entry__.dryrun_multichip).
+on a virtual 8-device CPU mesh (the driver separately dry-runs the
+multi-chip path via __graft_entry__.dryrun_multichip).
+
+Platform selection happens via jax.config.update rather than the
+JAX_PLATFORMS environment variable: this environment registers a TPU
+plugin ("axon") from sitecustomize at interpreter start, and overriding
+the env var conflicts with that hook (it expects to manage platform
+selection).  Post-import config.update only initializes the CPU client,
+never dials the TPU pool, and works the same everywhere.
 """
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+# Host-protocol tests exercise the CPU crypto path; kernel tests import
+# ops.ed25519_jax directly (and run it on the virtual CPU devices).
+from cometbft_tpu.crypto import batch  # noqa: E402
+
+if not os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND"):
+    batch.set_backend("cpu")

@@ -1,0 +1,109 @@
+"""Round-2 correctness fixes: non-RP extension signatures, proto-size
+budgeting, replay of timeout records.
+
+Reference behaviors: types/vote.go VerifyExtension (:280-299) requires
+both extension signatures; types/tx.go ComputeProtoSizeForTxs budgets
+per-tx framing; internal/consensus/replay.go:142 replays timeoutInfo.
+"""
+import pytest
+
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.tx import compute_proto_size_overhead
+from cometbft_tpu.types.vote import InvalidSignatureError, Vote, VoteError
+
+
+def _block_vote(pv, extension=b"ext", non_rp=b"nrp"):
+    addr = pv.get_pub_key().address()
+    return Vote(
+        type=canonical.PRECOMMIT_TYPE, height=5, round=0,
+        block_id=BlockID(hash=b"\x11" * 32,
+                         part_set_header=PartSetHeader(1, b"\x22" * 32)),
+        timestamp=Timestamp(1_700_000_000, 0),
+        validator_address=addr, validator_index=0,
+        extension=extension, non_rp_extension=non_rp)
+
+
+class TestNonRpExtensionSignatures:
+    def test_signer_produces_both_signatures(self):
+        pv = new_mock_pv()
+        v = _block_vote(pv)
+        pv.sign_vote("chain", v, sign_extension=True)
+        assert v.extension_signature
+        assert v.non_rp_extension_signature
+        v.verify_extension("chain", pv.get_pub_key())
+        v.verify_vote_and_extension("chain", pv.get_pub_key())
+
+    def test_forged_non_rp_extension_rejected(self):
+        pv = new_mock_pv()
+        v = _block_vote(pv)
+        pv.sign_vote("chain", v, sign_extension=True)
+        v.non_rp_extension = b"forged"
+        with pytest.raises(InvalidSignatureError):
+            v.verify_extension("chain", pv.get_pub_key())
+
+    def test_missing_non_rp_signature_rejected(self):
+        pv = new_mock_pv()
+        v = _block_vote(pv)
+        pv.sign_vote("chain", v, sign_extension=True)
+        v.non_rp_extension_signature = b""
+        with pytest.raises(InvalidSignatureError):
+            v.verify_extension("chain", pv.get_pub_key())
+
+    def test_validate_basic_requires_signature_pairing(self):
+        pv = new_mock_pv()
+        v = _block_vote(pv)
+        pv.sign_vote("chain", v, sign_extension=True)
+        v.validate_basic()
+        v.non_rp_extension_signature = b""
+        with pytest.raises(VoteError):
+            v.validate_basic()
+
+    def test_file_pv_signs_non_rp(self, tmp_path):
+        from cometbft_tpu.privval.file import FilePV
+        pv = FilePV.generate(str(tmp_path / "key.json"),
+                             str(tmp_path / "state.json"))
+        v = _block_vote(pv)
+        v.validator_address = pv.get_pub_key().address()
+        pv.sign_vote("chain", v, sign_extension=True)
+        assert v.non_rp_extension_signature
+        v.verify_extension("chain", pv.get_pub_key())
+
+
+class TestProtoSizeBudget:
+    def test_overhead_formula(self):
+        # 1-byte tag + varint(len)
+        assert compute_proto_size_overhead(0) == 2
+        assert compute_proto_size_overhead(127) == 2
+        assert compute_proto_size_overhead(128) == 3
+        assert compute_proto_size_overhead(20_000) == 4
+
+    def test_reap_respects_encoded_size(self):
+        import asyncio
+        from cometbft_tpu.abci.client import AppConns
+        from cometbft_tpu.abci.kvstore import (
+            DEFAULT_LANES, KVStoreApplication,
+        )
+        from cometbft_tpu.config import MempoolConfig
+        from cometbft_tpu.mempool.mempool import CListMempool
+
+        async def run():
+            conns = AppConns(KVStoreApplication())
+            mp = CListMempool(MempoolConfig(), conns.mempool,
+                              lanes=DEFAULT_LANES, default_lane="default")
+            txs = [(f"k{i}=" + "v" * 100).encode() for i in range(4)]
+            for tx in txs:
+                await mp.check_tx(tx)
+            budget = sum(len(t) for t in txs[:2]) + \
+                sum(compute_proto_size_overhead(len(t)) for t in txs[:2])
+            reaped = mp.reap_max_bytes_max_gas(budget, -1)
+            got = sum(len(t) + compute_proto_size_overhead(len(t))
+                      for t in reaped)
+            assert got <= budget
+            # raw-size accounting would have squeezed in a 3rd tx
+            assert len(reaped) == 2
+        asyncio.run(run())
