@@ -2,21 +2,41 @@
 
 Prints ONE JSON line {"metric", "value", "unit", "vs_baseline"}.
 
-Workload (BASELINE.json config #5 / north star): verify 10,000 ed25519
-signatures over distinct vote sign-bytes — the hot path of
-types/validation.go verifyCommitBatch in the reference.  Baseline is the
-same batch on the CPU single-signature path (OpenSSL, the performance class
-of the reference's Go curve25519-voi path).  vs_baseline = speedup (x).
+Workload (BASELINE.json north star): verify 10,000 ed25519 signatures over
+distinct vote sign-bytes — the hot path of types/validation.go
+verifyCommitBatch in the reference.  Baseline is the same batch on the CPU
+single-signature path (OpenSSL, the performance class of the reference's
+Go curve25519-voi path).  vs_baseline = speedup (x).
+
+Robustness: the TPU backend in this environment ("axon", a pooled remote
+chip) can take minutes to claim or fail with UNAVAILABLE.  The bench
+therefore runs the measurement in a CHILD process (selected platform via
+COMETBFT_TPU_BENCH_CHILD) under a timeout, retries the TPU once, and falls
+back to the engine's CPU (OpenSSL) path so a number is always produced.  Diagnostics
+(platform used, compile ms, device ms) go to stderr; stdout carries only
+the JSON line.
 """
 import json
+import os
 import secrets
+import subprocess
 import sys
 import time
 
 import numpy as np
 
+N = 10_000
+MSG_LEN = 110                      # ~vote sign-bytes size
+TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("COMETBFT_TPU_BENCH_TIMEOUT",
+                                           "1500"))
+CPU_ATTEMPT_TIMEOUT_S = 1200
 
-def make_workload(n: int, msg_len: int = 110):
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+def make_workload(n: int, msg_len: int = MSG_LEN):
     from cryptography.hazmat.primitives.asymmetric.ed25519 import (
         Ed25519PrivateKey,
     )
@@ -47,19 +67,68 @@ def cpu_verify(items):
     return ok
 
 
-def main():
-    n = 10_000
-    items = make_workload(n)
+def child_cpu() -> int:
+    """No-TPU fallback: measure the engine's real CPU verify path (the
+    crypto/batch.py 'cpu' backend — OpenSSL per-sig loop).  vs_baseline is
+    ~1.0 by construction; the JSON records that no TPU speedup exists."""
+    items = make_workload(N)
+    sample = items[:1000]
+    t0 = time.perf_counter()
+    assert cpu_verify(sample)
+    cpu_ms = (time.perf_counter() - t0) * 1000.0 * (N / len(sample))
 
-    from cometbft_tpu.ops import ed25519_jax as ej
+    from cometbft_tpu.crypto import ed25519 as ced
+    bv_times = []
+    for _ in range(3):
+        t0 = time.perf_counter()
+        bv = ced.CpuBatchVerifier()
+        for pub, msg, sig in items:
+            bv.add(ced.Ed25519PubKey(pub), msg, sig)
+        ok, _ = bv.verify()
+        assert ok
+        bv_times.append((time.perf_counter() - t0) * 1000.0)
+    value = float(np.median(bv_times))
+    log(f"[bench] cpu fallback: engine path {value:.1f} ms, "
+        f"baseline {cpu_ms:.1f} ms")
+    print(json.dumps({
+        "metric": "commit_verify_10k_sigs_p50",
+        "value": round(value, 3),
+        "unit": "ms",
+        "vs_baseline": round(cpu_ms / value, 3),
+        "platform": "cpu-openssl",
+        "note": "engine CPU (OpenSSL) path; no TPU measurement",
+        "baseline_cpu_ms": round(cpu_ms, 1),
+    }))
+    return 0
+
+
+def child(platform: str) -> int:
+    """Run the measurement on `platform` ('tpu' keeps the default backend;
+    'cpu' measures the engine's OpenSSL path).  Prints the JSON line."""
+    if platform == "cpu":
+        return child_cpu()
+    import jax
+
+    t0 = time.perf_counter()
+    devs = jax.devices()
+    log(f"[bench] backend up in {time.perf_counter() - t0:.1f}s: {devs}")
+
+    items = make_workload(N)
 
     # CPU baseline (sampled, extrapolated)
     sample = items[:1000]
     t0 = time.perf_counter()
     assert cpu_verify(sample)
-    cpu_ms = (time.perf_counter() - t0) * 1000.0 * (n / len(sample))
+    cpu_ms = (time.perf_counter() - t0) * 1000.0 * (N / len(sample))
+    log(f"[bench] openssl single-sig baseline: {cpu_ms:.1f} ms / {N}")
 
-    # warm up compile for the 10k bucket, then measure end-to-end p50
+    from cometbft_tpu.ops import ed25519_jax as ej
+
+    t0 = time.perf_counter()
+    ej.warmup(N)
+    log(f"[bench] kernel warmup (compile) {time.perf_counter() - t0:.1f}s")
+
+    # end-to-end p50 over 5 runs (host prep + transfer + kernel)
     ok, mask = ej.verify_batch(items)
     assert ok, "workload must verify"
     times = []
@@ -68,15 +137,92 @@ def main():
         ok, _ = ej.verify_batch(items)
         times.append((time.perf_counter() - t0) * 1000.0)
     assert ok
-    tpu_ms = float(np.median(times))
+    e2e_ms = float(np.median(times))
+
+    # device-only time: prepped arrays resident, one dispatch
+    import jax.numpy as jnp
+    m = ej._bucket(N)
+    a = np.zeros((m, 32), np.uint8)
+    r = np.zeros((m, 32), np.uint8)
+    a[:] = np.frombuffer(ej._B_BYTES, np.uint8)
+    r[:] = np.frombuffer(ej._IDENTITY_BYTES, np.uint8)
+    win = np.zeros((ej._WINDOWS, m), np.int32)
+    da, dr = jnp.asarray(a), jnp.asarray(r)
+    dw = jnp.asarray(win)
+    ej._jit_verify(da, dr, dw, dw).block_until_ready()
+    dts = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        ej._jit_verify(da, dr, dw, dw).block_until_ready()
+        dts.append((time.perf_counter() - t0) * 1000.0)
+    dev_ms = float(np.median(dts))
+    log(f"[bench] platform={devs[0].platform} e2e_ms={e2e_ms:.2f} "
+        f"device_ms={dev_ms:.2f} runs={[round(t, 1) for t in times]}")
 
     print(json.dumps({
         "metric": "commit_verify_10k_sigs_p50",
-        "value": round(tpu_ms, 3),
+        "value": round(e2e_ms, 3),
         "unit": "ms",
-        "vs_baseline": round(cpu_ms / tpu_ms, 3),
+        "vs_baseline": round(cpu_ms / e2e_ms, 3),
+        "platform": devs[0].platform,
+        "device_ms": round(dev_ms, 3),
+        "baseline_cpu_ms": round(cpu_ms, 1),
     }))
+    return 0
+
+
+def run_child(platform: str, timeout_s: int):
+    """Returns (parsed_json_or_None, failure_description_or_None)."""
+    env = dict(os.environ, COMETBFT_TPU_BENCH_CHILD=platform)
+    try:
+        p = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            env=env, timeout=timeout_s, capture_output=True, text=True)
+    except subprocess.TimeoutExpired as e:
+        log(f"[bench] {platform} attempt timed out after {timeout_s}s")
+        stderr = e.stderr if isinstance(e.stderr, str) else \
+            (e.stderr or b"").decode(errors="replace")
+        if stderr:
+            log(stderr)
+        return None, f"timeout after {timeout_s}s"
+    log(p.stderr)
+    for line in p.stdout.splitlines():
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line), None
+            except json.JSONDecodeError:
+                continue
+    log(f"[bench] {platform} attempt rc={p.returncode}, no JSON line")
+    tail = " | ".join((p.stderr or "").strip().splitlines()[-2:])
+    return None, f"rc={p.returncode}: {tail[-300:]}"
+
+
+def main() -> int:
+    log("[bench] TPU attempt 1")
+    result, err = run_child("tpu", TPU_ATTEMPT_TIMEOUT_S)
+    if result is None and not err.startswith("timeout"):
+        # fast failure (e.g. UNAVAILABLE after pool claim denial): one retry
+        log("[bench] TPU attempt 2")
+        result, err = run_child("tpu", TPU_ATTEMPT_TIMEOUT_S)
+    if result is None:
+        # Distinguishable failure modes are preserved in tpu_error: a
+        # timeout/UNAVAILABLE is a pool hiccup, an AssertionError means the
+        # kernel itself misbehaved — never mask the latter as "unavailable".
+        log("[bench] TPU unavailable; measuring the engine's CPU "
+            "(OpenSSL) verify path instead")
+        result, cpu_err = run_child("cpu", CPU_ATTEMPT_TIMEOUT_S)
+        if result is not None:
+            result["tpu_error"] = err
+        else:
+            result = {"metric": "commit_verify_10k_sigs_p50",
+                      "value": -1.0, "unit": "ms", "vs_baseline": 0.0,
+                      "error": f"tpu: {err}; cpu: {cpu_err}"}
+    print(json.dumps(result))
+    return 0
 
 
 if __name__ == "__main__":
+    if os.environ.get("COMETBFT_TPU_BENCH_CHILD"):
+        sys.exit(child(os.environ["COMETBFT_TPU_BENCH_CHILD"]))
     sys.exit(main())

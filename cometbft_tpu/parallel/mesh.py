@@ -36,8 +36,9 @@ def sharded_verify_tally(mesh: Mesh):
     """Build the jitted multi-chip step: verify signatures sharded over the
     mesh; the collective is a psum of per-shard valid-lane counts.
 
-    Returns fn(a_bytes[n,32]u8, r_bytes[n,32]u8, s_bits[253,n]i32,
-               k_bits[253,n]i32) -> (ok[n] bool, valid_count i32).
+    Returns fn(a_bytes[n,32]u8, r_bytes[n,32]u8, s_win[64,n]i32,
+               k_win[64,n]i32) -> (ok[n] bool, valid_count i32)
+    (s_win/k_win: 4-bit little-endian scalar windows, ed25519_jax._windows_le).
 
     n must be a multiple of the mesh size.  Voting-power totals are
     aggregated on the host from the exact per-lane mask: validator powers

@@ -168,19 +168,21 @@ class TestShardedTally:
         n = 2 * ndev
         a = np.zeros((n, 32), np.uint8)
         r = np.zeros((n, 32), np.uint8)
-        s_bits = np.zeros((253, n), np.int32)
-        k_bits = np.zeros((253, n), np.int32)
-        items, golden = [], []
+        s_raw = np.zeros((n, 32), np.uint8)
+        k_raw = np.zeros((n, 32), np.uint8)
+        golden = []
         for i in range(n):
             pub, msg, sig = _sig()
             if i % 3 == 0:
                 sig = sig[:32] + (1).to_bytes(32, "little")  # bad S
             a[i] = np.frombuffer(pub, np.uint8)
             r[i] = np.frombuffer(sig[:32], np.uint8)
-            s_bits[:, i] = ej._bits_le(int.from_bytes(sig[32:], "little"))
-            k_bits[:, i] = ej._bits_le(ref.sha512_mod_l(sig[:32], pub, msg))
+            s_raw[i] = np.frombuffer(sig[32:], np.uint8)
+            k = ref.sha512_mod_l(sig[:32], pub, msg)
+            k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
             golden.append(ref.verify(pub, msg, sig))
-        ok, count = step(jnp.asarray(a), jnp.asarray(r), jnp.asarray(s_bits),
-                         jnp.asarray(k_bits))
+        ok, count = step(jnp.asarray(a), jnp.asarray(r),
+                         jnp.asarray(ej._windows_le(s_raw)),
+                         jnp.asarray(ej._windows_le(k_raw)))
         assert list(np.asarray(ok)) == golden
         assert int(count) == sum(golden)
