@@ -89,7 +89,8 @@ def cmd_show_validator(args) -> int:
         cfg.base.path(cfg.base.priv_validator_state_file))
     pub = pv.get_pub_key()
     import base64
-    print(json.dumps({"type": "tendermint/PubKeyEd25519",
+    from ..privval.file import _AMINO_NAMES
+    print(json.dumps({"type": _AMINO_NAMES[pub.type()][0],
                       "value": base64.b64encode(
                           pub.bytes()).decode()}))
     return 0
@@ -148,7 +149,8 @@ def cmd_testnet(args) -> int:
         os.makedirs(os.path.join(home, "data"), exist_ok=True)
         pv = FilePV.load_or_generate(
             cfg.base.path(cfg.base.priv_validator_key_file),
-            cfg.base.path(cfg.base.priv_validator_state_file))
+            cfg.base.path(cfg.base.priv_validator_state_file),
+            key_type=getattr(args, "key_type", "ed25519"))
         nk = NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
         pvs.append(pv)
         node_ids.append(nk.id)
@@ -245,6 +247,9 @@ def main(argv=None) -> int:
     sp.add_argument("--chain-id", default="")
     sp.add_argument("--starting-p2p-port", type=int, default=26656)
     sp.add_argument("--starting-rpc-port", type=int, default=26657)
+    sp.add_argument("--key-type", dest="key_type", default="ed25519",
+                    help="validator key type: ed25519|secp256k1|bls12_381 "
+                         "(reference: testnet.go --key-type)")
     sp.set_defaults(fn=cmd_testnet)
 
     sp = sub.add_parser("rollback", help="roll back one height")

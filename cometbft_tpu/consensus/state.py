@@ -534,7 +534,15 @@ class ConsensusState:
             return False
         if rs.proposal_block_parts is None:
             return False
-        added = rs.proposal_block_parts.add_part(msg.part)
+        try:
+            added = rs.proposal_block_parts.add_part(msg.part)
+        except (PartSetError, ValueError) as e:
+            # A part that doesn't match the current part-set header (e.g. a
+            # part raced from another round's proposal) is dropped, not a
+            # consensus failure — reference state.go:2129-2150 returns
+            # ErrPartSetInvalidProof to handleMsg, which only logs it.
+            self.logger.debug("Invalid block part", err=str(e), peer=peer_id)
+            return False
         if not added:
             return False
         max_bytes = self.sm_state.consensus_params.block.max_bytes

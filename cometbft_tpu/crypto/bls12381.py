@@ -1,0 +1,235 @@
+"""BLS12-381 keys (minimal-pubkey-size: pubkeys in G1, signatures in G2).
+
+Reference: crypto/bls12381/key_bls12381.go —
+  * PrivKey 32 bytes (blst.KeyGen / SecretKey.Serialize), Sign = compressed
+    G2 point over hash_to_g2(msg, dstMinPk) (key_bls12381.go:112-116).
+  * PubKey = 96-byte *uncompressed* G1 serialization (P1Affine.Serialize;
+    const.go PubKeySize=96), KeyValidate = subgroup + non-infinity check
+    (key_bls12381.go:158-169).
+  * Address = SumTruncated(pubkey serialize) (key_bls12381.go:172-177).
+  * VerifySignature group-checks the signature but allows infinity, since an
+    aggregate can be infinite (key_bls12381.go:179-192).
+  * DST "BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_" (key_bls12381.go:31).
+
+Aggregate path (BASELINE config #5): aggregate_signatures /
+fast_aggregate_verify / aggregate_verify mirror the blst aggregate API the
+reference links against.
+
+KeyGen follows draft-irtf-cfrg-bls-signature (HKDF loop), as blst does.
+"""
+from __future__ import annotations
+
+import hashlib
+import hmac
+import secrets
+from typing import Sequence
+
+from . import _bls12381_math as m
+from . import tmhash
+from .keys import PrivKey, PubKey
+
+KEY_TYPE = "bls12_381"
+PRIV_KEY_SIZE = 32
+PUB_KEY_SIZE = 96           # uncompressed G1
+SIGNATURE_SIZE = 96         # compressed G2
+DST = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_NUL_"
+
+ENABLED = True
+
+
+class DeserializationError(ValueError):
+    pass
+
+
+class InfinitePubKeyError(ValueError):
+    pass
+
+
+def _hkdf_extract(salt: bytes, ikm: bytes) -> bytes:
+    return hmac.new(salt, ikm, hashlib.sha256).digest()
+
+
+def _hkdf_expand(prk: bytes, info: bytes, length: int) -> bytes:
+    out = b""
+    t = b""
+    i = 1
+    while len(out) < length:
+        t = hmac.new(prk, t + info + bytes([i]), hashlib.sha256).digest()
+        out += t
+        i += 1
+    return out[:length]
+
+
+def keygen(ikm: bytes, key_info: bytes = b"") -> int:
+    """draft-irtf-cfrg-bls-signature KeyGen (the algorithm behind
+    blst.KeyGen, key_bls12381.go:66-74)."""
+    if len(ikm) < 32:
+        raise ValueError("IKM must be at least 32 bytes")
+    salt = b"BLS-SIG-KEYGEN-SALT-"
+    length = 48
+    sk = 0
+    while sk == 0:
+        salt = hashlib.sha256(salt).digest()
+        prk = _hkdf_extract(salt, ikm + b"\x00")
+        okm = _hkdf_expand(prk, key_info + length.to_bytes(2, "big"), length)
+        sk = int.from_bytes(okm, "big") % m.R_ORDER
+    return sk
+
+
+class Bls12381PubKey(PubKey):
+    __slots__ = ("_raw", "_pt")
+
+    def __init__(self, raw: bytes):
+        """Validates: deserializable, on curve, in G1 subgroup, not infinity
+        (reference NewPublicKeyFromBytes + KeyValidate)."""
+        if len(raw) != PUB_KEY_SIZE:
+            raise DeserializationError(
+                f"bls12381 pubkey must be {PUB_KEY_SIZE} bytes, got {len(raw)}")
+        try:
+            pt = m.g1_deserialize(raw)
+        except ValueError as e:
+            raise DeserializationError(str(e)) from None
+        if pt is None:
+            raise InfinitePubKeyError("bls12381: pubkey is infinite")
+        if not m.g1_in_subgroup(pt):
+            raise DeserializationError("bls12381: pubkey not in G1 subgroup")
+        self._raw = bytes(raw)
+        self._pt = pt
+
+    @classmethod
+    def _from_point_unchecked(cls, pt) -> "Bls12381PubKey":
+        """Internal: wrap an already-validated G1 point (skips the subgroup
+        check — for aggregation over keys validated at an earlier boundary,
+        e.g. genesis load or the 10k-aggregate bench)."""
+        self = object.__new__(cls)
+        self._raw = m.g1_serialize(pt)
+        self._pt = pt
+        return self
+
+    def address(self) -> bytes:
+        return tmhash.sum_truncated(self._raw)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def point(self):
+        return self._pt
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """e(pk, H(m)) == e(G1, sig); signature is group-checked but may be
+        infinite (aggregates can be — key_bls12381.go:185-188)."""
+        sig_pt = _parse_signature(sig)
+        if sig_pt is False:
+            return False
+        if sig_pt is None:
+            return False    # infinity never verifies a single message
+        hm = m.hash_to_g2(msg, DST)
+        # e(pk, H(m)) * e(-G1, sig) == 1
+        return m.pairings_product_is_one(
+            [(self._pt, hm), (m.pt_neg(m.G1_OPS, m.G1_GEN), sig_pt)])
+
+
+def _parse_signature(sig: bytes):
+    """Compressed G2 -> point | None (infinity) | False (invalid)."""
+    if len(sig) != SIGNATURE_SIZE:
+        return False
+    try:
+        pt = m.g2_uncompress(sig)
+    except ValueError:
+        return False
+    if pt is not None and not m.g2_in_subgroup(pt):
+        return False
+    return pt
+
+
+class Bls12381PrivKey(PrivKey):
+    __slots__ = ("_sk",)
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PRIV_KEY_SIZE:
+            raise DeserializationError(
+                f"bls12381 privkey must be {PRIV_KEY_SIZE} bytes, got {len(raw)}")
+        sk = int.from_bytes(raw, "big")
+        if not (0 < sk < m.R_ORDER):
+            raise DeserializationError("bls12381 privkey scalar out of range")
+        self._sk = sk
+
+    def bytes(self) -> bytes:
+        return self._sk.to_bytes(PRIV_KEY_SIZE, "big")
+
+    def sign(self, msg: bytes) -> bytes:
+        hm = m.hash_to_g2(msg, DST)
+        return m.g2_compress(m.pt_mul(m.G2_OPS, hm, self._sk))
+
+    def pub_key(self) -> Bls12381PubKey:
+        pt = m.pt_mul(m.G1_OPS, m.G1_GEN, self._sk)
+        return Bls12381PubKey(m.g1_serialize(pt))
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> Bls12381PrivKey:
+    return gen_priv_key_from_secret(secrets.token_bytes(32))
+
+
+def gen_priv_key_from_secret(secret: bytes) -> Bls12381PrivKey:
+    """Reference GenPrivKeyFromSecret (key_bls12381.go:66-74): non-32-byte
+    secrets are SHA-256'd into the KeyGen seed."""
+    if len(secret) != 32:
+        secret = hashlib.sha256(secret).digest()
+    sk = keygen(secret)
+    return Bls12381PrivKey(sk.to_bytes(PRIV_KEY_SIZE, "big"))
+
+
+# --- aggregate API (blst P2Aggregate surface) -------------------------------
+
+def aggregate_signatures(sigs: Sequence[bytes]) -> bytes:
+    """Sum compressed-G2 signatures; raises on any invalid input."""
+    if not sigs:
+        raise ValueError("no signatures to aggregate")
+    acc = None
+    for sig in sigs:
+        pt = _parse_signature(sig)
+        if pt is False:
+            raise ValueError("invalid signature in aggregate")
+        acc = m.pt_add(m.G2_OPS, acc, pt)
+    return m.g2_compress(acc)
+
+
+def fast_aggregate_verify(pub_keys: Sequence[Bls12381PubKey], msg: bytes,
+                          sig: bytes) -> bool:
+    """All signers over ONE message: aggregate pubkeys in G1 (cheap), then a
+    single pairing check — the 10k-validator aggregate path of BASELINE
+    config #5."""
+    if not pub_keys:
+        return False
+    sig_pt = _parse_signature(sig)
+    if sig_pt is False or sig_pt is None:
+        return False
+    agg = None
+    for pk in pub_keys:
+        agg = m.pt_add(m.G1_OPS, agg, pk.point())
+    hm = m.hash_to_g2(msg, DST)
+    return m.pairings_product_is_one(
+        [(agg, hm), (m.pt_neg(m.G1_OPS, m.G1_GEN), sig_pt)])
+
+
+def aggregate_verify(pub_keys: Sequence[Bls12381PubKey],
+                     msgs: Sequence[bytes], sig: bytes) -> bool:
+    """Distinct-message aggregate: prod e(pk_i, H(m_i)) == e(G1, sig).
+    Messages must be pairwise distinct (rogue-message rule)."""
+    if not pub_keys or len(pub_keys) != len(msgs):
+        return False
+    if len(set(msgs)) != len(msgs):
+        return False
+    sig_pt = _parse_signature(sig)
+    if sig_pt is False or sig_pt is None:
+        return False
+    pairs = [(pk.point(), m.hash_to_g2(msg, DST))
+             for pk, msg in zip(pub_keys, msgs)]
+    pairs.append((m.pt_neg(m.G1_OPS, m.G1_GEN), sig_pt))
+    return m.pairings_product_is_one(pairs)

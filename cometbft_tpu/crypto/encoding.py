@@ -1,13 +1,14 @@
-"""PubKey ↔ proto PublicKey conversion.
+"""PubKey ↔ proto PublicKey conversion + key-type registry.
 
 Reference: crypto/encoding/codec.go — oneof sum keyed by key type
 (proto/cometbft/crypto/v1/keys.proto: ed25519=1, secp256k1=2, bls12381=3,
-secp256k1eth=4).
+secp256k1eth=4) — and internal/keytypes/keytypes.go:15 (name -> constructor
+registry used by genesis / testnet key-type flags).
 """
 from __future__ import annotations
 
-from . import ed25519
-from .keys import PubKey
+from . import bls12381, ed25519, secp256k1
+from .keys import PrivKey, PubKey
 
 # proto oneof field name per key type
 _FIELD_BY_TYPE = {
@@ -30,13 +31,61 @@ def pub_key_to_proto(pk: PubKey) -> dict:
 
 
 def pub_key_from_proto(d: dict) -> PubKey:
-    if "ed25519" in d:
-        return ed25519.Ed25519PubKey(d["ed25519"])
+    try:
+        if "ed25519" in d:
+            return ed25519.Ed25519PubKey(d["ed25519"])
+        if "secp256k1" in d:
+            return secp256k1.Secp256k1PubKey(d["secp256k1"])
+        if "bls12381" in d:
+            return bls12381.Bls12381PubKey(d["bls12381"])
+    except ValueError as e:
+        raise EncodingError(str(e)) from None
     raise EncodingError(f"unsupported proto pubkey {sorted(d)}")
 
 
 def pub_key_from_type_and_bytes(key_type: str, raw: bytes) -> PubKey:
     """Reference: crypto/encoding codec + internal/keytypes registry."""
-    if key_type == "ed25519":
-        return ed25519.Ed25519PubKey(raw)
+    try:
+        if key_type == ed25519.KEY_TYPE:
+            return ed25519.Ed25519PubKey(raw)
+        if key_type == secp256k1.KEY_TYPE:
+            return secp256k1.Secp256k1PubKey(raw)
+        if key_type == bls12381.KEY_TYPE:
+            return bls12381.Bls12381PubKey(raw)
+    except ValueError as e:
+        raise EncodingError(str(e)) from None
+    raise EncodingError(f"unsupported key type {key_type}")
+
+
+# --- key-type registry (internal/keytypes/keytypes.go) ----------------------
+
+_GENERATORS = {
+    ed25519.KEY_TYPE: ed25519.gen_priv_key,
+    secp256k1.KEY_TYPE: secp256k1.gen_priv_key,
+    bls12381.KEY_TYPE: bls12381.gen_priv_key,
+}
+
+
+def supported_key_types() -> list[str]:
+    return sorted(_GENERATORS)
+
+
+def gen_priv_key_by_type(key_type: str) -> PrivKey:
+    gen = _GENERATORS.get(key_type)
+    if gen is None:
+        raise EncodingError(f"unsupported key type {key_type}; "
+                            f"supported: {supported_key_types()}")
+    return gen()
+
+
+def priv_key_from_type_and_bytes(key_type: str, raw: bytes) -> PrivKey:
+    try:
+        if key_type == ed25519.KEY_TYPE:
+            return ed25519.Ed25519PrivKey(raw)
+        if key_type == secp256k1.KEY_TYPE:
+            return secp256k1.Secp256k1PrivKey(raw)
+        if key_type == bls12381.KEY_TYPE:
+            return bls12381.Bls12381PrivKey(raw)
+    except ValueError as e:
+        raise EncodingError(str(e)) from None
     raise EncodingError(f"unsupported key type {key_type}")

@@ -1,0 +1,148 @@
+"""secp256k1 ECDSA keys (Cosmos-style).
+
+Reference: crypto/secp256k1/secp256k1.go —
+  * PrivKey 32 bytes, Sign = ECDSA over SHA-256(msg), 64-byte R||S output in
+    lower-S form (secp256k1.go:120-131).
+  * PubKey = 33-byte compressed point (secp256k1.go:137-143).
+  * Address = RIPEMD160(SHA256(compressed pubkey)) — Bitcoin style
+    (secp256k1.go:148-172).
+  * VerifySignature rejects signatures not in lower-S form (malleability;
+    secp256k1.go:188-218).
+
+Scalar/point heavy lifting is delegated to OpenSSL via `cryptography`
+(the host-CPU fast path; this key type never batches — reference
+crypto/batch/batch.go supports ed25519 only), with R||S <-> DER conversion
+and low-S normalization done here.
+"""
+from __future__ import annotations
+
+import hashlib
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+
+from .keys import PrivKey, PubKey
+
+KEY_TYPE = "secp256k1"
+PRIV_KEY_SIZE = 32
+PUB_KEY_SIZE = 33          # compressed: 02/03 parity byte + x-coordinate
+SIG_SIZE = 64              # R || S
+
+# Curve order (reference: secp256k1.S256().N).
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_HALF_N = _N // 2
+
+_CURVE = ec.SECP256K1()
+_PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))
+
+
+def _sha256(b: bytes) -> bytes:
+    return hashlib.sha256(b).digest()
+
+
+class Secp256k1PubKey(PubKey):
+    __slots__ = ("_raw", "_pk")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PUB_KEY_SIZE:
+            raise ValueError(
+                f"secp256k1 pubkey must be {PUB_KEY_SIZE} bytes, got {len(raw)}")
+        self._raw = bytes(raw)
+        self._pk = None  # parsed lazily: parse failures surface in verify
+
+    def address(self) -> bytes:
+        """Bitcoin-style RIPEMD160(SHA256(pubkey)). Ref secp256k1.go:148."""
+        h = hashlib.new("ripemd160")
+        h.update(_sha256(self._raw))
+        return h.digest()
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def _parsed(self):
+        if self._pk is None:
+            self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+                _CURVE, self._raw)
+        return self._pk
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        """64-byte R||S; rejects high-S (malleable) signatures.
+
+        Reference: secp256k1.go:188-218 VerifySignature.
+        """
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < _N) or not (0 < s < _N) or s > _HALF_N:
+            return False
+        try:
+            der = encode_dss_signature(r, s)
+            self._parsed().verify(der, _sha256(msg), _PREHASHED_SHA256)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class Secp256k1PrivKey(PrivKey):
+    __slots__ = ("_raw", "_sk")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PRIV_KEY_SIZE:
+            raise ValueError(
+                f"secp256k1 privkey must be {PRIV_KEY_SIZE} bytes, got {len(raw)}")
+        d = int.from_bytes(raw, "big")
+        if not (0 < d < _N):
+            raise ValueError("secp256k1 privkey scalar out of range")
+        self._raw = bytes(raw)
+        self._sk = ec.derive_private_key(d, _CURVE)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def sign(self, msg: bytes) -> bytes:
+        """ECDSA over SHA-256(msg); returns R||S with S normalized to the
+        lower half-order. Ref secp256k1.go:120-131."""
+        der = self._sk.sign(_sha256(msg), _PREHASHED_SHA256)
+        r, s = decode_dss_signature(der)
+        if s > _HALF_N:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1PubKey:
+        from cryptography.hazmat.primitives.serialization import (
+            Encoding, PublicFormat,
+        )
+        raw = self._sk.public_key().public_bytes(
+            Encoding.X962, PublicFormat.CompressedPoint)
+        return Secp256k1PubKey(raw)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> Secp256k1PrivKey:
+    """Random scalar in (0, N). Ref secp256k1.go:62-88."""
+    while True:
+        raw = secrets.token_bytes(PRIV_KEY_SIZE)
+        d = int.from_bytes(raw, "big")
+        if 0 < d < _N:
+            return Secp256k1PrivKey(raw)
+
+
+def gen_priv_key_from_secret(secret: bytes) -> Secp256k1PrivKey:
+    """Deterministic: k = (SHA256(secret) mod (N-1)) + 1.
+    Ref secp256k1.go:93-118 GenPrivKeySecp256k1."""
+    fe = int.from_bytes(_sha256(secret), "big")
+    d = fe % (_N - 1) + 1
+    return Secp256k1PrivKey(d.to_bytes(32, "big"))

@@ -29,7 +29,7 @@ from ..state.execution import BlockExecutor
 from ..state.store import Store
 from ..store import BlockStore
 from ..types.events import EventBus
-from ..types.genesis import GenesisDoc
+from ..types.genesis import GenesisDoc, pub_key_to_json
 from ..abci import types as abci
 
 
@@ -295,9 +295,7 @@ class Node:
             },
             "validator_info": {
                 "address": pub.address().hex().upper(),
-                "pub_key": {"type": "tendermint/PubKeyEd25519",
-                            "value": __import__("base64").b64encode(
-                                pub.bytes()).decode()},
+                "pub_key": pub_key_to_json(pub),
                 "voting_power": str(_voting_power(state, pub)),
             },
         }

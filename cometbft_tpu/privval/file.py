@@ -15,6 +15,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from ..crypto import ed25519
+from ..crypto import encoding as crypto_encoding
 from ..crypto.keys import PrivKey, PubKey
 from ..types import canonical
 from ..types.priv_validator import PrivValidator
@@ -22,6 +23,16 @@ from ..types.proposal import Proposal
 from ..types.timestamp import Timestamp
 from ..types.vote import Vote
 from ..wire import pb, unmarshal_delimited
+
+# amino-JSON type names per key type (reference: cmtjson.RegisterType in
+# crypto/{ed25519,secp256k1,bls12381}): (pubkey name, privkey name)
+_AMINO_NAMES = {
+    "ed25519": ("tendermint/PubKeyEd25519", "tendermint/PrivKeyEd25519"),
+    "secp256k1": ("tendermint/PubKeySecp256k1",
+                  "tendermint/PrivKeySecp256k1"),
+    "bls12_381": ("cometbft/PubKeyBls12_381", "cometbft/PrivKeyBls12_381"),
+}
+_KEY_TYPE_BY_PRIV_NAME = {v[1]: k for k, v in _AMINO_NAMES.items()}
 
 # sign step (reference: privval/file.go stepPropose/Prevote/Precommit)
 STEP_PROPOSE = 1
@@ -113,9 +124,12 @@ class FilePV(PrivValidator):
 
     # ------------------------------------------------------------------
     @classmethod
-    def generate(cls, key_file_path: str,
-                 state_file_path: str) -> "FilePV":
-        pv = cls(ed25519.gen_priv_key(), key_file_path, state_file_path)
+    def generate(cls, key_file_path: str, state_file_path: str,
+                 key_type: str = ed25519.KEY_TYPE) -> "FilePV":
+        """Reference: privval.GenFilePV with keytypes registry (testnet
+        --key-type flag)."""
+        pv = cls(crypto_encoding.gen_priv_key_by_type(key_type),
+                 key_file_path, state_file_path)
         pv.save()
         return pv
 
@@ -124,8 +138,14 @@ class FilePV(PrivValidator):
              state_file_path: str) -> "FilePV":
         with open(key_file_path) as f:
             kd = json.load(f)
-        priv = ed25519.Ed25519PrivKey(
-            base64.b64decode(kd["priv_key"]["value"]))
+        amino_name = kd["priv_key"].get("type",
+                                        "tendermint/PrivKeyEd25519")
+        key_type = _KEY_TYPE_BY_PRIV_NAME.get(amino_name)
+        if key_type is None:
+            raise PrivValidatorError(
+                f"unknown priv_key type {amino_name!r}")
+        priv = crypto_encoding.priv_key_from_type_and_bytes(
+            key_type, base64.b64decode(kd["priv_key"]["value"]))
         lss = LastSignState()
         if os.path.exists(state_file_path):
             with open(state_file_path) as f:
@@ -133,11 +153,11 @@ class FilePV(PrivValidator):
         return cls(priv, key_file_path, state_file_path, lss)
 
     @classmethod
-    def load_or_generate(cls, key_file_path: str,
-                         state_file_path: str) -> "FilePV":
+    def load_or_generate(cls, key_file_path: str, state_file_path: str,
+                         key_type: str = ed25519.KEY_TYPE) -> "FilePV":
         if os.path.exists(key_file_path):
             return cls.load(key_file_path, state_file_path)
-        return cls.generate(key_file_path, state_file_path)
+        return cls.generate(key_file_path, state_file_path, key_type)
 
     def save(self) -> None:
         pub = self.priv_key.pub_key()
@@ -146,10 +166,10 @@ class FilePV(PrivValidator):
         with open(self.key_file_path, "w") as f:
             json.dump({
                 "address": pub.address().hex().upper(),
-                "pub_key": {"type": "tendermint/PubKeyEd25519",
+                "pub_key": {"type": _AMINO_NAMES[pub.type()][0],
                             "value": base64.b64encode(
                                 pub.bytes()).decode()},
-                "priv_key": {"type": "tendermint/PrivKeyEd25519",
+                "priv_key": {"type": _AMINO_NAMES[pub.type()][1],
                              "value": base64.b64encode(
                                  self.priv_key.bytes()).decode()},
             }, f, indent=2)

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..abci import types as abci
 from ..mempool.mempool import InvalidTxError, MempoolError, TxInCacheError
+from ..types import genesis
 from ..types.tx import tx_hash
 
 
@@ -334,9 +335,7 @@ async def _validators(env, height, page, per_page):
         "block_height": str(h),
         "validators": [
             {"address": v.address.hex().upper(),
-             "pub_key": {"type": "tendermint/PubKeyEd25519",
-                         "value": base64.b64encode(
-                             v.pub_key.bytes()).decode()},
+             "pub_key": genesis.pub_key_to_json(v.pub_key),
              "voting_power": str(v.voting_power),
              "proposer_priority": str(v.proposer_priority)}
             for v in sel],

@@ -13,11 +13,11 @@ from ..wire import pb, encode
 from .block_id import BlockID
 from .timestamp import Timestamp
 from .vote import (
-    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL, Vote,
+    BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT, BLOCK_ID_FLAG_NIL,
+    MAX_SIGNATURE_SIZE, Vote,
 )
 from . import canonical
 
-MAX_SIGNATURE_SIZE = 64  # ed25519; reference: types/block.go MaxSignatureSize
 
 _VALID_FLAGS = (BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT,
                 BLOCK_ID_FLAG_NIL)

@@ -9,6 +9,7 @@ from dataclasses import dataclass, field
 
 from . import canonical
 from .block_id import BlockID
+from .vote import MAX_SIGNATURE_SIZE
 from .part_set import PartSetError
 from .timestamp import Timestamp
 
@@ -52,7 +53,7 @@ class Proposal:
             raise ProposalError("expected a complete, non-empty BlockID")
         if not self.signature:
             raise ProposalError("signature is missing")
-        if len(self.signature) > 64:
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
             raise ProposalError("signature is too big")
 
     def is_timely(self, recv_time: Timestamp, sp) -> bool:

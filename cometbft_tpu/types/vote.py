@@ -13,6 +13,9 @@ from .block_id import BlockID
 from .part_set import PartSetError
 from .timestamp import Timestamp
 
+# max(ed25519=64, bls12_381=96); reference: types/signable.go:13
+MAX_SIGNATURE_SIZE = 96
+
 # reference: types/vote.go:20 — 1 MiB cap on any single extension
 MAX_VOTE_EXTENSION_SIZE = 1024 * 1024
 
@@ -125,7 +128,7 @@ class Vote:
             raise VoteError("negative validator index")
         if len(self.signature) == 0:
             raise VoteError("signature is missing")
-        if len(self.signature) > 64:
+        if len(self.signature) > MAX_SIGNATURE_SIZE:
             raise VoteError("signature is too big")
         if self.type == canonical.PRECOMMIT_TYPE and \
                 not self.block_id.is_nil():
@@ -135,7 +138,7 @@ class Vote:
                 raise VoteError("vote extension signature is missing")
             if len(self.non_rp_extension) > MAX_VOTE_EXTENSION_SIZE:
                 raise VoteError("non-RP vote extension too big")
-            if len(self.non_rp_extension_signature) > 64:
+            if len(self.non_rp_extension_signature) > MAX_SIGNATURE_SIZE:
                 raise VoteError("non-RP extension signature is too big")
             if self.non_rp_extension and \
                     not self.non_rp_extension_signature:

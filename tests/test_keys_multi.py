@@ -1,0 +1,233 @@
+"""secp256k1 + bls12381 key types and mixed-key validator sets.
+
+Reference behaviors: crypto/secp256k1/secp256k1.go (lower-S rule, Bitcoin
+addresses), crypto/bls12381/key_bls12381.go (G1 pubkeys / G2 sigs,
+aggregates), types/validator_set.go:845 AllKeysHaveSameType gating the
+batch path (types/validation.go:15-21).
+"""
+import pytest
+
+from cometbft_tpu.crypto import _bls12381_math as blsm
+from cometbft_tpu.crypto import bls12381, ed25519, encoding, secp256k1
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import Commit, CommitSig
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validation import verify_commit
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+
+
+class TestSecp256k1:
+    def test_sign_verify(self):
+        sk = secp256k1.gen_priv_key()
+        pk = sk.pub_key()
+        msg = b"hello consensus"
+        sig = sk.sign(msg)
+        assert len(sig) == 64
+        assert pk.verify_signature(msg, sig)
+        assert not pk.verify_signature(msg + b"!", sig)
+        assert not pk.verify_signature(msg, sig[:-1] + bytes([sig[-1] ^ 1]))
+
+    def test_pubkey_shape_and_address(self):
+        sk = secp256k1.gen_priv_key()
+        pk = sk.pub_key()
+        assert len(pk.bytes()) == 33
+        assert pk.bytes()[0] in (2, 3)
+        assert len(pk.address()) == 20
+        assert pk.type() == "secp256k1"
+
+    def test_high_s_rejected(self):
+        """Malleated (N - S) signatures must not verify
+        (reference secp256k1.go:188-218)."""
+        sk = secp256k1.gen_priv_key()
+        msg = b"malleability"
+        sig = sk.sign(msg)
+        r, s = sig[:32], int.from_bytes(sig[32:], "big")
+        high_s = (secp256k1._N - s).to_bytes(32, "big")
+        assert not sk.pub_key().verify_signature(msg, r + high_s)
+
+    def test_deterministic_from_secret(self):
+        a = secp256k1.gen_priv_key_from_secret(b"seed")
+        b = secp256k1.gen_priv_key_from_secret(b"seed")
+        assert a.bytes() == b.bytes()
+        assert a.pub_key().bytes() == b.pub_key().bytes()
+
+    def test_roundtrip_via_encoding(self):
+        pk = secp256k1.gen_priv_key().pub_key()
+        d = encoding.pub_key_to_proto(pk)
+        assert encoding.pub_key_from_proto(d) == pk
+
+
+class TestBls12381:
+    def test_sign_verify(self):
+        sk = bls12381.gen_priv_key()
+        pk = sk.pub_key()
+        msg = b"bls block vote"
+        sig = sk.sign(msg)
+        assert len(sig) == 96
+        assert len(pk.bytes()) == 96
+        assert pk.verify_signature(msg, sig)
+        assert not pk.verify_signature(msg + b"!", sig)
+
+    def test_address_and_type(self):
+        pk = bls12381.gen_priv_key_from_secret(b"s").pub_key()
+        assert len(pk.address()) == 20
+        assert pk.type() == "bls12_381"
+
+    def test_deterministic_keygen(self):
+        a = bls12381.gen_priv_key_from_secret(b"same secret")
+        b = bls12381.gen_priv_key_from_secret(b"same secret")
+        assert a.bytes() == b.bytes()
+        assert a.pub_key().bytes() == b.pub_key().bytes()
+
+    def test_infinite_pubkey_rejected(self):
+        inf = bytes([0x40]) + bytes(95)
+        with pytest.raises(ValueError):
+            bls12381.Bls12381PubKey(inf)
+
+    def test_serialization_roundtrip(self):
+        sk = bls12381.gen_priv_key_from_secret(b"ser")
+        pk_pt = blsm.g1_deserialize(sk.pub_key().bytes())
+        assert blsm.g1_uncompress(blsm.g1_compress(pk_pt)) == pk_pt
+        sig = sk.sign(b"m")
+        sig_pt = blsm.g2_uncompress(sig)
+        assert blsm.g2_compress(sig_pt) == sig
+
+    def test_fast_aggregate_verify(self):
+        """All validators sign ONE message (the aggregate-commit shape of
+        BASELINE config #5)."""
+        msg = b"canonical vote bytes at height H"
+        sks = [bls12381.gen_priv_key_from_secret(bytes([i]) * 8)
+               for i in range(4)]
+        pks = [sk.pub_key() for sk in sks]
+        agg = bls12381.aggregate_signatures([sk.sign(msg) for sk in sks])
+        assert bls12381.fast_aggregate_verify(pks, msg, agg)
+        assert not bls12381.fast_aggregate_verify(pks, msg + b"!", agg)
+        assert not bls12381.fast_aggregate_verify(pks[:3], msg, agg)
+
+    def test_aggregate_verify_distinct_msgs(self):
+        sks = [bls12381.gen_priv_key_from_secret(bytes([40 + i]) * 4)
+               for i in range(3)]
+        pks = [sk.pub_key() for sk in sks]
+        msgs = [b"m0", b"m1", b"m2"]
+        agg = bls12381.aggregate_signatures(
+            [sk.sign(m) for sk, m in zip(sks, msgs)])
+        assert bls12381.aggregate_verify(pks, msgs, agg)
+        assert not bls12381.aggregate_verify(pks, [b"m0", b"m1", b"mX"], agg)
+        # duplicate messages rejected (rogue-message rule)
+        assert not bls12381.aggregate_verify(pks, [b"m0", b"m0", b"m2"],
+                                             agg)
+
+
+class TestMixedKeyValidatorSet:
+    def _commit_fixture(self, privs, chain_id="mixed-chain", height=3):
+        vals = [Validator.new(pk.pub_key(), 10) for pk in privs]
+        pairs = sorted(zip(vals, privs),
+                       key=lambda vp: (-vp[0].voting_power, vp[0].address))
+        vals = [p[0] for p in pairs]
+        privs = [p[1] for p in pairs]
+        vset = ValidatorSet(vals)
+        block_id = BlockID(hash=b"\x21" * 32,
+                           part_set_header=PartSetHeader(1, b"\x43" * 32))
+        sigs = []
+        for i, (val, priv) in enumerate(zip(vset.validators, privs)):
+            ts = Timestamp(1700000000 + i, 0)
+            v = Vote(type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+                     block_id=block_id, timestamp=ts,
+                     validator_address=val.address, validator_index=i)
+            sigs.append(CommitSig(block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                                  validator_address=val.address,
+                                  timestamp=ts,
+                                  signature=priv.sign(v.sign_bytes(chain_id))))
+        commit = Commit(height=height, round=0, block_id=block_id,
+                        signatures=sigs)
+        return chain_id, vset, block_id, height, commit
+
+    def test_mixed_keys_disable_batch_and_verify(self):
+        """Mixed key types must fall back to the single-sig path
+        (reference types/validation.go:15-21) and still verify."""
+        privs = [ed25519.gen_priv_key(), ed25519.gen_priv_key(),
+                 secp256k1.gen_priv_key(),
+                 bls12381.gen_priv_key_from_secret(b"v3")]
+        chain_id, vset, bid, h, commit = self._commit_fixture(privs)
+        assert not vset.all_keys_have_same_type()
+        verify_commit(chain_id, vset, bid, h, commit)
+
+    def test_single_type_set_reports_same_type(self):
+        privs = [secp256k1.gen_priv_key() for _ in range(3)]
+        chain_id, vset, bid, h, commit = self._commit_fixture(privs)
+        assert vset.all_keys_have_same_type()
+        verify_commit(chain_id, vset, bid, h, commit)
+
+
+class TestStressMixed10k:
+    """BASELINE config #5: 10k-validator Commit, mixed key types, plus the
+    bls12381 aggregate-sig path."""
+
+    def test_10k_mixed_key_commit_verify(self):
+        chain_id, height = "stress-chain", 9
+        n_ed = 9990
+        privs = [ed25519.gen_priv_key() for _ in range(n_ed)]
+        privs += [secp256k1.gen_priv_key() for _ in range(8)]
+        privs += [bls12381.gen_priv_key_from_secret(bytes([i]) * 2)
+                  for i in range(2)]
+        vals = [Validator.new(pk.pub_key(), 5) for pk in privs]
+        pairs = sorted(zip(vals, privs),
+                       key=lambda vp: (-vp[0].voting_power, vp[0].address))
+        vset = ValidatorSet([p[0] for p in pairs])
+        privs = [p[1] for p in pairs]
+        assert not vset.all_keys_have_same_type()
+        block_id = BlockID(hash=b"\x77" * 32,
+                           part_set_header=PartSetHeader(1, b"\x99" * 32))
+        sigs = []
+        for i, (val, priv) in enumerate(zip(vset.validators, privs)):
+            ts = Timestamp(1700000000, 0)
+            v = Vote(type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+                     block_id=block_id, timestamp=ts,
+                     validator_address=val.address, validator_index=i)
+            sigs.append(CommitSig(
+                block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                validator_address=val.address, timestamp=ts,
+                signature=priv.sign(v.sign_bytes(chain_id))))
+        commit = Commit(height=height, round=0, block_id=block_id,
+                        signatures=sigs)
+        # mixed keys -> single-sig fallback path, all 10k must verify
+        verify_commit(chain_id, vset, block_id, height, commit)
+
+    def test_10k_bls_aggregate(self):
+        """10k G1 pubkey aggregation + one pairing check over a shared
+        message (aggregate-signature commit shape)."""
+        msg = b"one canonical commit message"
+        # aggregate pubkey/sig pair built by scalar identity:
+        # sum_i sk_i applied to G1/H(m); signer count kept real via
+        # per-signer pubkey objects over distinct scalars.
+        import cometbft_tpu.crypto._bls12381_math as mm
+        n = 10_000
+        scalars = [i + 2 for i in range(n)]
+        # consecutive scalars -> derive pubkeys incrementally (one G1 add
+        # per key instead of a full scalar mult; pure-python test budget)
+        pks = []
+        pt = mm.pt_mul(mm.G1_OPS, mm.G1_GEN, scalars[0])
+        for _ in range(n):
+            pks.append(bls12381.Bls12381PubKey._from_point_unchecked(pt))
+            pt = mm.pt_add(mm.G1_OPS, pt, mm.G1_GEN)
+        sig_scalar = sum(scalars) % mm.R_ORDER
+        agg_sig = bls12381.Bls12381PrivKey(
+            sig_scalar.to_bytes(32, "big")).sign(msg)
+        assert bls12381.fast_aggregate_verify(pks, msg, agg_sig)
+        assert not bls12381.fast_aggregate_verify(pks, msg + b"!", agg_sig)
+
+
+class TestKeyRegistry:
+    def test_gen_by_type_roundtrip(self):
+        for kt in encoding.supported_key_types():
+            sk = encoding.gen_priv_key_by_type(kt)
+            assert sk.type() == kt
+            sk2 = encoding.priv_key_from_type_and_bytes(kt, sk.bytes())
+            assert sk2.pub_key() == sk.pub_key()
+            pk = encoding.pub_key_from_type_and_bytes(
+                kt, sk.pub_key().bytes())
+            assert pk == sk.pub_key()
