@@ -135,6 +135,13 @@ class AppConns:
     NewConnSyncLocalClientCreator semantics).
     """
 
+    async def start(self) -> None:
+        """No-op: local conns have no transport (lifecycle parity with
+        SocketAppConns)."""
+
+    async def stop(self) -> None:
+        """No-op."""
+
     def __init__(self, app: abci.Application, sync: bool = True):
         if sync:
             lock = asyncio.Lock()
@@ -158,11 +165,217 @@ class ClientCreator:
         self._addr = addr
         self._transport = transport
 
-    def new_app_conns(self) -> AppConns:
+    def new_app_conns(self):
         if self._transport in ("local", "builtin", "builtin_unsync"):
             if self._app is None:
                 raise ABCIClientError("local client requires an app")
             return AppConns(self._app,
                             sync=self._transport != "builtin_unsync")
+        if self._transport in ("socket", "unix", "tcp"):
+            return SocketAppConns(self._addr)
         raise ABCIClientError(
-            f"transport {self._transport!r} not yet supported")
+            f"transport {self._transport!r} not supported")
+
+
+class SocketClient:
+    """Pipelined async client over a unix/tcp socket.
+
+    Reference: abci/client/socket_client.go:515 — requests are written
+    immediately and matched FIFO against the response stream, so many
+    calls (e.g. mempool CheckTx under load) can be in flight at once; the
+    server processes them in order, which preserves the per-connection
+    ABCI ordering contract.  An ExceptionResponse or transport error fails
+    every pending call (reference StopForError semantics).
+    """
+
+    def __init__(self, address: str, logger=None):
+        from ..libs.log import new_logger
+        self.address = address
+        self.logger = logger or new_logger("abci-client")
+        self._reader = None
+        self._writer = None
+        self._pending: "asyncio.Queue[tuple[str, asyncio.Future]]" = None  # type: ignore[assignment]
+        self._recv_task = None
+        self._err: Optional[Exception] = None
+
+    async def connect(self, retries: int = 80,
+                      retry_delay: float = 0.25) -> None:
+        from .server import parse_address
+        scheme, host, port = parse_address(self.address)
+        last: Optional[Exception] = None
+        for _ in range(retries):
+            try:
+                if scheme == "unix":
+                    self._reader, self._writer = \
+                        await asyncio.open_unix_connection(host)
+                else:
+                    self._reader, self._writer = \
+                        await asyncio.open_connection(host, port)
+                break
+            except OSError as e:
+                last = e
+                await asyncio.sleep(retry_delay)
+        else:
+            raise ABCIClientError(
+                f"cannot connect to ABCI app at {self.address}: {last}")
+        self._pending = asyncio.Queue()
+        self._recv_task = asyncio.create_task(self._recv_loop())
+
+    async def close(self) -> None:
+        if self._err is None:
+            self._err = ABCIClientError("client closed")
+        self._fail_pending(self._err)
+        if self._recv_task is not None:
+            self._recv_task.cancel()
+            try:
+                await self._recv_task
+            except (asyncio.CancelledError, Exception):
+                pass
+        if self._writer is not None:
+            try:
+                self._writer.close()
+            except Exception:
+                pass
+
+    async def _recv_loop(self) -> None:
+        from . import pb
+        from .server import read_frame
+        fut = None
+        try:
+            while True:
+                payload = await read_frame(self._reader)
+                if payload is None:
+                    raise ABCIClientError("ABCI connection closed by app")
+                resp = pb.decode_response(payload)
+                if self._pending.empty():
+                    raise ABCIClientError(
+                        f"unsolicited {type(resp).__name__}")
+                want, fut = self._pending.get_nowait()
+                if isinstance(resp, abci.ExceptionResponse):
+                    # reference StopForError semantics: an app exception
+                    # is fatal — the app's state is unknown, so fail this
+                    # call, every pending call, and the client itself
+                    raise ABCIClientError(f"app exception: {resp.error}")
+                got = type(resp).__name__.replace("Response", "")
+                if got != want:
+                    raise ABCIClientError(
+                        f"response out of order: want {want}, got {got}")
+                if not fut.done():
+                    fut.set_result(resp)
+                fut = None
+        except asyncio.CancelledError:
+            if fut is not None and not fut.done():
+                fut.set_exception(ABCIClientError("client stopped"))
+            self._fail_pending(ABCIClientError("client stopped"))
+            raise
+        except Exception as e:  # noqa: BLE001 — fail every in-flight call
+            self._err = e
+            if fut is not None and not fut.done():
+                fut.set_exception(e)
+            self._fail_pending(e)
+
+    def _fail_pending(self, err: Exception) -> None:
+        while self._pending is not None and not self._pending.empty():
+            _, fut = self._pending.get_nowait()
+            if not fut.done():
+                fut.set_exception(err)
+
+    async def _call(self, req, want: str):
+        from . import pb
+        if self._err is not None:
+            raise ABCIClientError(f"ABCI client dead: {self._err}")
+        loop = asyncio.get_running_loop()
+        fut: asyncio.Future = loop.create_future()
+        self._pending.put_nowait((want, fut))
+        data = pb.encode_request_frame(req)
+        if want != "Flush":
+            # reference socket_client.go follows every queued request
+            # with a Flush so a buffered-writer server (the Go one)
+            # actually sends the response; the flush response resolves a
+            # throwaway future to keep FIFO matching aligned
+            self._pending.put_nowait(("Flush", loop.create_future()))
+            data += pb.encode_request_frame(abci.FlushRequest())
+        self._writer.write(data)
+        await self._writer.drain()
+        return await fut
+
+    # -- the 15-method surface + echo/flush ------------------------------
+    async def echo(self, message: str) -> abci.EchoResponse:
+        return await self._call(abci.EchoRequest(message=message), "Echo")
+
+    async def flush(self) -> None:
+        await self._call(abci.FlushRequest(), "Flush")
+
+    async def info(self, req: abci.InfoRequest) -> abci.InfoResponse:
+        return await self._call(req, "Info")
+
+    async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
+        return await self._call(req, "Query")
+
+    async def check_tx(self, req: abci.CheckTxRequest
+                       ) -> abci.CheckTxResponse:
+        return await self._call(req, "CheckTx")
+
+    async def init_chain(self, req: abci.InitChainRequest
+                         ) -> abci.InitChainResponse:
+        return await self._call(req, "InitChain")
+
+    async def prepare_proposal(self, req: abci.PrepareProposalRequest
+                               ) -> abci.PrepareProposalResponse:
+        return await self._call(req, "PrepareProposal")
+
+    async def process_proposal(self, req: abci.ProcessProposalRequest
+                               ) -> abci.ProcessProposalResponse:
+        return await self._call(req, "ProcessProposal")
+
+    async def finalize_block(self, req: abci.FinalizeBlockRequest
+                             ) -> abci.FinalizeBlockResponse:
+        return await self._call(req, "FinalizeBlock")
+
+    async def extend_vote(self, req: abci.ExtendVoteRequest
+                          ) -> abci.ExtendVoteResponse:
+        return await self._call(req, "ExtendVote")
+
+    async def verify_vote_extension(
+            self, req: abci.VerifyVoteExtensionRequest
+    ) -> abci.VerifyVoteExtensionResponse:
+        return await self._call(req, "VerifyVoteExtension")
+
+    async def commit(self) -> abci.CommitResponse:
+        return await self._call(abci.CommitRequest(), "Commit")
+
+    async def list_snapshots(self, req: abci.ListSnapshotsRequest
+                             ) -> abci.ListSnapshotsResponse:
+        return await self._call(req, "ListSnapshots")
+
+    async def offer_snapshot(self, req: abci.OfferSnapshotRequest
+                             ) -> abci.OfferSnapshotResponse:
+        return await self._call(req, "OfferSnapshot")
+
+    async def load_snapshot_chunk(self, req: abci.LoadSnapshotChunkRequest
+                                  ) -> abci.LoadSnapshotChunkResponse:
+        return await self._call(req, "LoadSnapshotChunk")
+
+    async def apply_snapshot_chunk(
+            self, req: abci.ApplySnapshotChunkRequest
+    ) -> abci.ApplySnapshotChunkResponse:
+        return await self._call(req, "ApplySnapshotChunk")
+
+
+class SocketAppConns:
+    """proxy.AppConns over four socket connections to one app process
+    (reference: multi_app_conn.go creates one client per named conn)."""
+
+    def __init__(self, address: str):
+        self.consensus = SocketClient(address)
+        self.mempool = SocketClient(address)
+        self.query = SocketClient(address)
+        self.snapshot = SocketClient(address)
+
+    async def start(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            await c.connect()
+
+    async def stop(self) -> None:
+        for c in (self.consensus, self.mempool, self.query, self.snapshot):
+            await c.close()

@@ -88,11 +88,8 @@ def cmd_show_validator(args) -> int:
         cfg.base.path(cfg.base.priv_validator_key_file),
         cfg.base.path(cfg.base.priv_validator_state_file))
     pub = pv.get_pub_key()
-    import base64
-    from ..privval.file import _AMINO_NAMES
-    print(json.dumps({"type": _AMINO_NAMES[pub.type()][0],
-                      "value": base64.b64encode(
-                          pub.bytes()).decode()}))
+    from ..types.genesis import pub_key_to_json
+    print(json.dumps(pub_key_to_json(pub)))
     return 0
 
 

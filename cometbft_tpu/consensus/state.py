@@ -15,6 +15,7 @@ import asyncio
 from typing import Callable, Optional
 
 from ..config import ConsensusConfig
+from ..libs import fail
 from ..libs.log import Logger, new_logger
 from ..state.execution import BlockExecutor
 from ..state.state import State as SMState
@@ -852,6 +853,8 @@ class ConsensusState:
                          hash=block.hash().hex().upper()[:12],
                          num_txs=len(block.data.txs))
 
+        fail.fail()    # crash point: before block save (state.go:1872)
+
         if self.block_store.height < block.header.height:
             seen_ext = rs.votes.precommits(rs.commit_round) \
                 .make_extended_commit(
@@ -865,9 +868,15 @@ class ConsensusState:
                 self.block_store.save_block(block, block_parts,
                                             seen_ext.to_commit())
 
+        fail.fail()    # crash point: block saved, WAL barrier not yet
+                       # written (state.go:1889)
+
         # fsync'd end-of-height barrier BEFORE ApplyBlock: on crash,
         # replay/handshake re-applies the block
         self.wal.write_end_height(height)
+
+        fail.fail()    # crash point: barrier written, block not applied
+                       # (state.go:1911)
 
         state_copy = self.sm_state.copy()
         state_copy = await self.block_exec.apply_verified_block(
@@ -875,6 +884,9 @@ class ConsensusState:
             BlockID(hash=block.hash(),
                     part_set_header=block_parts.header()),
             block, block.header.height)
+
+        fail.fail()    # crash point: applied, consensus state not yet
+                       # advanced (state.go:1933)
 
         self.update_to_state(state_copy)
         if self.priv_validator is not None:

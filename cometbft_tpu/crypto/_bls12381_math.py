@@ -524,7 +524,10 @@ def g1_deserialize(data: bytes):
         raise ValueError("bad G1 uncompressed length")
     flags = data[0]
     if flags & 0x80:
-        return g1_uncompress(data[:48])    # tolerate compressed input
+        # a 96-byte blob with the compressed flag set is NOT a valid
+        # uncompressed encoding — accepting it would make pubkey bytes
+        # (and the addresses hashed from them) malleable
+        raise ValueError("compressed flag in uncompressed G1 encoding")
     if flags & 0x40:
         if any(data[1:]):
             raise ValueError("bad G1 infinity encoding")

@@ -57,6 +57,21 @@ def pub_key_from_type_and_bytes(key_type: str, raw: bytes) -> PubKey:
     raise EncodingError(f"unsupported key type {key_type}")
 
 
+# amino-JSON type names per key type (reference: cmtjson.RegisterType in
+# crypto/{ed25519,secp256k1,bls12381}) — the single source for genesis
+# JSON, privval key files, and show-validator output
+AMINO_PUBKEY_NAMES = {
+    "ed25519": "tendermint/PubKeyEd25519",
+    "secp256k1": "tendermint/PubKeySecp256k1",
+    "bls12_381": "cometbft/PubKeyBls12_381",
+}
+AMINO_PRIVKEY_NAMES = {
+    "ed25519": "tendermint/PrivKeyEd25519",
+    "secp256k1": "tendermint/PrivKeySecp256k1",
+    "bls12_381": "cometbft/PrivKeyBls12_381",
+}
+
+
 # --- key-type registry (internal/keytypes/keytypes.go) ----------------------
 
 _GENERATORS = {

@@ -1,0 +1,236 @@
+"""Prometheus-style metrics: registry + text exposition.
+
+Reference: libs/metrics (go-kit metrics with a Prometheus provider) and
+the per-package metrics.go files (internal/consensus/metrics.go:190,
+mempool, p2p, state, blocksync, statesync, proxy).  Served at /metrics
+by the instrumentation listener (node/node.go prometheusSrv).
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Sequence
+
+
+def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+def _fmt_value(v: float) -> str:
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(v)
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: Sequence[str] = ()):
+        self.name = name
+        self.help = help_
+        self.label_names = tuple(label_names)
+        self._children: dict[tuple, "_Metric"] = {}
+        self._lock = threading.Lock()
+
+    def with_labels(self, *values: str):
+        if len(values) != len(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected {len(self.label_names)} label "
+                f"values, got {len(values)}")
+        key = tuple(str(v) for v in values)
+        with self._lock:
+            child = self._children.get(key)
+            if child is None:
+                child = self._new_child(key)
+                self._children[key] = child
+            return child
+
+    def _new_child(self, key: tuple):  # pragma: no cover - abstract
+        raise NotImplementedError
+
+    def _samples(self):  # -> list[(labels, value)]
+        raise NotImplementedError
+
+    def render(self) -> str:
+        lines = [f"# HELP {self.name} {self.help}",
+                 f"# TYPE {self.name} {self.kind}"]
+        for suffix, labels, value in self._samples():
+            lines.append(
+                f"{self.name}{suffix}{labels} {_fmt_value(value)}")
+        return "\n".join(lines)
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._value = 0.0
+
+    def _new_child(self, key):
+        return Counter(self.name, self.help)
+
+    def add(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError("counters only go up")
+        self._value += v
+
+    inc = add
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _samples(self):
+        if self.label_names:
+            return [("", _fmt_labels(self.label_names, k), c._value)
+                    for k, c in sorted(self._children.items())]
+        return [("", "", self._value)]
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: Sequence[str] = ()):
+        super().__init__(name, help_, label_names)
+        self._value = 0.0
+
+    def _new_child(self, key):
+        return Gauge(self.name, self.help)
+
+    def set(self, v: float) -> None:
+        self._value = float(v)
+
+    def add(self, v: float = 1.0) -> None:
+        self._value += v
+
+    def sub(self, v: float = 1.0) -> None:
+        self._value -= v
+
+    @property
+    def value(self) -> float:
+        return self._value
+
+    def _samples(self):
+        if self.label_names:
+            return [("", _fmt_labels(self.label_names, k), g._value)
+                    for k, g in sorted(self._children.items())]
+        return [("", "", self._value)]
+
+
+_DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                    5.0, 10.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    def __init__(self, name: str, help_: str,
+                 label_names: Sequence[str] = (),
+                 buckets: Sequence[float] = _DEFAULT_BUCKETS):
+        super().__init__(name, help_, label_names)
+        self.buckets = tuple(sorted(buckets))
+        self._counts = [0] * len(self.buckets)
+        self._sum = 0.0
+        self._count = 0
+
+    def _new_child(self, key):
+        return Histogram(self.name, self.help, buckets=self.buckets)
+
+    def observe(self, v: float) -> None:
+        self._sum += v
+        self._count += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self._counts[i] += 1
+
+    def _child_samples(self, labels_prefix: str):
+        out = []
+        cum = 0
+        for b, c in zip(self.buckets, self._counts):
+            cum = c
+            le = _fmt_value(b)
+            if labels_prefix:
+                lab = labels_prefix[:-1] + f',le="{le}"}}'
+            else:
+                lab = f'{{le="{le}"}}'
+            out.append(("_bucket", lab, cum))
+        inf_lab = (labels_prefix[:-1] + ',le="+Inf"}') \
+            if labels_prefix else '{le="+Inf"}'
+        out.append(("_bucket", inf_lab, self._count))
+        out.append(("_sum", labels_prefix, self._sum))
+        out.append(("_count", labels_prefix, self._count))
+        return out
+
+    def _samples(self):
+        if self.label_names:
+            out = []
+            for k, h in sorted(self._children.items()):
+                out.extend(h._child_samples(
+                    _fmt_labels(self.label_names, k)))
+            return out
+        return self._child_samples("")
+
+
+class Registry:
+    def __init__(self, namespace: str = "cometbft"):
+        self.namespace = namespace
+        self._metrics: dict[str, _Metric] = {}
+        self._lock = threading.Lock()
+
+    def _register(self, m: _Metric) -> _Metric:
+        with self._lock:
+            if m.name in self._metrics:
+                return self._metrics[m.name]
+            self._metrics[m.name] = m
+            return m
+
+    def counter(self, subsystem: str, name: str, help_: str = "",
+                labels: Sequence[str] = ()) -> Counter:
+        return self._register(Counter(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels))
+
+    def gauge(self, subsystem: str, name: str, help_: str = "",
+              labels: Sequence[str] = ()) -> Gauge:
+        return self._register(Gauge(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels))
+
+    def histogram(self, subsystem: str, name: str, help_: str = "",
+                  labels: Sequence[str] = (),
+                  buckets: Sequence[float] = _DEFAULT_BUCKETS
+                  ) -> Histogram:
+        return self._register(Histogram(
+            f"{self.namespace}_{subsystem}_{name}", help_, labels,
+            buckets))
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = sorted(self._metrics.values(),
+                             key=lambda m: m.name)
+        return "\n".join(m.render() for m in metrics) + "\n"
+
+
+# The process-global registry (reference: the Prometheus default
+# registerer); nodes may also construct private registries in tests.
+DEFAULT = Registry()
+
+
+class Timer:
+    """Context manager observing elapsed seconds into a Histogram."""
+
+    def __init__(self, hist: Histogram):
+        self.hist = hist
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.hist.observe(time.perf_counter() - self._t0)
+        return False

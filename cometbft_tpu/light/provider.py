@@ -8,6 +8,7 @@ tests, mirroring provider/mock + local RPC).
 from __future__ import annotations
 
 import abc
+import asyncio
 from typing import Optional
 
 from ..types.block import LightBlock, SignedHeader
@@ -64,3 +65,43 @@ class NodeProvider(Provider):
 
     def id(self) -> str:
         return f"node-provider:{self.chain_id}"
+
+
+class HttpProvider(Provider):
+    """Light blocks over a node's RPC (reference:
+    light/provider/http/http.go — /commit + paged /validators)."""
+
+    def __init__(self, address: str, chain_id: str = ""):
+        from ..rpc.client import HTTPClient
+        self.client = HTTPClient(address)
+        self.chain_id = chain_id
+        self.address = address
+
+    async def light_block(self, height: int) -> LightBlock:
+        from ..rpc.client import RPCClientError
+        try:
+            signed_header, _ = await self.client.commit(height)
+            h = signed_header.header.height
+            vals = await self.client.validators(h)
+        except RPCClientError as e:
+            raise LightBlockNotFoundError(str(e)) from None
+        except (OSError, asyncio.TimeoutError) as e:
+            raise ProviderError(
+                f"provider {self.address} unreachable: {e}") from None
+        lb = LightBlock(signed_header=signed_header, validator_set=vals)
+        if self.chain_id:
+            lb.validate_basic(self.chain_id)
+        return lb
+
+    async def report_evidence(self, ev) -> None:
+        """POST wire-encoded evidence to the node's broadcast_evidence
+        RPC (reference: http provider ReportEvidence ->
+        rpc/core/evidence.go)."""
+        import base64
+        from ..wire import pb as _pb, encode as _encode
+        raw = _encode(_pb.EVIDENCE, ev.to_proto_wrapped())
+        await self.client.call(
+            "broadcast_evidence", evidence=base64.b64encode(raw).decode())
+
+    def id(self) -> str:
+        return f"http{{{self.address}}}"

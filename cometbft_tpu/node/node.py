@@ -14,7 +14,7 @@ from ..abci.client import AppConns, ClientCreator
 from ..abci.kvstore import KVStoreApplication
 from ..config import Config
 from ..consensus.reactor import ConsensusReactor
-from ..consensus.replay import Handshaker, catchup_replay
+from ..consensus.replay import Handshaker, ReplayError, catchup_replay
 from ..consensus.state import ConsensusState
 from ..consensus.wal import WAL
 from ..db import new_db
@@ -96,7 +96,8 @@ class Node:
         self.state_store = Store(new_db("state", backend, db_dir))
 
         # --- application ------------------------------------------------
-        if app is None:
+        if app is None and config.base.abci in ("builtin",
+                                                "builtin_unsync"):
             if config.base.proxy_app in ("kvstore", "persistent_kvstore"):
                 app = KVStoreApplication(
                     db=new_db("app", backend, db_dir))
@@ -106,7 +107,8 @@ class Node:
                     f"(pass an Application instance for custom apps)")
         self.app = app
         self.app_conns = ClientCreator(
-            app=app, transport=config.base.abci).new_app_conns()
+            app=app, addr=config.base.proxy_app,
+            transport=config.base.abci).new_app_conns()
 
         # --- state ------------------------------------------------------
         state = self.state_store.load()
@@ -130,7 +132,9 @@ class Node:
         self.switch = Switch(
             self.node_key, self.genesis_doc.chain_id,
             listen_addr=config.p2p.laddr.replace("tcp://", ""),
-            moniker=config.base.moniker)
+            moniker=config.base.moniker,
+            send_rate=config.p2p.send_rate,
+            recv_rate=config.p2p.recv_rate)
 
         self._rpc_server = None
         self._started = False
@@ -139,6 +143,10 @@ class Node:
     async def start(self) -> None:
         """Boot order mirrors node.OnStart."""
         cfg = self.config
+
+        # out-of-process app: open the four socket AppConns first
+        # (reference: createAndStartProxyAppConns, setup.go:179)
+        await self.app_conns.start()
 
         # ABCI handshake reconciles app and store
         handshaker = Handshaker(self.state_store, self.initial_state,
@@ -189,7 +197,17 @@ class Node:
             cfg.consensus, state, block_exec, self.block_store,
             priv_validator=self.priv_validator,
             event_bus=self.event_bus, wal=WAL(wal_path))
-        await catchup_replay(self.consensus_state, wal_path)
+        try:
+            await catchup_replay(self.consensus_state, wal_path)
+        except ReplayError as e:
+            # reference state.go OnStart: a non-corruption catchup error
+            # (e.g. the end-height barrier was never written because we
+            # crashed between block save and WAL fsync — the handshake
+            # already replayed the block) is logged and the node starts
+            # anyway; only height-in-flight votes are lost
+            self.logger.error(
+                "Error on catchup replay; proceeding to start node "
+                "anyway", err=str(e))
         # WAL catchup can itself finalize a block — use the freshest
         # state for the blocksync decision and reactor
         state = self.state_store.load() or state
@@ -260,6 +278,7 @@ class Node:
         await self.switch.stop()
         if self._rpc_server is not None:
             await self._rpc_server.stop()
+        await self.app_conns.stop()
         self._started = False
         self.logger.info("Node stopped")
 

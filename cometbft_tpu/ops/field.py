@@ -80,43 +80,49 @@ def neg(x: jnp.ndarray) -> jnp.ndarray:
     return -x
 
 
-# The limb convolution + 2^256->38 fold as ONE constant matrix: flatten the
-# outer product x_i*y_j to [..., 1024] and contract with _REDMAT[1024, 32],
-# where entry (i*32+j, k) is 1 when i+j == k and 38 when i+j == k+32.
-# Magnitude bound: position k receives <= 32 pairs * 600^2 directly plus
-# 38 * (31 pairs * 600^2) from the fold — < 4.4e8, comfortably int32.
-# One dot_general instead of 32 strided accumulate ops: this is both the
-# MXU-friendly layout and a ~10x smaller HLO graph (compile time).
-def _build_redmat() -> np.ndarray:
-    m = np.zeros((LIMBS * LIMBS, LIMBS), np.int32)
+# The limb product is the length-63 convolution of the two limb vectors,
+# with columns >= 32 folded back at weight 38 (2^256 = 2p + 38).  The
+# convolution is ONE dot_general: flatten the outer product x_i*y_j to
+# [..., 1024] and contract with the constant 0/1 selector _CONVMAT
+# [1024, 64] (entry (i*32+j, i+j) = 1; column 63 stays zero), then fold
+# lo + 38*hi on the vector unit.
+#
+# The dot runs in float32 at Precision.HIGHEST, which is EXACT here and is
+# the whole point of the layout: operands are pre-normalized to
+# |limb| <= 293 (2 carry passes), so each product is an integer < 2^17 and
+# each 0/1 column sums <= 32 of them < 2^22 — far inside float32's 2^24
+# exact-integer range.  f32-HIGHEST contraction maps onto the MXU
+# (bf16x3 passes on TPU, sgemm on CPU); an int32 formulation of the same
+# contraction lowers to slow vector-unit loops, and an unrolled 32-slice
+# MAC formulation is ~10x cheaper arithmetically but blows up XLA compile
+# time (~30s for point decompression alone), so this is the sweet spot of
+# compile time x runtime x exactness.
+def _build_convmat() -> np.ndarray:
+    m = np.zeros((LIMBS * LIMBS, 2 * LIMBS), np.float32)
     for i in range(LIMBS):
         for j in range(LIMBS):
-            k = i + j
-            if k < LIMBS:
-                m[i * LIMBS + j, k] = 1
-            else:
-                m[i * LIMBS + j, k - LIMBS] = _FOLD
+            m[i * LIMBS + j, i + j] = 1.0
     return m
 
 
-_REDMAT = jnp.asarray(_build_redmat())
+_CONVMAT = jnp.asarray(_build_convmat())
 
 
 def mul(x: jnp.ndarray, y: jnp.ndarray) -> jnp.ndarray:
-    """Field multiply. |input limbs| <= ~600 allowed; output |limbs| <= ~300.
-
-    Outer product of limbs, then one matmul against the constant
-    convolution+fold matrix, then carry normalization."""
+    """Field multiply. |input limbs| <= ~1600 allowed; output <= ~600."""
     batch = jnp.broadcast_shapes(x.shape[:-1], y.shape[:-1])
-    x = jnp.broadcast_to(x, batch + (LIMBS,))
-    y = jnp.broadcast_to(y, batch + (LIMBS,))
-    outer = (x[..., :, None] * y[..., None, :]).reshape(
+    x = jnp.broadcast_to(normalize(x, passes=2), batch + (LIMBS,))
+    y = jnp.broadcast_to(normalize(y, passes=2), batch + (LIMBS,))
+    xf = x.astype(jnp.float32)
+    yf = y.astype(jnp.float32)
+    outer = (xf[..., :, None] * yf[..., None, :]).reshape(
         batch + (LIMBS * LIMBS,))
-    prod = jax.lax.dot_general(
-        outer, _REDMAT,
+    conv = jax.lax.dot_general(
+        outer, _CONVMAT,
         dimension_numbers=(((outer.ndim - 1,), (0,)), ((), ())),
-        preferred_element_type=jnp.int32)
-    return normalize(prod, passes=4)
+        precision=jax.lax.Precision.HIGHEST).astype(jnp.int32)
+    folded = conv[..., :LIMBS] + _FOLD * conv[..., LIMBS:]
+    return normalize(folded, passes=3)
 
 
 def sqr(x: jnp.ndarray) -> jnp.ndarray:

@@ -14,6 +14,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
+from ..libs.flowrate import RateLimiter
 from ..libs.log import Logger, new_logger
 
 MAX_PACKET_PAYLOAD_SIZE = 1024
@@ -88,11 +89,18 @@ class MConnection:
     def __init__(self, sconn, channels: list[ChannelDescriptor],
                  on_receive: Callable[[int, bytes], Awaitable[None]],
                  on_error: Callable[[Exception], None],
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 send_rate: float = 5_120_000,
+                 recv_rate: float = 5_120_000):
         self._sconn = sconn
         self._channels = {d.id: _Channel(d) for d in channels}
         self._on_receive = on_receive
         self._on_error = on_error
+        # token-bucket flow control, 5 MB/s defaults (reference:
+        # internal/flowrate via connection.go sendSomePacketMsgs /
+        # recvRoutine; config p2p.send_rate/recv_rate)
+        self.send_limiter = RateLimiter(send_rate)
+        self.recv_limiter = RateLimiter(recv_rate)
         self.logger = logger if logger is not None else \
             new_logger("mconn")
         self._send_event = asyncio.Event()
@@ -163,6 +171,7 @@ class MConnection:
                 payload, eof = ch.next_packet()
                 pkt = bytes([_PKT_MSG, ch.desc.id,
                              1 if eof else 0]) + payload
+                await self.send_limiter.take(len(pkt))
                 await self._sconn.write_msg(pkt)
                 # decay the ratio counters periodically
                 if ch.recently_sent > 1 << 20:
@@ -177,6 +186,7 @@ class MConnection:
         try:
             while not self._closed:
                 msg = await self._sconn.read_msg()
+                await self.recv_limiter.take(len(msg))
                 self._last_recv = asyncio.get_running_loop().time()
                 if not msg:
                     raise MConnectionError("empty packet")

@@ -125,11 +125,15 @@ class Switch:
     def __init__(self, node_key: NodeKey, network: str,
                  listen_addr: str = "",
                  moniker: str = "anonymous",
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 send_rate: float = 5_120_000,
+                 recv_rate: float = 5_120_000):
         self.node_key = node_key
         self.network = network
         self.listen_addr = listen_addr
         self.moniker = moniker
+        self.send_rate = send_rate
+        self.recv_rate = recv_rate
         self.logger = logger if logger is not None else \
             new_logger("p2p")
         self.reactors: dict[str, Reactor] = {}
@@ -239,7 +243,8 @@ class Switch:
                     self.stop_peer(peer_holder[0], str(e)))
 
         mconn = MConnection(sconn, self._channel_descs, on_receive,
-                            on_error)
+                            on_error, send_rate=self.send_rate,
+                            recv_rate=self.recv_rate)
         peer = Peer(their_info, mconn, outbound, remote_addr)
         peer_holder.append(peer)
         self.peers[peer.id] = peer

@@ -24,13 +24,11 @@ from ..types.timestamp import Timestamp
 from ..types.vote import Vote
 from ..wire import pb, unmarshal_delimited
 
-# amino-JSON type names per key type (reference: cmtjson.RegisterType in
-# crypto/{ed25519,secp256k1,bls12381}): (pubkey name, privkey name)
+# amino-JSON type names (single registry: crypto/encoding.py)
 _AMINO_NAMES = {
-    "ed25519": ("tendermint/PubKeyEd25519", "tendermint/PrivKeyEd25519"),
-    "secp256k1": ("tendermint/PubKeySecp256k1",
-                  "tendermint/PrivKeySecp256k1"),
-    "bls12_381": ("cometbft/PubKeyBls12_381", "cometbft/PrivKeyBls12_381"),
+    kt: (crypto_encoding.AMINO_PUBKEY_NAMES[kt],
+         crypto_encoding.AMINO_PRIVKEY_NAMES[kt])
+    for kt in crypto_encoding.AMINO_PUBKEY_NAMES
 }
 _KEY_TYPE_BY_PRIV_NAME = {v[1]: k for k, v in _AMINO_NAMES.items()}
 

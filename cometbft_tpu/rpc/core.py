@@ -74,6 +74,8 @@ def routes(env: Environment) -> dict:
         order_by="asc": _tx_search(env, query, page, per_page),
         "block_search": lambda query="", page="1", per_page="30",
         order_by="asc": _block_search(env, query, page, per_page),
+        "broadcast_evidence": lambda evidence="":
+            _broadcast_evidence(env, evidence),
     }
 
 
@@ -215,6 +217,22 @@ async def _broadcast_tx_commit(env, tx):
                 f"rpc-tx-{key.hex()[:16]}")
         except Exception:
             pass
+
+
+async def _broadcast_evidence(env, evidence):
+    """Ingest wire-encoded evidence into the pool (reference:
+    rpc/core/evidence.go BroadcastEvidence; used by the light client's
+    report_evidence path)."""
+    from ..types.evidence import evidence_from_proto_wrapped
+    from ..wire import pb as _pb, decode as _decode
+    raw = base64.b64decode(evidence)
+    ev = evidence_from_proto_wrapped(_decode(_pb.EVIDENCE, raw))
+    pool = getattr(env.node, "evidence_pool", None)
+    if pool is None:
+        from .server import RPCError
+        raise RPCError(-32603, "evidence pool unavailable")
+    pool.add_evidence(ev)
+    return {"hash": ev.hash().hex().upper()}
 
 
 async def _unconfirmed_txs(env, limit):
@@ -523,3 +541,44 @@ def _parse_bool(v) -> bool:
     if isinstance(v, bool):
         return v
     return str(v).lower() in ("true", "1")
+
+
+def event_data_json(ev) -> dict:
+    """EventData -> the ws subscription payload (reference: the typed
+    TMEventData JSON in rpc/core/events).  Best-effort typed rendering of
+    the common event kinds; round-state events carry their summary dict."""
+    kind = getattr(ev, "kind", "")
+    payload = getattr(ev, "payload", None)
+    out: dict = {"type": f"tendermint/event/{kind or 'Unknown'}"}
+    value: dict = {}
+    try:
+        if kind == "NewBlock" and isinstance(payload, dict):
+            block = payload.get("block")
+            if block is not None:
+                value = {"block": _block_json(block),
+                         "block_id": _block_id_json(
+                             payload.get("block_id"))}
+        elif kind == "NewBlockHeader" and isinstance(payload, dict):
+            value = {"header": _header_json(payload["header"])}
+        elif kind == "Tx" and isinstance(payload, dict):
+            res = payload.get("result")
+            value = {
+                "height": str(payload.get("height", 0)),
+                "index": payload.get("index", 0),
+                "tx": base64.b64encode(payload.get("tx", b"")).decode(),
+                "result": {
+                    "code": res.code,
+                    "data": base64.b64encode(res.data).decode(),
+                    "log": res.log,
+                    "gas_wanted": str(res.gas_wanted),
+                    "gas_used": str(res.gas_used),
+                    "events": _events_json(res.events),
+                } if res is not None else None,
+            }
+        elif isinstance(payload, dict):
+            value = {k: v for k, v in payload.items()
+                     if isinstance(v, (str, int, float, bool, type(None)))}
+    except Exception:  # noqa: BLE001 — events must never kill the pump
+        value = {}
+    out["value"] = value
+    return out

@@ -36,6 +36,7 @@ class RPCServer:
         self.routes = core.routes(self.env)
         self._server: Optional[asyncio.base_events.Server] = None
         self.listen_addr = ""
+        self._ws_counter = 0
 
     async def start(self) -> None:
         addr = self.config.laddr.replace("tcp://", "")
@@ -74,6 +75,15 @@ class RPCServer:
                         break
                     k, _, v = line.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if headers.get("upgrade", "").lower() == "websocket":
+                    # reference: ws_handler.go — the /websocket endpoint
+                    from .ws import WsSession
+                    self._ws_counter += 1
+                    peer = writer.get_extra_info("peername")
+                    remote = f"{peer}#{self._ws_counter}"
+                    await WsSession(self, reader, writer, remote).run(
+                        headers)
+                    return
                 body = b""
                 clen = int(headers.get("content-length", 0) or 0)
                 if clen:

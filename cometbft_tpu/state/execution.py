@@ -11,6 +11,7 @@ from typing import Optional
 
 from ..abci import types as abci
 from ..crypto import encoding as crypto_encoding, merkle
+from ..libs import fail
 from ..libs.log import Logger, new_logger
 from ..types.block import Block
 from ..types.block_id import BlockID
@@ -345,8 +346,14 @@ class BlockExecutor:
                 f"{len(block.data.txs)} != "
                 f"{len(abci_response.tx_results)}")
 
+        fail.fail()    # crash point: finalized, responses unsaved
+                       # (execution.go:267)
+
         # save results BEFORE app commit (crash-consistency barrier)
         self.store.save_finalize_block_response(h.height, abci_response)
+
+        fail.fail()    # crash point: responses saved, state not updated
+                       # (execution.go:274)
 
         validator_updates = validate_validator_updates(
             abci_response.validator_updates,
@@ -359,6 +366,9 @@ class BlockExecutor:
         retain_height = await self.commit(state, block, abci_response)
 
         self.evpool.update(state, block.evidence)
+
+        fail.fail()    # crash point: app committed, state unsaved
+                       # (execution.go:315)
 
         state.app_hash = abci_response.app_hash
         self.store.save(state)

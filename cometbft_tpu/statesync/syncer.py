@@ -207,3 +207,29 @@ class Syncer:
         commit = await self.state_provider.commit(snap.height)
         self.logger.info("Snapshot restored", height=snap.height)
         return state, commit
+
+
+async def new_rpc_state_provider(chain_id: str, genesis_doc,
+                                 servers: list[str],
+                                 trust_height: int, trust_hash: bytes,
+                                 trust_period_ns: int = 168 * 3600 * 10**9
+                                 ) -> StateProvider:
+    """StateProvider backed by a light client over real RPC servers
+    (reference: stateprovider.go:29 NewLightClientStateProvider — the
+    config.statesync rpc_servers + trust height/hash path).  The first
+    server is the primary, the rest are witnesses."""
+    from ..db.db import MemDB
+    from ..light.client import Client as LightClient, TrustOptions
+    from ..light.provider import HttpProvider
+    from ..light.store import TrustedStore
+
+    if not servers:
+        raise StatesyncError("statesync needs at least one RPC server")
+    providers = [HttpProvider(addr, chain_id) for addr in servers]
+    client = LightClient(
+        chain_id,
+        TrustOptions(period_ns=trust_period_ns, height=trust_height,
+                     header_hash=trust_hash),
+        providers[0], providers[1:], TrustedStore(MemDB()))
+    await client.initialize()
+    return StateProvider(client, chain_id, genesis_doc)

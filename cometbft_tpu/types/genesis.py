@@ -19,13 +19,8 @@ from .validator import MAX_TOTAL_VOTING_POWER
 
 MAX_CHAIN_ID_LEN = 50
 
-# amino-compatible JSON type tags (reference: libs/json named types,
-# registered in crypto/{ed25519,secp256k1,bls12381})
-_PUBKEY_JSON_TYPES = {
-    "ed25519": "tendermint/PubKeyEd25519",
-    "secp256k1": "tendermint/PubKeySecp256k1",
-    "bls12_381": "cometbft/PubKeyBls12_381",
-}
+# amino-compatible JSON type tags (single registry: crypto/encoding.py)
+_PUBKEY_JSON_TYPES = crypto_encoding.AMINO_PUBKEY_NAMES
 _PUBKEY_JSON_TYPES_REV = {v: k for k, v in _PUBKEY_JSON_TYPES.items()}
 
 

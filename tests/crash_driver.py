@@ -1,0 +1,54 @@
+"""Subprocess driver for the fail-point crash-consistency test.
+
+Runs a single-validator node at HOME until TARGET_HEIGHT, then exits 0.
+With FAIL_TEST_INDEX set, the node hard-crashes (exit 99) at the indexed
+commit-path boundary instead (see cometbft_tpu/libs/fail.py).
+"""
+import asyncio
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+async def main(home: str, target: int) -> int:
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.node.node import Node
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    cfg = Config()
+    cfg.base.home = home
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = ""
+    cfg.consensus.timeout_commit = 0.02
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    key_file = cfg.base.path(cfg.base.priv_validator_key_file)
+    pv = FilePV.load_or_generate(
+        key_file, cfg.base.path(cfg.base.priv_validator_state_file))
+    NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    gen_file = cfg.base.path(cfg.base.genesis_file)
+    if not os.path.exists(gen_file):
+        doc = GenesisDoc(
+            chain_id="crash-chain", genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(address=b"",
+                                         pub_key=pv.get_pub_key(),
+                                         power=10)])
+        doc.save_as(gen_file)
+
+    node = Node(cfg)
+    await node.start()
+    for _ in range(2000):
+        if node.height >= target:
+            await node.stop()
+            return 0
+        await asyncio.sleep(0.02)
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(asyncio.run(main(sys.argv[1], int(sys.argv[2]))))

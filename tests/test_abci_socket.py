@@ -1,0 +1,284 @@
+"""ABCI socket protocol: proto round-trips, server/client over unix
+sockets, pipelining, and a node running against an out-of-process app.
+
+Reference: abci/client/socket_client.go:515, abci/server/socket_server.go,
+proto/cometbft/abci/v2/types.proto Request/Response oneofs.
+"""
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from cometbft_tpu.abci import pb
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.client import SocketAppConns, SocketClient
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.abci.server import SocketServer
+from cometbft_tpu.types.timestamp import Timestamp
+
+
+def _roundtrip_request(req):
+    frame = pb.encode_request_frame(req)
+    from cometbft_tpu.wire.proto import decode_uvarint
+    size, pos = decode_uvarint(frame, 0)
+    assert size == len(frame) - pos
+    return pb.decode_request(frame[pos:])
+
+
+def _roundtrip_response(resp):
+    frame = pb.encode_response_frame(resp)
+    from cometbft_tpu.wire.proto import decode_uvarint
+    size, pos = decode_uvarint(frame, 0)
+    return pb.decode_response(frame[pos:])
+
+
+class TestProtoRoundTrip:
+    def test_all_requests(self):
+        ts = Timestamp(1700000001, 500)
+        val = abci.ABCIValidator(address=b"\x01" * 20, power=10)
+        reqs = [
+            abci.EchoRequest(message="hi"),
+            abci.FlushRequest(),
+            abci.InfoRequest(version="1.0", block_version=11,
+                             p2p_version=9, abci_version="2.0"),
+            abci.InitChainRequest(
+                time=ts, chain_id="c", validators=[
+                    abci.ValidatorUpdate(power=5, pub_key_bytes=b"\x02" * 32,
+                                         pub_key_type="ed25519")],
+                app_state_bytes=b"{}", initial_height=1),
+            abci.QueryRequest(data=b"k", path="/store", height=3,
+                              prove=True),
+            abci.CheckTxRequest(tx=b"a=1", type=abci.CHECK_TX_TYPE_CHECK),
+            abci.CommitRequest(),
+            abci.ListSnapshotsRequest(),
+            abci.OfferSnapshotRequest(
+                snapshot=abci.Snapshot(height=5, format=1, chunks=2,
+                                       hash=b"h" * 32, metadata=b"m"),
+                app_hash=b"a" * 32),
+            abci.LoadSnapshotChunkRequest(height=5, format=1, chunk=1),
+            abci.ApplySnapshotChunkRequest(index=1, chunk=b"c",
+                                           sender="n0"),
+            abci.PrepareProposalRequest(
+                max_tx_bytes=1000, txs=[b"t1", b"t2"],
+                local_last_commit=abci.ExtendedCommitInfo(
+                    round=1, votes=[abci.ExtendedVoteInfo(
+                        validator=val, vote_extension=b"e",
+                        extension_signature=b"s" * 64,
+                        block_id_flag=2, non_rp_vote_extension=b"n",
+                        non_rp_extension_signature=b"t" * 64)]),
+                misbehavior=[abci.Misbehavior(
+                    type=abci.MISBEHAVIOR_TYPE_DUPLICATE_VOTE,
+                    validator=val, height=2, time=ts,
+                    total_voting_power=10)],
+                height=7, time=ts, next_validators_hash=b"v" * 32,
+                proposer_address=b"\x03" * 20),
+            abci.ProcessProposalRequest(
+                txs=[b"t"], proposed_last_commit=abci.CommitInfo(
+                    round=0, votes=[abci.VoteInfo(validator=val,
+                                                  block_id_flag=2)]),
+                hash=b"H" * 32, height=7, time=ts,
+                next_validators_hash=b"v" * 32,
+                proposer_address=b"\x03" * 20),
+            abci.ExtendVoteRequest(hash=b"H" * 32, height=7, time=ts,
+                                   txs=[b"t"]),
+            abci.VerifyVoteExtensionRequest(
+                hash=b"H" * 32, validator_address=b"\x01" * 20, height=7,
+                vote_extension=b"e", non_rp_vote_extension=b"n"),
+            abci.FinalizeBlockRequest(
+                txs=[b"t1"], hash=b"H" * 32, height=7, time=ts,
+                next_validators_hash=b"v" * 32,
+                proposer_address=b"\x03" * 20, syncing_to_height=7),
+        ]
+        for req in reqs:
+            assert _roundtrip_request(req) == req, type(req).__name__
+
+    def test_all_responses(self):
+        resps = [
+            abci.ExceptionResponse(error="boom"),
+            abci.EchoResponse(message="hi"),
+            abci.FlushResponse(),
+            abci.InfoResponse(data="kv", version="1", app_version=1,
+                              last_block_height=5,
+                              last_block_app_hash=b"h" * 32,
+                              lane_priorities={"a": 1, "b": 3},
+                              default_lane="a"),
+            abci.InitChainResponse(validators=[
+                abci.ValidatorUpdate(power=3, pub_key_bytes=b"\x02" * 32,
+                                     pub_key_type="ed25519")],
+                app_hash=b"x" * 32),
+            abci.QueryResponse(code=0, value=b"v", height=3, index=1,
+                               key=b"k"),
+            abci.CheckTxResponse(code=0, gas_wanted=1, lane_id="fast",
+                                 events=[abci.Event(
+                                     type="tx", attributes=[
+                                         abci.EventAttribute(
+                                             key="k", value="v",
+                                             index=True)])]),
+            abci.CommitResponse(retain_height=2),
+            abci.ListSnapshotsResponse(snapshots=[
+                abci.Snapshot(height=1, format=1, chunks=1,
+                              hash=b"h" * 32)]),
+            abci.OfferSnapshotResponse(
+                result=abci.OFFER_SNAPSHOT_RESULT_ACCEPT),
+            abci.LoadSnapshotChunkResponse(chunk=b"c"),
+            abci.ApplySnapshotChunkResponse(
+                result=abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT,
+                refetch_chunks=[1, 2], reject_senders=["bad"]),
+            abci.PrepareProposalResponse(txs=[b"t"]),
+            abci.ProcessProposalResponse(
+                status=abci.PROCESS_PROPOSAL_STATUS_ACCEPT),
+            abci.ExtendVoteResponse(vote_extension=b"e",
+                                    non_rp_extension=b"n"),
+            abci.VerifyVoteExtensionResponse(
+                status=abci.VERIFY_VOTE_EXTENSION_STATUS_ACCEPT),
+            abci.FinalizeBlockResponse(
+                tx_results=[abci.ExecTxResult(code=0, gas_used=1)],
+                app_hash=b"a" * 32),
+        ]
+        for resp in resps:
+            assert _roundtrip_response(resp) == resp, type(resp).__name__
+
+
+class TestSocketClientServer:
+    def test_echo_info_checktx(self):
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                sock = os.path.join(d, "app.sock")
+                srv = SocketServer(f"unix://{sock}", KVStoreApplication())
+                await srv.start()
+                cli = SocketClient(f"unix://{sock}")
+                await cli.connect()
+                echo = await cli.echo("hello")
+                assert echo.message == "hello"
+                info = await cli.info(abci.InfoRequest())
+                assert info.data
+                res = await cli.check_tx(abci.CheckTxRequest(
+                    tx=b"k=v", type=abci.CHECK_TX_TYPE_CHECK))
+                assert res.code == abci.CODE_TYPE_OK
+                await cli.flush()
+                await cli.close()
+                await srv.stop()
+        asyncio.run(run())
+
+    def test_pipelined_checktx(self):
+        """Many in-flight CheckTx calls resolve in order (the pipelining
+        contract of socket_client.go)."""
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                sock = os.path.join(d, "app.sock")
+                srv = SocketServer(f"unix://{sock}", KVStoreApplication())
+                await srv.start()
+                cli = SocketClient(f"unix://{sock}")
+                await cli.connect()
+                futs = [
+                    asyncio.ensure_future(cli.check_tx(abci.CheckTxRequest(
+                        tx=f"k{i}=v{i}".encode(),
+                        type=abci.CHECK_TX_TYPE_CHECK)))
+                    for i in range(100)
+                ]
+                res = await asyncio.gather(*futs)
+                assert all(r.code == abci.CODE_TYPE_OK for r in res)
+                await cli.close()
+                await srv.stop()
+        asyncio.run(run())
+
+    def test_exception_response_is_fatal(self):
+        """An app ExceptionResponse kills the client — the app's state is
+        unknown (reference socket_client StopForError semantics)."""
+        class BoomApp(abci.BaseApplication):
+            async def query(self, req):
+                raise RuntimeError("boom")
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                sock = os.path.join(d, "app.sock")
+                srv = SocketServer(f"unix://{sock}", BoomApp())
+                await srv.start()
+                cli = SocketClient(f"unix://{sock}")
+                await cli.connect()
+                with pytest.raises(Exception, match="boom"):
+                    await cli.query(abci.QueryRequest(path="x"))
+                with pytest.raises(Exception, match="dead"):
+                    await cli.echo("should be dead")
+                await cli.close()
+                await srv.stop()
+        asyncio.run(run())
+
+
+class TestNodeWithSocketApp:
+    def test_node_over_external_kvstore_process(self):
+        """A full node drives a kvstore app living in a SEPARATE PROCESS
+        over a unix socket: handshake, block production, tx commit
+        (reference: e2e 'unix' ABCI protocol mode)."""
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                sock = os.path.join(d, "app.sock")
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "cometbft_tpu.abci.server",
+                     "--address", f"unix://{sock}", "--app", "kvstore"],
+                    stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+                    env={**os.environ, "JAX_PLATFORMS": ""})
+                try:
+                    home = os.path.join(d, "node")
+                    cfg = Config()
+                    cfg.base.home = home
+                    cfg.base.abci = "socket"
+                    cfg.base.proxy_app = f"unix://{sock}"
+                    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                    cfg.rpc.laddr = ""
+                    cfg.consensus.timeout_commit = 0.05
+                    os.makedirs(os.path.join(home, "config"),
+                                exist_ok=True)
+                    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+                    pv = FilePV.generate(
+                        cfg.base.path(cfg.base.priv_validator_key_file),
+                        cfg.base.path(cfg.base.priv_validator_state_file))
+                    NodeKey.load_or_gen(
+                        cfg.base.path(cfg.base.node_key_file))
+                    doc = GenesisDoc(
+                        chain_id="socket-chain",
+                        genesis_time=Timestamp.now(),
+                        validators=[GenesisValidator(
+                            address=b"", pub_key=pv.get_pub_key(),
+                            power=10)])
+                    doc.save_as(cfg.base.path(cfg.base.genesis_file))
+                    node = Node(cfg)
+                    await node.start()
+                    # wait for a few blocks, submit a tx, see it commit
+                    for _ in range(200):
+                        if node.height >= 2:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert node.height >= 2, "no blocks produced"
+                    await node.mempool.check_tx(b"socket=works")
+                    h0 = node.height
+                    for _ in range(200):
+                        if node.height >= h0 + 2:
+                            break
+                        await asyncio.sleep(0.05)
+                    # poll: block-store height leads the app commit
+                    value = b""
+                    for _ in range(200):
+                        res = await node.app_conns.query.query(
+                            abci.QueryRequest(path="/store",
+                                              data=b"socket"))
+                        value = res.value
+                        if value:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert value == b"works"
+                    await node.stop()
+                finally:
+                    proc.terminate()
+                    proc.wait(timeout=5)
+        asyncio.run(run())

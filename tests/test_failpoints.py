@@ -1,0 +1,68 @@
+"""Crash-consistency: iterate EVERY fail-point index through the commit
+path, hard-crash a real node process there, restart, and require full
+recovery.
+
+Reference: internal/fail/fail.go:28 + the fail.Fail() crash points in
+internal/consensus/state.go:1872-1941 and state/execution.go:267-322;
+the replay tests iterate all indices the same way.
+"""
+import os
+import subprocess
+import sys
+import tempfile
+
+_DRIVER = os.path.join(os.path.dirname(__file__), "crash_driver.py")
+
+# 7 fail() calls fire per committed height: 4 in consensus/state.py
+# _finalize_commit + 3 in state/execution.py _apply_block (order: 0 before
+# block save, 1 before WAL barrier, 2 after barrier, 3 before response
+# save, 4 after response save, 5 after app commit, 6 before
+# update_to_state).
+N_FAIL_POINTS = 7
+
+
+def _run(home: str, target: int, fail_index: int = -1,
+         timeout: int = 60) -> int:
+    env = {**os.environ, "JAX_PLATFORMS": ""}
+    if fail_index >= 0:
+        env["FAIL_TEST_INDEX"] = str(fail_index)
+    else:
+        env.pop("FAIL_TEST_INDEX", None)
+    p = subprocess.run(
+        [sys.executable, _DRIVER, home, str(target)],
+        env=env, timeout=timeout, capture_output=True, text=True)
+    return p.returncode
+
+
+class TestCrashConsistency:
+    def test_recovery_at_every_commit_boundary(self):
+        """For each index i: crash a node mid-commit at boundary i (the
+        crash is index i of height 2's commit because height 1 commits
+        before the WAL has settled... indices count from process start),
+        then restart and require the chain to keep committing."""
+        for i in range(N_FAIL_POINTS):
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                rc = _run(home, target=50, fail_index=i)
+                assert rc == 99, \
+                    f"fail point {i} did not fire (rc={rc})"
+                rc = _run(home, target=5)
+                assert rc == 0, f"recovery after crash at {i} failed"
+
+    def test_crash_at_later_height_boundaries(self):
+        """Crash during the 3rd height's commit (index 2 heights in) and
+        recover — catches bugs that only appear once LastCommit exists."""
+        for boundary in (0, 2, 5, 6):
+            i = 2 * N_FAIL_POINTS + boundary
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                rc = _run(home, target=50, fail_index=i)
+                assert rc == 99, \
+                    f"fail point {i} did not fire (rc={rc})"
+                rc = _run(home, target=6)
+                assert rc == 0, f"recovery after crash at {i} failed"
+
+    def test_no_failpoint_runs_clean(self):
+        with tempfile.TemporaryDirectory() as d:
+            home = os.path.join(d, "node")
+            assert _run(home, target=3) == 0

@@ -1,0 +1,90 @@
+"""Metrics registry + token-bucket flow control.
+
+Reference: libs/metrics + per-package metrics.go; internal/flowrate and
+the MConnection rate caps (connection.go:27-44).
+"""
+import asyncio
+import time
+
+from cometbft_tpu.libs.flowrate import RateLimiter
+from cometbft_tpu.libs.metrics import Registry, Timer
+
+
+class TestMetrics:
+    def test_counter_gauge_histogram_render(self):
+        reg = Registry()
+        c = reg.counter("consensus", "total_txs", "txs committed")
+        c.add(5)
+        c.inc()
+        g = reg.gauge("mempool", "size", "pending txs")
+        g.set(42)
+        h = reg.histogram("consensus", "block_interval_seconds",
+                          "time between blocks")
+        h.observe(0.3)
+        h.observe(1.7)
+        out = reg.render()
+        assert "cometbft_consensus_total_txs 6" in out
+        assert "cometbft_mempool_size 42" in out
+        assert 'cometbft_consensus_block_interval_seconds_bucket{le="0.5"} 1' \
+            in out
+        assert "cometbft_consensus_block_interval_seconds_count 2" in out
+        assert "# TYPE cometbft_consensus_total_txs counter" in out
+
+    def test_labels(self):
+        reg = Registry()
+        c = reg.counter("p2p", "message_send_bytes_total", "bytes",
+                        labels=("chID",))
+        c.with_labels("0x20").add(100)
+        c.with_labels("0x21").add(50)
+        c.with_labels("0x20").add(1)
+        out = reg.render()
+        assert 'cometbft_p2p_message_send_bytes_total{chID="0x20"} 101' \
+            in out
+        assert 'cometbft_p2p_message_send_bytes_total{chID="0x21"} 50' \
+            in out
+
+    def test_register_idempotent(self):
+        reg = Registry()
+        a = reg.gauge("consensus", "height", "h")
+        b = reg.gauge("consensus", "height", "h")
+        assert a is b
+
+    def test_timer(self):
+        reg = Registry()
+        h = reg.histogram("state", "block_processing_seconds", "t")
+        with Timer(h):
+            time.sleep(0.01)
+        assert h._count == 1
+        assert h._sum >= 0.01
+
+
+class TestRateLimiter:
+    def test_unlimited(self):
+        async def run():
+            lim = RateLimiter(0)
+            t0 = time.monotonic()
+            for _ in range(100):
+                await lim.take(10_000_000)
+            assert time.monotonic() - t0 < 0.5
+            assert lim.total == 100 * 10_000_000
+        asyncio.run(run())
+
+    def test_limits_throughput(self):
+        """Pushing 3x the bucket through a 100kB/s limiter must take
+        ~2s beyond the initial burst."""
+        async def run():
+            lim = RateLimiter(100_000)      # 100 kB/s, 100 kB burst
+            t0 = time.monotonic()
+            for _ in range(30):
+                await lim.take(10_000)      # 300 kB total
+            elapsed = time.monotonic() - t0
+            assert elapsed >= 1.5, f"rate not enforced ({elapsed:.2f}s)"
+            assert elapsed < 4.0
+        asyncio.run(run())
+
+    def test_try_take(self):
+        lim = RateLimiter(1000, burst=1000)
+        assert lim.try_take(800)
+        assert not lim.try_take(800)       # bucket nearly empty
+        time.sleep(0.3)
+        assert lim.try_take(200)           # ~300 tokens refilled
