@@ -17,7 +17,6 @@ from typing import Optional
 from urllib.parse import urlparse
 
 from ..libs.log import new_logger
-from ..wire.proto import decode_uvarint
 from . import pb
 from . import types as abci
 
@@ -41,22 +40,8 @@ def parse_address(addr: str) -> tuple[str, str, int]:
 async def read_frame(reader: asyncio.StreamReader,
                      max_size: int = pb.MAX_MSG_SIZE) -> Optional[bytes]:
     """Read one uvarint-length-delimited frame; None on clean EOF."""
-    prefix = b""
-    while True:
-        b = await reader.read(1)
-        if not b:
-            if prefix:
-                raise ABCIServerError("EOF inside length prefix")
-            return None
-        prefix += b
-        if b[0] < 0x80:
-            break
-        if len(prefix) > 10:
-            raise ABCIServerError("length prefix too long")
-    size, _ = decode_uvarint(prefix, 0)
-    if size > max_size:
-        raise ABCIServerError(f"message too large: {size}")
-    return await reader.readexactly(size)
+    from ..libs.protoio import read_delimited
+    return await read_delimited(reader, max_size, ABCIServerError)
 
 
 class SocketServer:

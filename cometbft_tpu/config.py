@@ -26,6 +26,10 @@ class BaseConfig:
     genesis_file: str = "config/genesis.json"
     priv_validator_key_file: str = "config/priv_validator_key.json"
     priv_validator_state_file: str = "data/priv_validator_state.json"
+    # when set, the node listens here for an external remote signer and
+    # uses it instead of the file-backed key (reference:
+    # config.Base.PrivValidatorListenAddr)
+    priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     filter_peers: bool = False
 

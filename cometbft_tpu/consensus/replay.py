@@ -316,7 +316,7 @@ async def catchup_replay(cs, wal_path: str) -> int:
                 f"marker for {height - 1}")
         # fresh chain: replay everything in the WAL
         try:
-            tail = list(WAL.iter_messages(wal_path))
+            tail = list(WAL.iter_group(wal_path))
         except FileNotFoundError:
             return 0
     n = 0

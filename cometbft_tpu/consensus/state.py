@@ -439,8 +439,7 @@ class ConsensusState:
             height=height, round=round_, pol_round=rs.valid_round,
             block_id=prop_block_id, timestamp=block.header.time)
         try:
-            self.priv_validator.sign_proposal(self.sm_state.chain_id,
-                                              proposal)
+            await self._pv_sign_proposal(proposal)
         except Exception as e:
             if not self.replay_mode:
                 self.logger.error("failed signing proposal",
@@ -1056,6 +1055,24 @@ class ConsensusState:
         return self.sm_state.consensus_params.feature.pbts_enabled(
             height)
 
+    async def _pv_sign_vote(self, vote: Vote, sign_ext: bool) -> None:
+        """One seam for local (sync) and remote (async) signers."""
+        pv = self.priv_validator
+        if hasattr(pv, "sign_vote_async"):
+            await pv.sign_vote_async(self.sm_state.chain_id, vote,
+                                     sign_ext)
+        else:
+            pv.sign_vote(self.sm_state.chain_id, vote,
+                         sign_extension=sign_ext)
+
+    async def _pv_sign_proposal(self, proposal: Proposal) -> None:
+        pv = self.priv_validator
+        if hasattr(pv, "sign_proposal_async"):
+            await pv.sign_proposal_async(self.sm_state.chain_id,
+                                         proposal)
+        else:
+            pv.sign_proposal(self.sm_state.chain_id, proposal)
+
     async def _sign_vote(self, msg_type: int, hash_: bytes,
                    psh: PartSetHeader,
                    block: Optional[Block]) -> Optional[Vote]:
@@ -1088,8 +1105,7 @@ class ConsensusState:
                 vote.non_rp_extension = non_rp_ext
                 sign_ext = True
         try:
-            self.priv_validator.sign_vote(
-                self.sm_state.chain_id, vote, sign_extension=sign_ext)
+            await self._pv_sign_vote(vote, sign_ext)
         except Exception as e:
             self.logger.error("failed signing vote", err=str(e))
             return None

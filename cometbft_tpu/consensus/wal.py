@@ -31,11 +31,22 @@ def _frame(payload: bytes) -> bytes:
 
 
 class WAL:
-    """Append-only message log with explicit fsync barriers."""
+    """Append-only message log with explicit fsync barriers and file
+    rotation.
 
-    def __init__(self, path: str):
+    Rotation mirrors the reference's autofile group (internal/autofile
+    group.go): when the head file exceeds head_size_limit the head is
+    renamed to `<path>.NNN` and a fresh head opened; when the group
+    exceeds total_size_limit the oldest rotated files are deleted.
+    Replay iterates rotated files oldest-first, then the head."""
+
+    def __init__(self, path: str,
+                 head_size_limit: int = 4 * 1024 * 1024,
+                 total_size_limit: int = 128 * 1024 * 1024):
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         self._path = path
+        self._head_size_limit = head_size_limit
+        self._total_size_limit = total_size_limit
         self._f = open(path, "ab")
 
     @property
@@ -49,6 +60,28 @@ class WAL:
         if len(payload) > MAX_MSG_SIZE_BYTES:
             raise WALError(f"msg is too big: {len(payload)} bytes")
         self._f.write(_frame(payload))
+        if self._f.tell() > self._head_size_limit:
+            self._rotate()
+
+    def _rotate(self) -> None:
+        """Head -> numbered group file; enforce the total size cap."""
+        self._f.flush()
+        os.fsync(self._f.fileno())
+        self._f.close()
+        existing = WAL.group_files(self._path)[:-1]   # without head
+        nxt = 0
+        if existing:
+            nxt = int(existing[-1].rsplit(".", 1)[1]) + 1
+        os.replace(self._path, f"{self._path}.{nxt:03d}")
+        self._f = open(self._path, "ab")
+        # prune oldest rotated files beyond the total limit
+        files = WAL.group_files(self._path)[:-1]
+        total = sum(os.path.getsize(f) for f in files)
+        for f in files:
+            if total <= self._total_size_limit:
+                break
+            total -= os.path.getsize(f)
+            os.remove(f)
 
     def write_sync(self, msg: dict) -> None:
         """Append + flush + fsync (reference: WAL.WriteSync — used before
@@ -73,6 +106,30 @@ class WAL:
         self._f.close()
 
     # ------------------------------------------------------------------
+    @staticmethod
+    def group_files(path: str) -> list[str]:
+        """Rotated files (oldest first) + the head file, existing only."""
+        d = os.path.dirname(path) or "."
+        base = os.path.basename(path)
+        rotated = []
+        if os.path.isdir(d):
+            for name in os.listdir(d):
+                if name.startswith(base + "."):
+                    suffix = name[len(base) + 1:]
+                    if suffix.isdigit():
+                        rotated.append(os.path.join(d, name))
+        rotated.sort(key=lambda f: int(f.rsplit(".", 1)[1]))
+        out = rotated
+        if os.path.exists(path):
+            out = rotated + [path]
+        return out
+
+    @staticmethod
+    def iter_group(path: str, strict: bool = False) -> Iterator[dict]:
+        """All messages across the rotated group, oldest first."""
+        for f in WAL.group_files(path):
+            yield from WAL.iter_messages(f, strict=strict)
+
     @staticmethod
     def iter_messages(path: str, strict: bool = False) -> Iterator[dict]:
         """Decode records; on a torn tail (crash mid-write) stop unless
@@ -104,11 +161,11 @@ class WAL:
                               ) -> Optional[list[dict]]:
         """Messages AFTER the end-height marker for `height`, or None if
         the marker is absent (reference: SearchForEndHeight)."""
-        if not os.path.exists(path):
+        if not WAL.group_files(path):
             return None
         found = False
         out: list[dict] = []
-        for msg in WAL.iter_messages(path):
+        for msg in WAL.iter_group(path):
             if found:
                 out.append(msg)
             elif msg.get("type") == "end_height" and \

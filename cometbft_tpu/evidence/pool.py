@@ -91,13 +91,102 @@ class EvidencePool:
         if isinstance(ev, DuplicateVoteEvidence):
             self._verify_duplicate_vote(ev, state, ev_time)
         elif isinstance(ev, LightClientAttackEvidence):
-            # full light-client attack reconstruction arrives with the
-            # light client detector wiring
-            raise EvidenceError(
-                "light client attack evidence verification requires "
-                "the light client detector")
+            self._verify_light_client_attack(ev, state)
         else:
             raise EvidenceError(f"unknown evidence type {type(ev)}")
+
+    def _signed_header(self, height: int):
+        from ..types.block import SignedHeader
+        meta = self.block_store.load_block_meta(height)
+        commit = self.block_store.load_block_commit(height)
+        if commit is None:
+            commit = self.block_store.load_seen_commit(height)
+        if meta is None or commit is None:
+            raise EvidenceError(f"no header/commit at height {height}")
+        return SignedHeader(header=meta.header, commit=commit)
+
+    def _verify_light_client_attack(self, ev: LightClientAttackEvidence,
+                                    state: SMState) -> None:
+        """Reconstruct and verify the attack against OUR chain
+        (reference: verify.go VerifyLightClientAttack :105 + the common/
+        trusted header plumbing in verify :55-84)."""
+        from ..types.validation import (
+            Fraction, VerificationError, verify_commit_light,
+            verify_commit_light_trusting,
+        )
+        common_header = self._signed_header(ev.height)
+        common_vals = self.state_store.load_validators(ev.height)
+        conflicting = ev.conflicting_block
+        conf_height = conflicting.height
+        trusted_header = common_header
+        if ev.height != conf_height:
+            try:
+                trusted_header = self._signed_header(conf_height)
+            except EvidenceError:
+                # forward lunatic: we don't have a block there yet —
+                # judge against our latest (reference: verify.go :71-83)
+                trusted_header = self._signed_header(
+                    self.block_store.height)
+                if trusted_header.header.time.unix_ns() < \
+                        conflicting.signed_header.header.time.unix_ns():
+                    raise EvidenceError(
+                        "latest block is before conflicting block — "
+                        "cannot judge forward lunatic attack")
+
+        chain_id = state.chain_id
+        try:
+            if common_header.header.height != conf_height:
+                # lunatic: 1/3 of the COMMON set must have signed it
+                verify_commit_light_trusting(
+                    chain_id, common_vals,
+                    conflicting.signed_header.commit,
+                    Fraction(1, 3), count_all_signatures=True)
+            elif ev.conflicting_header_is_invalid(
+                    trusted_header.header):
+                raise EvidenceError(
+                    "common height equals conflicting height so the "
+                    "conflicting header must be correctly derived")
+            # 2/3+ of the conflicting set signed the conflicting block
+            verify_commit_light(
+                chain_id, conflicting.validator_set,
+                conflicting.signed_header.commit.block_id,
+                conf_height, conflicting.signed_header.commit,
+                count_all_signatures=True)
+        except VerificationError as e:
+            raise EvidenceError(
+                f"invalid conflicting block commit: {e}") from None
+
+        if ev.total_voting_power != common_vals.total_voting_power():
+            raise EvidenceError(
+                f"evidence voting power {ev.total_voting_power} != "
+                f"common set power {common_vals.total_voting_power()}")
+
+        conf_time = conflicting.signed_header.header.time
+        if conf_height > trusted_header.header.height:
+            if conf_time.unix_ns() > \
+                    trusted_header.header.time.unix_ns():
+                raise EvidenceError(
+                    "conflicting block does not violate monotonic time")
+        elif trusted_header.header.hash() == \
+                conflicting.signed_header.header.hash():
+            raise EvidenceError(
+                "trusted header hash matches the conflicting header")
+
+        # the ABCI-facing fields must match what WE derive
+        # (reference: validateABCIEvidence :218)
+        expect = ev.get_byzantine_validators(common_vals,
+                                             trusted_header)
+        if len(expect) != len(ev.byzantine_validators):
+            raise EvidenceError(
+                f"expected {len(expect)} byzantine validators, "
+                f"got {len(ev.byzantine_validators)}")
+        for want, got in zip(expect, ev.byzantine_validators):
+            if want.address != got.address:
+                raise EvidenceError(
+                    "unexpected byzantine validator address")
+        if ev.timestamp != common_header.header.time:
+            raise EvidenceError(
+                "evidence timestamp != common header time")
 
     def _verify_duplicate_vote(self, ev: DuplicateVoteEvidence,
                                state: SMState,

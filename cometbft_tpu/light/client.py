@@ -140,17 +140,20 @@ class Client:
                               now: Timestamp,
                               prefetched: Optional[LightBlock] = None
                               ) -> LightBlock:
+        trace: list[LightBlock] = [trusted]
         if self.mode == SEQUENTIAL:
-            lb = await self._verify_sequential(trusted, height, now)
+            lb = await self._verify_sequential(trusted, height, now,
+                                               trace)
         else:
             lb = await self._verify_skipping(trusted, height, now,
-                                             prefetched)
-        await self._detect_divergence(lb, now)
+                                             prefetched, trace)
+        await self._detect_divergence(lb, now, trace)
         return lb
 
     async def _verify_sequential(self, trusted: LightBlock,
-                                 height: int,
-                                 now: Timestamp) -> LightBlock:
+                                 height: int, now: Timestamp,
+                                 trace: Optional[list] = None
+                                 ) -> LightBlock:
         """Verify every header between trusted and height (reference:
         verifySequential)."""
         current = trusted
@@ -161,12 +164,15 @@ class Client:
                    self.trust_options.period_ns, now,
                    self.max_clock_drift_ns, self.trust_level)
             self.store.save_light_block(nxt)
+            if trace is not None:
+                trace.append(nxt)
             current = nxt
         return current
 
     async def _verify_skipping(self, trusted: LightBlock, height: int,
                                now: Timestamp,
-                               prefetched: Optional[LightBlock] = None
+                               prefetched: Optional[LightBlock] = None,
+                               trace: Optional[list] = None
                                ) -> LightBlock:
         """Bisection (reference: verifySkipping): try to jump straight
         to the target; on insufficient trust, bisect."""
@@ -183,6 +189,8 @@ class Client:
                        self.trust_options.period_ns, now,
                        self.max_clock_drift_ns, self.trust_level)
                 self.store.save_light_block(candidate)
+                if trace is not None:
+                    trace.append(candidate)
                 verified = candidate
                 pivots.pop()
             except LightClientError as e:
@@ -214,39 +222,71 @@ class Client:
 
     # ------------------------------------------------------------------
     async def _detect_divergence(self, verified: LightBlock,
-                                 now: Timestamp) -> None:
-        """Cross-check the verified header against witnesses
-        (reference: detector.go detectDivergence)."""
+                                 now: Timestamp,
+                                 trace: Optional[list] = None) -> None:
+        """Cross-check the verified header against witnesses; on
+        divergence, bisect OUR trace against the witness to find the
+        common block, attribute the equivocators, and report evidence to
+        both sides (reference: detector.go detectDivergence +
+        examineConflictingHeaderAgainstTrace :236 +
+        newLightClientAttackEvidence :420)."""
         if not self.witnesses:
             return
         h = verified.height
         target_hash = verified.signed_header.header.hash()
+        trace = trace or [verified]
         bad: list[Provider] = []
         for w in self.witnesses:
             try:
                 wlb = await w.light_block(h)
             except (ProviderError, LightBlockNotFoundError):
                 continue
-            if wlb.signed_header.header.hash() != target_hash:
-                # divergence: build attack evidence against the witness
-                # trace and report to both sides (reference:
-                # examineConflictingHeaderAgainstTrace)
-                common = self.store.latest()
-                ev = LightClientAttackEvidence(
-                    conflicting_block=wlb,
-                    common_height=min(common.height, h) if common
-                    else h,
-                    byzantine_validators=[],
-                    total_voting_power=verified.validator_set
-                    .total_voting_power(),
-                    timestamp=verified.signed_header.header.time)
-                try:
-                    await self.primary.report_evidence(ev)
-                    await w.report_evidence(ev)
-                except ProviderError:
-                    pass
-                bad.append(w)
+            if wlb.signed_header.header.hash() == target_hash:
+                continue
+            ev = await self._build_attack_evidence(w, wlb, trace)
+            try:
+                await self.primary.report_evidence(ev)
+                await w.report_evidence(ev)
+            except ProviderError:
+                pass
+            bad.append(w)
         if bad:
             for w in bad:
                 self.witnesses.remove(w)
-            raise DivergenceError(bad[0])
+            raise DivergenceError(bad[0], evidence=None)
+
+    async def _build_attack_evidence(self, witness: Provider,
+                                     conflicting: LightBlock,
+                                     trace: list
+                                     ) -> LightClientAttackEvidence:
+        """Walk the trace to the LAST block the witness agrees with —
+        that is the common block; the trusted block is our verified end
+        of trace (reference: examineConflictingHeaderAgainstTrace)."""
+        common = trace[0]
+        for tb in trace:
+            try:
+                wb = await witness.light_block(tb.height)
+            except (ProviderError, LightBlockNotFoundError):
+                break
+            if wb.signed_header.header.hash() != \
+                    tb.signed_header.header.hash():
+                break
+            common = tb
+        trusted = trace[-1]
+        if conflicting.height != common.height:
+            common_height = common.height
+            timestamp = common.signed_header.header.time
+            total_power = common.validator_set.total_voting_power()
+        else:
+            common_height = trusted.height
+            timestamp = trusted.signed_header.header.time
+            total_power = trusted.validator_set.total_voting_power()
+        ev = LightClientAttackEvidence(
+            conflicting_block=conflicting,
+            common_height=common_height,
+            byzantine_validators=[],
+            total_voting_power=total_power,
+            timestamp=timestamp)
+        ev.byzantine_validators = ev.get_byzantine_validators(
+            common.validator_set, trusted.signed_header)
+        return ev

@@ -85,9 +85,15 @@ class Node:
                 config.base.genesis_file))
         self.node_key = NodeKey.load_or_gen(
             config.base.path(config.base.node_key_file))
-        self.priv_validator = FilePV.load_or_generate(
-            config.base.path(config.base.priv_validator_key_file),
-            config.base.path(config.base.priv_validator_state_file))
+        if config.base.priv_validator_laddr:
+            # remote signer: key lives in an external process
+            # (reference: createAndStartPrivValidatorSocketClient,
+            # setup.go:715); connection established in start()
+            self.priv_validator = None
+        else:
+            self.priv_validator = FilePV.load_or_generate(
+                config.base.path(config.base.priv_validator_key_file),
+                config.base.path(config.base.priv_validator_state_file))
 
         # --- storage ----------------------------------------------------
         backend = config.base.db_backend
@@ -120,6 +126,30 @@ class Node:
         # --- event bus --------------------------------------------------
         self.event_bus = EventBus()
 
+        # --- metrics (reference: per-package metrics.go + /metrics) -----
+        from ..libs.metrics import Registry
+        self.metrics_registry = Registry()
+        m = self.metrics_registry
+        self._m_height = m.gauge("consensus", "height",
+                                 "Height of the chain")
+        self._m_txs = m.counter("consensus", "total_txs",
+                                "Total committed txs")
+        self._m_block_interval = m.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block")
+        self._m_block_size = m.gauge("consensus", "block_size_bytes",
+                                     "Size of the latest block")
+        self._m_validators = m.gauge("consensus", "validators",
+                                     "Number of validators")
+        self._m_mempool_size = m.gauge("mempool", "size",
+                                       "Pending txs in the mempool")
+        self._m_peers = m.gauge("p2p", "peers", "Connected peers")
+        self._m_p2p_sent = m.gauge("p2p", "message_send_bytes_total",
+                                   "Bytes sent to peers")
+        self._m_p2p_recv = m.gauge("p2p", "message_receive_bytes_total",
+                                   "Bytes received from peers")
+        self._last_block_time_s: float = 0.0
+
         # --- mempool ----------------------------------------------------
         self.mempool: Optional[CListMempool] = None
         self.mempool_reactor: Optional[MempoolReactor] = None
@@ -144,6 +174,19 @@ class Node:
         """Boot order mirrors node.OnStart."""
         cfg = self.config
 
+        if cfg.base.priv_validator_laddr:
+            from ..privval.signer import (
+                RetrySignerClient, SignerClient, SignerListenerEndpoint,
+            )
+            self._signer_endpoint = SignerListenerEndpoint(
+                cfg.base.priv_validator_laddr)
+            await self._signer_endpoint.start()
+            await self._signer_endpoint.wait_for_signer()
+            client = RetrySignerClient(SignerClient(
+                self._signer_endpoint, self.genesis_doc.chain_id))
+            await client.fetch_pub_key()
+            self.priv_validator = client
+
         # out-of-process app: open the four socket AppConns first
         # (reference: createAndStartProxyAppConns, setup.go:179)
         await self.app_conns.start()
@@ -162,6 +205,14 @@ class Node:
             lanes=info.lane_priorities or None,
             default_lane=info.default_lane,
             height=state.last_block_height)
+
+        # pruner service (reference: state/pruner.go via setup.go)
+        from ..state.pruner import Pruner
+        self.pruner = Pruner(
+            self.state_store, self.block_store,
+            new_db("pruner", cfg.base.db_backend,
+                   cfg.base.path(cfg.base.db_dir)))
+        await self.pruner.start()
 
         # evidence pool
         from ..evidence import EvidencePool
@@ -191,6 +242,7 @@ class Node:
             mempool=self.mempool, evpool=self.evidence_pool,
             event_bus=self.event_bus,
             block_store=self.block_store)
+        block_exec.pruner = self.pruner
 
         wal_path = cfg.base.path(cfg.consensus.wal_file)
         self.consensus_state = ConsensusState(
@@ -265,12 +317,18 @@ class Node:
             await self.blocksync_reactor.start_sync()
         else:
             await self.consensus_state.start()
+        self._metrics_task = asyncio.get_running_loop().create_task(
+            self._metrics_watcher())
         self._started = True
         self.logger.info("Node started",
                          node_id=self.node_key.id[:12],
                          chain=self.genesis_doc.chain_id)
 
     async def stop(self) -> None:
+        if getattr(self, "_metrics_task", None) is not None:
+            self._metrics_task.cancel()
+        if getattr(self, "pruner", None) is not None:
+            await self.pruner.stop()
         if getattr(self, "indexer_service", None) is not None:
             await self.indexer_service.stop()
         if self.consensus_state is not None:
@@ -279,8 +337,47 @@ class Node:
         if self._rpc_server is not None:
             await self._rpc_server.stop()
         await self.app_conns.stop()
+        if getattr(self, "_signer_endpoint", None) is not None:
+            await self._signer_endpoint.stop()
         self._started = False
         self.logger.info("Node stopped")
+
+    async def _metrics_watcher(self) -> None:
+        """Event-driven metric updates (reference: recordMetrics in
+        internal/consensus/state.go + per-subsystem metrics.go)."""
+        import time as _time
+        sub = self.event_bus.subscribe("node-metrics",
+                                       "tm.event = 'NewBlock'")
+        try:
+            while True:
+                msg = await sub.next()
+                now = _time.monotonic()
+                payload = msg.data.payload
+                block = payload.get("block")
+                if block is None:
+                    continue
+                self._m_height.set(block.header.height)
+                self._m_txs.add(len(block.data.txs))
+                if self._last_block_time_s:
+                    self._m_block_interval.observe(
+                        now - self._last_block_time_s)
+                self._last_block_time_s = now
+                state = self.state_store.load()
+                if state is not None:
+                    self._m_validators.set(state.validators.size())
+                if self.mempool is not None:
+                    self._m_mempool_size.set(self.mempool.size())
+                self._m_peers.set(self.switch.num_peers())
+                sent = recv = 0
+                for peer in self.switch.peers.values():
+                    sent += peer.mconn.send_limiter.total
+                    recv += peer.mconn.recv_limiter.total
+                self._m_p2p_sent.set(sent)
+                self._m_p2p_recv.set(recv)
+        except asyncio.CancelledError:
+            raise
+        except Exception:
+            self.logger.error("metrics watcher died", exc_info=True)
 
     # ------------------------------------------------------------------
     @property

@@ -272,10 +272,28 @@ def verify_batch(
     return bool(out.all()), out.tolist()
 
 
+def _kernel_choice() -> str:
+    """'pallas' (fused Mosaic kernel; TPU) or 'xla' (portable).
+
+    COMETBFT_TPU_KERNEL=pallas|xla overrides; auto picks pallas on TPU
+    platforms only — on CPU the pallas path would run interpreted."""
+    choice = os.environ.get("COMETBFT_TPU_KERNEL", "auto").lower()
+    if choice in ("pallas", "xla"):
+        return choice
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:
+        return "xla"
+    return "pallas" if platform == "tpu" else "xla"
+
+
 def _verify_chunk(items) -> np.ndarray:
     enable_compilation_cache()
     n = len(items)
     m = _bucket(n)
+    if _kernel_choice() == "pallas":
+        from . import ed25519_pallas as ep
+        m = max(m, ep.BLOCK)
     a_b = np.zeros((m, 32), np.uint8)
     r_b = np.zeros((m, 32), np.uint8)
     s_raw = np.zeros((m, 32), np.uint8)
@@ -297,10 +315,18 @@ def _verify_chunk(items) -> np.ndarray:
         s_raw[i] = np.frombuffer(sig[32:], np.uint8)
         k = ref.sha512_mod_l(sig[:32], pub, msg)
         k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
-    ok = np.asarray(_jit_verify(
-        jnp.asarray(a_b), jnp.asarray(r_b),
-        jnp.asarray(_windows_le(s_raw)),
-        jnp.asarray(_windows_le(k_raw))))
+    if _kernel_choice() == "pallas":
+        from . import ed25519_pallas as ep
+        ok = np.asarray(ep.verify_cols(
+            jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),
+            jnp.asarray(np.ascontiguousarray(r_b.T).astype(np.int32)),
+            jnp.asarray(_windows_le(s_raw)),
+            jnp.asarray(_windows_le(k_raw))))
+    else:
+        ok = np.asarray(_jit_verify(
+            jnp.asarray(a_b), jnp.asarray(r_b),
+            jnp.asarray(_windows_le(s_raw)),
+            jnp.asarray(_windows_le(k_raw))))
     ok = ok[:n].copy()
     ok[pre_bad[:n]] = False
     return ok
@@ -314,6 +340,17 @@ def warmup(n: int) -> None:
 @functools.lru_cache(maxsize=None)
 def _warmup_bucket(m: int) -> None:
     enable_compilation_cache()
+    if _kernel_choice() == "pallas":
+        from . import ed25519_pallas as ep
+        m = max(m, ep.BLOCK)
+        a = np.tile(np.frombuffer(_B_BYTES, np.uint8).astype(np.int32)
+                    .reshape(32, 1), (1, m))
+        r = np.tile(np.frombuffer(_IDENTITY_BYTES, np.uint8)
+                    .astype(np.int32).reshape(32, 1), (1, m))
+        z = np.zeros((_WINDOWS, m), np.int32)
+        np.asarray(ep.verify_cols(jnp.asarray(a), jnp.asarray(r),
+                                  jnp.asarray(z), jnp.asarray(z)))
+        return
     a = np.tile(np.frombuffer(_B_BYTES, np.uint8), (m, 1))
     r = np.tile(np.frombuffer(_IDENTITY_BYTES, np.uint8), (m, 1))
     z = np.zeros((_WINDOWS, m), np.int32)

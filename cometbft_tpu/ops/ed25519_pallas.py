@@ -99,6 +99,7 @@ _P_NP = np.frombuffer(field.P.to_bytes(32, "little"), np.uint8
                       ).astype(np.int32)
 
 
+
 def _seq_carry(x):
     """Exact sequential sweep: rows -> [0,256), plus carry row."""
     outs = []
@@ -110,9 +111,9 @@ def _seq_carry(x):
     return jnp.concatenate(outs, axis=0), c
 
 
-def _canonical(x):
+def _canonical(x, four_p):
     x = _norm(x, 4)
-    x = x + jnp.asarray(2 * 2 * _P_NP.reshape(LIMBS, 1))      # + 4p
+    x = x + four_p                                            # + 4p
     for _ in range(3):
         x, c = _seq_carry(x)
         x = jnp.concatenate([x[0:1] + _FOLD * c, x[1:]], axis=0)
@@ -136,21 +137,21 @@ def _canonical(x):
     return x
 
 
-def _is_zero(x):
+def _is_zero(x, four_p):
     """[1, B] bool: x == 0 mod p."""
-    c = _canonical(x)
+    c = _canonical(x, four_p)
     nz = c[0:1]
     for i in range(1, LIMBS):
         nz = nz | c[i:i + 1]
     return nz == 0
 
 
-def _eq(a, b):
-    return _is_zero(a - b)
+def _eq(a, b, four_p):
+    return _is_zero(a - b, four_p)
 
 
-def _parity(x):
-    return _canonical(x)[0:1] & 1
+def _parity(x, four_p):
+    return _canonical(x, four_p)[0:1] & 1
 
 
 # --- point ops (extended twisted Edwards, limb-major) -----------------------
@@ -160,13 +161,13 @@ _2D_COL = field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1)
 _SQRT_M1_COL = field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1)
 
 
-def _ext_add(p, q):
+def _ext_add(p, q, two_d):
     """Unified add (complete for a=-1)."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
     a = _mul(Y1 - X1, Y2 - X2)
     b = _mul(Y1 + X1, Y2 + X2)
-    c = _mul(_mul(T1, T2), jnp.asarray(_2D_COL))
+    c = _mul(_mul(T1, T2), two_d)
     d = _mul_const(_mul(Z1, Z2), 2)
     e = b - a
     f = d - c
@@ -188,23 +189,23 @@ def _ext_double(p):
     return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
 
 
-def _decompress(b):
+def _decompress(b, d_col, sqrt_m1, four_p):
     """b: [32, B] int32 byte values -> (x, y, ok) limb-major."""
     sign = b[31:32] >> 7
     y = jnp.concatenate([b[:31], b[31:32] & 0x7F], axis=0)
     one = jnp.zeros_like(y).at[0:1].set(1)
     yy = _sqr(y)
     u = yy - one
-    v = _mul(yy, jnp.asarray(_D_COL)) + one
+    v = _mul(yy, d_col) + one
     v3 = _mul(_sqr(v), v)
     v7 = _mul(_sqr(v3), v)
     x = _mul(_mul(u, v3), _pow_p58(_mul(u, v7)))
     vxx = _mul(v, _sqr(x))
-    ok_direct = _eq(vxx, u)
-    ok_flip = _eq(vxx, -u)
-    x = jnp.where(ok_flip, _mul(x, jnp.asarray(_SQRT_M1_COL)), x)
+    ok_direct = _eq(vxx, u, four_p)
+    ok_flip = _eq(vxx, -u, four_p)
+    x = jnp.where(ok_flip, _mul(x, sqrt_m1), x)
     valid = ok_direct | ok_flip
-    wrong_sign = _parity(x) != sign
+    wrong_sign = _parity(x, four_p) != sign
     x = jnp.where(wrong_sign, -x, x)
     return x, y, valid
 
@@ -225,14 +226,31 @@ def _build_b_table_cols() -> np.ndarray:
 
 _B_TABLE_NP = _build_b_table_cols()
 
+# packed constants input: D, 2D, sqrt(-1), 4p, then the flattened B table
+_CONSTS_NP = np.concatenate([
+    field.to_limbs(ref.D).reshape(LIMBS, 1).astype(np.int32),
+    field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1).astype(np.int32),
+    field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1).astype(np.int32),
+    # 4p as limb-wise double of 2p = 2^256 - 38 (fits 32 bytes)
+    (2 * np.frombuffer((2 * field.P).to_bytes(32, "little"), np.uint8)
+     .astype(np.int32)).reshape(LIMBS, 1),
+    _B_TABLE_NP.reshape(16 * 4 * LIMBS, 1),
+], axis=0)
 
-def _kernel(a_ref, r_ref, swin_ref, kwin_ref, ok_ref, tab_ref):
+
+def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
+            tab_ref):
     B = a_ref.shape[1]
     a_b = a_ref[:]
     r_b = r_ref[:]
+    d_col = consts_ref[0:LIMBS]
+    two_d = consts_ref[LIMBS:2 * LIMBS]
+    sqrt_m1 = consts_ref[2 * LIMBS:3 * LIMBS]
+    four_p = consts_ref[3 * LIMBS:4 * LIMBS]
+    b_tab = consts_ref[4 * LIMBS:].reshape(16, 4, LIMBS, 1)
 
-    ax, ay, a_ok = _decompress(a_b)
-    rx, ry, r_ok = _decompress(r_b)
+    ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p)
+    rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p)
     one = jnp.zeros((LIMBS, B), jnp.int32).at[0:1].set(1)
     zero = jnp.zeros((LIMBS, B), jnp.int32)
 
@@ -252,13 +270,12 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, ok_ref, tab_ref):
         p = (prev[0:LIMBS], prev[LIMBS:2 * LIMBS],
              prev[2 * LIMBS:3 * LIMBS], prev[3 * LIMBS:])
         q = (nax, nay, one, nat)
-        r = _ext_add(p, q)
+        r = _ext_add(p, q, two_d)
         tab_ref[i + 1] = jnp.concatenate(r, axis=0)
         return 0
 
     lax.fori_loop(1, 15, build_body, 0)
 
-    b_tab = jnp.asarray(_B_TABLE_NP)          # [16, 4, 32, 1]
     swin = swin_ref[:]
     kwin = kwin_ref[:]
 
@@ -289,8 +306,8 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, ok_ref, tab_ref):
         w = (_WINDOWS - 1) - j
         sw = lax.dynamic_slice_in_dim(swin, w, 1, axis=0)
         kw = lax.dynamic_slice_in_dim(kwin, w, 1, axis=0)
-        acc = _ext_add(acc, select_b_table(sw))
-        acc = _ext_add(acc, select_lane_table(kw))
+        acc = _ext_add(acc, select_b_table(sw), two_d)
+        acc = _ext_add(acc, select_lane_table(kw), two_d)
         return acc
 
     acc = lax.fori_loop(0, _WINDOWS, ladder_body,
@@ -298,11 +315,11 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, ok_ref, tab_ref):
 
     # subtract R, clear cofactor, identity test
     nrt = _mul(-rx, ry)
-    acc = _ext_add(acc, (-rx, ry, one, nrt))
+    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d)
     for _ in range(3):
         acc = _ext_double(acc)
     X, Y, Z, _T = acc
-    ok = _is_zero(X) & _eq(Y, Z) & a_ok & r_ok          # [1, B] bool
+    ok = _is_zero(X, four_p) & _eq(Y, Z, four_p) & a_ok & r_ok
     ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, B))
 
 
@@ -325,6 +342,8 @@ def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False):
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((_WINDOWS, BLOCK), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
+            pl.BlockSpec((_CONSTS_NP.shape[0], 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((8, BLOCK), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
@@ -332,7 +351,7 @@ def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False):
             pltpu.VMEM((16, 4 * LIMBS, BLOCK), jnp.int32),
         ],
         interpret=interpret,
-    )(a_cols, r_cols, s_win, k_win)
+    )(a_cols, r_cols, s_win, k_win, jnp.asarray(_CONSTS_NP))
     return out[0] != 0
 
 

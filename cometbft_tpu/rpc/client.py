@@ -253,16 +253,17 @@ class WSClient:
                 pass
 
     async def _recv_loop(self) -> None:
-        from .ws import OP_CLOSE, OP_PING, OP_PONG, OP_TEXT, frame, \
-            read_message
+        from .ws import OP_CLOSE, OP_PING, OP_TEXT, frame, read_message
+
+        async def on_control(op, payload):
+            if op == OP_PING:
+                await self._send_raw(frame(OP_PONG, payload, mask=True))
+
         try:
             while True:
-                op, data = await read_message(self._reader)
+                op, data = await read_message(self._reader, on_control)
                 if op == OP_CLOSE:
                     return
-                if op == OP_PING:
-                    await self._send_raw(frame(OP_PONG, data, mask=True))
-                    continue
                 if op != OP_TEXT:
                     continue
                 msg = json.loads(data)
@@ -282,6 +283,14 @@ class WSClient:
         except (asyncio.CancelledError, asyncio.IncompleteReadError,
                 ConnectionError):
             pass
+        finally:
+            # connection gone: fail every caller still awaiting a reply
+            # and wake subscription readers with a sentinel error
+            err = RPCClientError("websocket connection closed")
+            for fut in self._pending.values():
+                if not fut.done():
+                    fut.set_exception(err)
+            self._pending.clear()
 
     async def _send_raw(self, data: bytes) -> None:
         self._writer.write(data)

@@ -75,6 +75,20 @@ class RPCServer:
                         break
                     k, _, v = line.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
+                if http_method_is_metrics(method, target):
+                    payload = self.node.metrics_registry.render().encode() \
+                        if getattr(self.node, "metrics_registry", None) \
+                        else b"# metrics disabled\n"
+                    writer.write(
+                        b"HTTP/1.1 200 OK\r\n"
+                        b"Content-Type: text/plain; version=0.0.4\r\n"
+                        b"Content-Length: " +
+                        str(len(payload)).encode() + b"\r\n"
+                        b"Connection: keep-alive\r\n\r\n" + payload)
+                    await writer.drain()
+                    if headers.get("connection", "").lower() == "close":
+                        break
+                    continue
                 if headers.get("upgrade", "").lower() == "websocket":
                     # reference: ws_handler.go — the /websocket endpoint
                     from .ws import WsSession
@@ -160,6 +174,12 @@ class RPCServer:
             return _err_response(rpc_id, -32603, "Internal error",
                                  str(e))
         return {"jsonrpc": "2.0", "id": rpc_id, "result": result}
+
+
+def http_method_is_metrics(method: str, target: str) -> bool:
+    """GET /metrics — the Prometheus exposition endpoint (reference:
+    node/node.go prometheusSrv + instrumentation config)."""
+    return method == "GET" and target.split("?", 1)[0] == "/metrics"
 
 
 def _err_response(rpc_id, code: int, message: str,

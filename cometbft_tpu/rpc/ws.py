@@ -47,9 +47,14 @@ def handshake_response(headers: dict) -> bytes:
         b"\r\n\r\n")
 
 
-async def read_message(reader: asyncio.StreamReader
-                       ) -> tuple[int, bytes]:
-    """Read one complete (possibly fragmented) message -> (opcode, data)."""
+async def read_message(reader: asyncio.StreamReader,
+                       on_control=None) -> tuple[int, bytes]:
+    """Read one complete (possibly fragmented) message -> (opcode, data).
+
+    RFC 6455 permits control frames BETWEEN the fragments of a message;
+    when `on_control(op, payload)` (async) is given, PING/PONG frames are
+    delivered to it without discarding accumulated fragments.  OP_CLOSE
+    always returns immediately — the connection is ending."""
     opcode = None
     data = b""
     while True:
@@ -69,8 +74,13 @@ async def read_message(reader: asyncio.StreamReader
         if masked:
             payload = bytes(b ^ mask[i % 4]
                             for i, b in enumerate(payload))
-        if op in (OP_CLOSE, OP_PING, OP_PONG):
-            return op, payload              # control frames never fragment
+        if op == OP_CLOSE:
+            return op, payload
+        if op in (OP_PING, OP_PONG):
+            if on_control is not None:
+                await on_control(op, payload)
+                continue
+            return op, payload
         if opcode is None:
             opcode = op
         data += payload
@@ -118,17 +128,16 @@ class WsSession:
     async def run(self, headers: dict) -> None:
         self.writer.write(handshake_response(headers))
         await self.writer.drain()
+        async def on_control(op, payload):
+            if op == OP_PING:
+                await self._send_raw(frame(OP_PONG, payload))
+
         try:
             while True:
-                op, data = await read_message(self.reader)
+                op, data = await read_message(self.reader, on_control)
                 if op == OP_CLOSE:
                     await self._send_raw(frame(OP_CLOSE, data[:2]))
                     return
-                if op == OP_PING:
-                    await self._send_raw(frame(OP_PONG, data))
-                    continue
-                if op == OP_PONG:
-                    continue
                 if op not in (OP_TEXT, OP_BIN):
                     continue
                 try:

@@ -117,7 +117,8 @@ class StatesyncReactor(Reactor):
             if not cr.get("missing", False):
                 self.syncer.add_chunk(
                     cr.get("height", 0), cr.get("format", 0),
-                    cr.get("index", 0), cr.get("chunk", b""))
+                    cr.get("index", 0), cr.get("chunk", b""),
+                    sender=peer.id)
 
     # ------------------------------------------------------------------
     def request_chunk(self, snap: SnapshotKey, index: int) -> None:

@@ -93,12 +93,75 @@ class StateProvider:
         return state
 
 
+class ChunkQueue:
+    """Disk-backed chunk staging (reference: statesync/chunks.go — a
+    temp-dir queue so a large snapshot never lives in process memory,
+    with per-chunk sender tracking for reject_senders)."""
+
+    def __init__(self, snap: SnapshotKey, directory: str):
+        import os
+        self.snap = snap
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+        self._have: set[int] = set()
+        self._senders: dict[int, str] = {}
+        self.event = asyncio.Event()
+
+    def _path(self, index: int) -> str:
+        import os
+        return os.path.join(self.dir, f"chunk-{index:06d}")
+
+    def add(self, index: int, chunk: bytes, sender: str = "") -> bool:
+        if index in self._have or not (0 <= index < self.snap.chunks):
+            return False
+        with open(self._path(index), "wb") as f:
+            f.write(chunk)
+        self._have.add(index)
+        self._senders[index] = sender
+        self.event.set()
+        return True
+
+    def has(self, index: int) -> bool:
+        return index in self._have
+
+    def load(self, index: int) -> bytes:
+        with open(self._path(index), "rb") as f:
+            return f.read()
+
+    def sender(self, index: int) -> str:
+        return self._senders.get(index, "")
+
+    def discard(self, index: int) -> None:
+        """Drop a chunk so it gets refetched (reference: chunks.go
+        Discard)."""
+        import os
+        if index in self._have:
+            self._have.discard(index)
+            self._senders.pop(index, None)
+            try:
+                os.remove(self._path(index))
+            except OSError:
+                pass
+
+    def discard_from_sender(self, sender: str) -> list[int]:
+        """Drop every chunk from a banned sender (reject_senders)."""
+        bad = [i for i, s in self._senders.items() if s == sender]
+        for i in bad:
+            self.discard(i)
+        return bad
+
+    def close(self) -> None:
+        import shutil
+        shutil.rmtree(self.dir, ignore_errors=True)
+
+
 class Syncer:
     """Reference: statesync/syncer.go."""
 
     def __init__(self, app_conns, state_provider: StateProvider,
                  request_chunk,
                  chunk_timeout_s: float = 10.0,
+                 chunk_dir: Optional[str] = None,
                  logger: Optional[Logger] = None):
         """request_chunk(snapshot, index) asks some peer for a chunk;
         results arrive via add_chunk."""
@@ -106,21 +169,27 @@ class Syncer:
         self.state_provider = state_provider
         self.request_chunk = request_chunk
         self.chunk_timeout_s = chunk_timeout_s
+        self.chunk_dir = chunk_dir
+        self._owns_chunk_dir = chunk_dir is None
         self.logger = logger if logger is not None else \
             new_logger("statesync")
         self.snapshots: dict[SnapshotKey, set[str]] = {}
-        self._chunks: dict[int, bytes] = {}
-        self._chunk_event = asyncio.Event()
+        self._queue: Optional[ChunkQueue] = None
+        self.banned_senders: set[str] = set()
 
     # ------------------------------------------------------------------
     def add_snapshot(self, peer_id: str, snap: SnapshotKey) -> None:
         self.snapshots.setdefault(snap, set()).add(peer_id)
 
     def add_chunk(self, height: int, format_: int, index: int,
-                  chunk: bytes) -> None:
-        if index not in self._chunks:
-            self._chunks[index] = chunk
-            self._chunk_event.set()
+                  chunk: bytes, sender: str = "") -> None:
+        q = self._queue
+        if q is None or q.snap.height != height or \
+                q.snap.format != format_:
+            return
+        if sender in self.banned_senders:
+            return
+        q.add(index, chunk, sender)
 
     # ------------------------------------------------------------------
     async def sync_any(self, discovery_time_s: float = 2.0
@@ -165,34 +234,66 @@ class Syncer:
             raise RejectSnapshotError(
                 f"app rejected snapshot: {offer.result}")
 
-        self._chunks.clear()
-        # fetch + apply chunks in order
-        applied = 0
-        requested: set[int] = set()
-        while applied < snap.chunks:
-            for i in range(snap.chunks):
-                if i not in self._chunks and i not in requested:
-                    self.request_chunk(snap, i)
-                    requested.add(i)
-            if applied not in self._chunks:
-                self._chunk_event.clear()
-                try:
-                    await asyncio.wait_for(self._chunk_event.wait(),
-                                           self.chunk_timeout_s)
-                except asyncio.TimeoutError:
-                    requested.clear()   # re-request everything missing
-                continue
-            resp = await self.app_conns.snapshot.apply_snapshot_chunk(
-                abci.ApplySnapshotChunkRequest(
-                    index=applied, chunk=self._chunks[applied]))
-            if resp.result == abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT:
-                applied += 1
-            elif resp.result == abci.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY:
-                self._chunks.pop(applied, None)
-                requested.discard(applied)
-            else:
-                raise RejectSnapshotError(
-                    f"chunk apply failed: {resp.result}")
+        import os
+        import tempfile
+        if self.chunk_dir is None:
+            self.chunk_dir = tempfile.mkdtemp(
+                prefix="statesync-chunks-")
+        self._queue = ChunkQueue(
+            snap, os.path.join(self.chunk_dir,
+                               f"snap-{snap.height}-{snap.format}"))
+        q = self._queue
+        try:
+            # parallel fetchers with per-chunk retry; chunks applied
+            # strictly in order (reference: syncer.go fetchChunks +
+            # applyChunks)
+            applied = 0
+            requested: set[int] = set()
+            while applied < snap.chunks:
+                for i in range(snap.chunks):
+                    if not q.has(i) and i not in requested:
+                        self.request_chunk(snap, i)
+                        requested.add(i)
+                if not q.has(applied):
+                    q.event.clear()
+                    try:
+                        await asyncio.wait_for(q.event.wait(),
+                                               self.chunk_timeout_s)
+                    except asyncio.TimeoutError:
+                        requested.clear()  # re-request everything missing
+                    continue
+                resp = await \
+                    self.app_conns.snapshot.apply_snapshot_chunk(
+                        abci.ApplySnapshotChunkRequest(
+                            index=applied, chunk=q.load(applied),
+                            sender=q.sender(applied)))
+                # senders the app rejects are banned and their chunks
+                # refetched (reference: syncer.go applyChunks)
+                for bad in resp.reject_senders:
+                    if bad:
+                        self.banned_senders.add(bad)
+                        for i in q.discard_from_sender(bad):
+                            requested.discard(i)
+                for i in resp.refetch_chunks:
+                    q.discard(i)
+                    requested.discard(i)
+                if resp.result == \
+                        abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT:
+                    applied += 1
+                elif resp.result == \
+                        abci.APPLY_SNAPSHOT_CHUNK_RESULT_RETRY:
+                    q.discard(applied)
+                    requested.discard(applied)
+                else:
+                    raise RejectSnapshotError(
+                        f"chunk apply failed: {resp.result}")
+        finally:
+            q.close()
+            self._queue = None
+            if self._owns_chunk_dir and self.chunk_dir is not None:
+                import shutil
+                shutil.rmtree(self.chunk_dir, ignore_errors=True)
+                self.chunk_dir = None
 
         # verify the app's restored state matches the trusted app hash
         info = await self.app_conns.query.info(abci.InfoRequest())

@@ -135,6 +135,56 @@ class LightClientAttackEvidence:
 
     TYPE = "light_client_attack"
 
+    def conflicting_header_is_invalid(self, trusted_header) -> bool:
+        """Lunatic-attack detection: any state-derived header field
+        differs (reference: evidence.go ConflictingHeaderIsInvalid
+        :313)."""
+        ch = self.conflicting_block.signed_header.header
+        return (trusted_header.validators_hash != ch.validators_hash or
+                trusted_header.next_validators_hash !=
+                ch.next_validators_hash or
+                trusted_header.consensus_hash != ch.consensus_hash or
+                trusted_header.app_hash != ch.app_hash or
+                trusted_header.last_results_hash != ch.last_results_hash)
+
+    def get_byzantine_validators(self, common_vals,
+                                 trusted_signed_header
+                                 ) -> list[Validator]:
+        """Attribute the equivocators (reference: evidence.go
+        GetByzantineValidators :260): lunatic -> common-set validators
+        who signed the lunatic header; equivocation (same round) ->
+        validators who signed both; amnesia (different rounds) ->
+        unattributable, empty."""
+        from .vote import BLOCK_ID_FLAG_COMMIT
+        out: list[Validator] = []
+        conflicting = self.conflicting_block
+        if self.conflicting_header_is_invalid(
+                trusted_signed_header.header):
+            for cs in conflicting.signed_header.commit.signatures:
+                if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                    continue
+                _, val = common_vals.get_by_address(
+                    cs.validator_address)
+                if val is not None:
+                    out.append(val)
+        elif trusted_signed_header.commit.round == \
+                conflicting.signed_header.commit.round:
+            trusted_sigs = trusted_signed_header.commit.signatures
+            for i, sig_a in enumerate(
+                    conflicting.signed_header.commit.signatures):
+                if sig_a.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                    continue
+                if i >= len(trusted_sigs) or \
+                        trusted_sigs[i].block_id_flag != \
+                        BLOCK_ID_FLAG_COMMIT:
+                    continue
+                _, val = conflicting.validator_set.get_by_address(
+                    sig_a.validator_address)
+                if val is not None:
+                    out.append(val)
+        out.sort(key=lambda v: (-v.voting_power, v.address))
+        return out
+
     def bytes(self) -> bytes:
         return encode(pb.LIGHT_CLIENT_ATTACK_EVIDENCE, self.to_proto())
 

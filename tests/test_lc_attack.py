@@ -1,0 +1,220 @@
+"""Light-client attack: forged witness header -> trace bisection ->
+attributed evidence -> peer evidence pool verifies and admits it.
+
+Reference: light/detector.go (examineConflictingHeaderAgainstTrace,
+newLightClientAttackEvidence), internal/evidence/verify.go
+(VerifyLightClientAttack, validateABCIEvidence), types/evidence.go
+GetByzantineValidators.
+"""
+import asyncio
+import dataclasses
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+from cometbft_tpu.config import Config
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.db.db import MemDB
+from cometbft_tpu.evidence import EvidencePool
+from cometbft_tpu.evidence.pool import EvidenceError
+from cometbft_tpu.light.client import (
+    Client, DivergenceError, TrustOptions,
+)
+from cometbft_tpu.light.provider import NodeProvider
+from cometbft_tpu.light.store import TrustedStore
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block import LightBlock, SignedHeader
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import Commit, CommitSig
+from cometbft_tpu.types.evidence import LightClientAttackEvidence
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.priv_validator import new_mock_pv
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import BLOCK_ID_FLAG_COMMIT, Vote
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def _test_config():
+    cfg = Config()
+    cfg.consensus.timeout_commit = 0.01
+    cfg.consensus.timeout_propose = 0.4
+    return cfg
+
+
+async def _grow_chain(n_blocks, n_vals=3):
+    pvs = [new_mock_pv() for _ in range(n_vals)]
+    doc = GenesisDoc(
+        chain_id="attack-chain",
+        genesis_time=Timestamp(1700000000, 0),
+        validators=[GenesisValidator(address=b"",
+                                     pub_key=pv.get_pub_key(),
+                                     power=10) for pv in pvs])
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage, ProposalMessage, VoteMessage,
+    )
+    nodes = []
+    for pv in pvs:
+        app = KVStoreApplication()
+        conns = AppConns(app)
+        ss, bs = Store(MemDB()), BlockStore(MemDB())
+        state = make_genesis_state(doc)
+        ss.save(state)
+        ex = BlockExecutor(ss, conns.consensus, block_store=bs)
+        cs = ConsensusState(_test_config().consensus, state, ex, bs,
+                            priv_validator=pv)
+        nodes.append((cs, ss, bs))
+    gossip = (ProposalMessage, BlockPartMessage, VoteMessage)
+    for i, (cs, _, _) in enumerate(nodes):
+        def mk(sender):
+            def hook(msg):
+                if isinstance(msg, gossip):
+                    for j, (other, _, _) in enumerate(nodes):
+                        if j != sender:
+                            other.send_peer(msg, f"n{sender}")
+            return hook
+        cs.broadcast_hooks.append(mk(i))
+    for cs, _, _ in nodes:
+        await cs.start()
+    while nodes[0][2].height < n_blocks:
+        await asyncio.sleep(0.01)
+    for cs, _, _ in nodes:
+        await cs.stop()
+    return doc, pvs, nodes[0][1], nodes[0][2]
+
+
+def _forge_lunatic_block(doc, pvs, ss, bs, height) -> LightBlock:
+    """A lunatic header at `height`: real header with a forged app hash,
+    re-committed by ALL validators (they are all byzantine)."""
+    meta = bs.load_block_meta(height)
+    header = dataclasses.replace(meta.header, app_hash=b"\xEE" * 32)
+    header = dataclasses.replace(header, _hash=None) \
+        if hasattr(header, "_hash") else header
+    try:
+        header.__dict__.pop("_hash", None)
+    except Exception:
+        pass
+    vals = ss.load_validators(height)
+    forged_id = BlockID(hash=header.hash(),
+                        part_set_header=PartSetHeader(1, b"\xAB" * 32))
+    sigs = []
+    by_addr = {pv.get_pub_key().address(): pv for pv in pvs}
+    for i, val in enumerate(vals.validators):
+        ts = Timestamp(1700000100 + i, 0)
+        v = Vote(type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+                 block_id=forged_id, timestamp=ts,
+                 validator_address=val.address, validator_index=i)
+        pv = by_addr[val.address]
+        v.signature = pv.priv_key.sign(v.sign_bytes(doc.chain_id))
+        sigs.append(CommitSig(block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                              validator_address=val.address,
+                              timestamp=ts, signature=v.signature))
+    commit = Commit(height=height, round=0, block_id=forged_id,
+                    signatures=sigs)
+    return LightBlock(signed_header=SignedHeader(header=header,
+                                                commit=commit),
+                      validator_set=vals)
+
+
+class _ForgingWitness(NodeProvider):
+    """Honest below `forge_height`, lunatic at and above it."""
+
+    def __init__(self, block_store, state_store, chain_id, doc, pvs,
+                 forge_height):
+        super().__init__(block_store, state_store, chain_id)
+        self.doc = doc
+        self.pvs = pvs
+        self.forge_height = forge_height
+
+    async def light_block(self, height):
+        if height == 0:
+            height = self.block_store.height
+        if height >= self.forge_height:
+            return _forge_lunatic_block(self.doc, self.pvs,
+                                        self.state_store,
+                                        self.block_store, height)
+        return await super().light_block(height)
+
+
+class TestLightClientAttack:
+    def test_forged_witness_evidence_accepted_by_peer_pool(self):
+        async def run():
+            doc, pvs, ss, bs = await _grow_chain(8)
+            forge_h = 6
+            primary = NodeProvider(bs, ss, doc.chain_id)
+            witness = _ForgingWitness(bs, ss, doc.chain_id, doc, pvs,
+                                      forge_h)
+            root = await primary.light_block(1)
+            client = Client(
+                doc.chain_id,
+                TrustOptions(period_ns=10 * 365 * 24 * 3600 * 10**9,
+                             height=1,
+                             header_hash=root.signed_header.header
+                             .hash()),
+                primary, [witness], TrustedStore(MemDB()))
+            await client.initialize()
+
+            with pytest.raises(DivergenceError):
+                await client.verify_light_block_at_height(forge_h)
+
+            # both sides got the evidence (reference sends to primary
+            # AND witness)
+            assert primary.evidence and witness.evidence
+            ev = primary.evidence[0]
+            assert isinstance(ev, LightClientAttackEvidence)
+            # lunatic attack: every signer of the forged commit is
+            # attributed
+            assert len(ev.byzantine_validators) == 3
+            assert ev.conflicting_block.height == forge_h
+            assert ev.common_height < forge_h
+
+            # a PEER full node verifies the evidence against ITS chain
+            # and admits it to the pool — i.e. it would commit it
+            pool = EvidencePool(MemDB(), ss, bs)
+            pool.add_evidence(ev)
+            pending, _ = pool.pending_evidence(1 << 20)
+            assert any(p.hash() == ev.hash() for p in pending)
+            # the block-validation path a peer runs on a proposed block
+            # carrying this evidence passes too
+            pool.check_evidence([ev])
+        asyncio.run(run())
+
+    def test_tampered_attribution_rejected(self):
+        """Evidence whose byzantine set doesn't match what the peer
+        derives itself is rejected (validateABCIEvidence)."""
+        async def run():
+            doc, pvs, ss, bs = await _grow_chain(8)
+            forge_h = 6
+            primary = NodeProvider(bs, ss, doc.chain_id)
+            witness = _ForgingWitness(bs, ss, doc.chain_id, doc, pvs,
+                                      forge_h)
+            root = await primary.light_block(1)
+            client = Client(
+                doc.chain_id,
+                TrustOptions(period_ns=10 * 365 * 24 * 3600 * 10**9,
+                             height=1,
+                             header_hash=root.signed_header.header
+                             .hash()),
+                primary, [witness], TrustedStore(MemDB()))
+            await client.initialize()
+            with pytest.raises(DivergenceError):
+                await client.verify_light_block_at_height(forge_h)
+            ev = primary.evidence[0]
+            ev.byzantine_validators = ev.byzantine_validators[:1]
+            pool = EvidencePool(MemDB(), ss, bs)
+            with pytest.raises(EvidenceError,
+                               match="byzantine"):
+                pool.add_evidence(ev)
+        asyncio.run(run())

@@ -88,3 +88,158 @@ class TestRateLimiter:
         assert not lim.try_take(800)       # bucket nearly empty
         time.sleep(0.3)
         assert lim.try_take(200)           # ~300 tokens refilled
+
+
+class TestNodeMetricsEndpoint:
+    def test_metrics_served_from_live_node(self):
+        """GET /metrics on a running node exposes consensus/mempool/p2p
+        series (reference: node/node.go prometheusSrv)."""
+        import os
+        import tempfile
+
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                cfg = Config()
+                cfg.base.home = home
+                cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                cfg.rpc.laddr = "tcp://127.0.0.1:0"
+                cfg.consensus.timeout_commit = 0.05
+                os.makedirs(os.path.join(home, "config"), exist_ok=True)
+                os.makedirs(os.path.join(home, "data"), exist_ok=True)
+                pv = FilePV.generate(
+                    cfg.base.path(cfg.base.priv_validator_key_file),
+                    cfg.base.path(cfg.base.priv_validator_state_file))
+                NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+                GenesisDoc(
+                    chain_id="metrics-chain",
+                    genesis_time=Timestamp.now(),
+                    validators=[GenesisValidator(
+                        address=b"", pub_key=pv.get_pub_key(),
+                        power=10)],
+                ).save_as(cfg.base.path(cfg.base.genesis_file))
+                node = Node(cfg)
+                await node.start()
+                try:
+                    for _ in range(300):
+                        if node.height >= 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    await asyncio.sleep(0.1)   # let the watcher observe
+                    host, port = node._rpc_server.listen_addr.rsplit(
+                        ":", 1)
+                    reader, writer = await asyncio.open_connection(
+                        host, int(port))
+                    writer.write(b"GET /metrics HTTP/1.1\r\n"
+                                 b"Host: x\r\nConnection: close\r\n\r\n")
+                    await writer.drain()
+                    raw = await reader.read(-1)
+                    writer.close()
+                    body = raw.split(b"\r\n\r\n", 1)[1].decode()
+                    assert "cometbft_consensus_height" in body
+                    h = [ln for ln in body.splitlines()
+                         if ln.startswith("cometbft_consensus_height ")]
+                    assert h and float(h[0].split()[-1]) >= 3
+                    assert "cometbft_consensus_block_interval_seconds_count" \
+                        in body
+                    assert "cometbft_mempool_size" in body
+                finally:
+                    await node.stop()
+        asyncio.run(run())
+
+
+class TestPrunerAndWALRotation:
+    def test_pruner_prunes_to_min_retain(self):
+        """Reference state/pruner.go: app + companion knobs, min wins,
+        monotonicity enforced."""
+        import tempfile
+
+        from cometbft_tpu.db.db import MemDB
+        from cometbft_tpu.state.pruner import Pruner
+
+        class FakeBlockStore:
+            def __init__(self):
+                self.base = 1
+                self.height = 100
+            def prune_blocks(self, retain):
+                pruned = retain - self.base
+                self.base = retain
+                return pruned, retain
+
+        class FakeStateStore:
+            def __init__(self):
+                self.calls = []
+            def prune_states(self, frm, to, ev):
+                self.calls.append((frm, to, ev))
+                return to - frm
+
+        bs, ss = FakeBlockStore(), FakeStateStore()
+        pr = Pruner(ss, bs, MemDB(), companion_enabled=True)
+        pr.set_application_retain_height(50)
+        # companion not set yet: nothing prunes
+        assert pr.effective_retain_height() == 0
+        assert pr.prune_once() == (0, 1)
+        pr.set_companion_retain_height(30)
+        assert pr.effective_retain_height() == 30
+        pruned, base = pr.prune_once()
+        assert (pruned, base) == (29, 30)
+        # companion can't move backwards
+        import pytest as _pytest
+        with _pytest.raises(ValueError):
+            pr.set_companion_retain_height(10)
+        # app knob silently keeps its max
+        pr.set_application_retain_height(20)
+        assert pr.get_application_retain_height() == 50
+
+    def test_wal_rotation_and_group_replay(self):
+        import os
+        import tempfile
+
+        from cometbft_tpu.consensus.wal import WAL
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "wal")
+            w = WAL(path, head_size_limit=2048)
+            for h in range(1, 30):
+                for i in range(20):
+                    w.write({"type": "vote", "height": h, "i": i,
+                             "pad": "x" * 64})
+                w.write_end_height(h)
+            w.close()
+            files = WAL.group_files(path)
+            assert len(files) > 2, "no rotation happened"
+            msgs = list(WAL.iter_group(path))
+            ends = [m["height"] for m in msgs
+                    if m.get("type") == "end_height"]
+            assert ends == list(range(1, 30))
+            # tail after a mid-group end-height spans files
+            tail = WAL.search_for_end_height(path, 15)
+            assert tail is not None
+            assert tail[0]["height"] == 16
+            assert WAL.search_for_end_height(path, 99) is None
+
+    def test_wal_total_size_cap_drops_oldest(self):
+        import os
+        import tempfile
+
+        from cometbft_tpu.consensus.wal import WAL
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "wal")
+            w = WAL(path, head_size_limit=1024,
+                    total_size_limit=4096)
+            for i in range(500):
+                w.write({"type": "vote", "i": i, "pad": "y" * 64})
+            w.close()
+            rotated = WAL.group_files(path)[:-1]
+            total = sum(os.path.getsize(f) for f in rotated)
+            assert total <= 4096 + 1024
+            # oldest file index is no longer 0
+            assert int(rotated[0].rsplit(".", 1)[1]) > 0

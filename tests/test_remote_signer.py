@@ -1,0 +1,189 @@
+"""Remote signer: socket privval protocol, retry wrapper, double-sign
+protection across the wire and across signer restarts.
+
+Reference: privval/signer_listener_endpoint.go, signer_server.go,
+signer_client.go, retry_signer_client.go.
+"""
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+
+import pytest
+
+from cometbft_tpu.privval import FilePV
+from cometbft_tpu.privval.file import DoubleSignError
+from cometbft_tpu.privval.signer import (
+    RetrySignerClient, SignerClient, SignerListenerEndpoint, SignerServer,
+)
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.vote import Vote
+
+
+def _vote(height, round_=0, hash_=b"\x11" * 32):
+    return Vote(type=canonical.PRECOMMIT_TYPE, height=height,
+                round=round_,
+                block_id=BlockID(hash=hash_,
+                                 part_set_header=PartSetHeader(
+                                     1, b"\x22" * 32)),
+                timestamp=Timestamp(1700000000, 0),
+                validator_address=b"\x01" * 20, validator_index=0)
+
+
+class TestSignerProtocol:
+    def test_ping_pubkey_sign_and_double_sign_refusal(self):
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                pv = FilePV.generate(os.path.join(d, "k.json"),
+                                     os.path.join(d, "s.json"))
+                ep = SignerListenerEndpoint("tcp://127.0.0.1:0")
+                await ep.start()
+                srv = SignerServer(ep.listen_addr, "sig-chain", pv)
+                await srv.start()
+                await ep.wait_for_signer(10)
+                cli = SignerClient(ep, "sig-chain")
+                await cli.ping()
+                pub = await cli.fetch_pub_key()
+                assert pub == pv.get_pub_key()
+
+                v = _vote(5)
+                await cli.sign_vote_async("sig-chain", v, False)
+                assert pub.verify_signature(
+                    v.sign_bytes("sig-chain"), v.signature)
+
+                # conflicting block at the same HRS: the SIGNER refuses
+                v2 = _vote(5, hash_=b"\x99" * 32)
+                with pytest.raises(DoubleSignError):
+                    await cli.sign_vote_async("sig-chain", v2, False)
+
+                # height regression also refused
+                v3 = _vote(4)
+                with pytest.raises(DoubleSignError):
+                    await cli.sign_vote_async("sig-chain", v3, False)
+
+                await srv.stop()
+                await ep.stop()
+        asyncio.run(run())
+
+    def test_retry_wrapper_never_retries_double_sign(self):
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                pv = FilePV.generate(os.path.join(d, "k.json"),
+                                     os.path.join(d, "s.json"))
+                ep = SignerListenerEndpoint("tcp://127.0.0.1:0")
+                await ep.start()
+                srv = SignerServer(ep.listen_addr, "c", pv)
+                await srv.start()
+                await ep.wait_for_signer(10)
+                cli = RetrySignerClient(SignerClient(ep, "c"))
+                await cli.fetch_pub_key()
+                v = _vote(7)
+                await cli.sign_vote_async("c", v, False)
+                before = pv.last_sign_state.height
+                with pytest.raises(DoubleSignError):
+                    await cli.sign_vote_async(
+                        "c", _vote(7, hash_=b"\x77" * 32), False)
+                assert pv.last_sign_state.height == before
+                await srv.stop()
+                await ep.stop()
+        asyncio.run(run())
+
+    def test_hrs_protection_survives_signer_restart(self):
+        """Sign at height 9, 'restart' the signer (fresh FilePV loaded
+        from disk), then a request for height 8 must be refused — the
+        HRS state machine is durable in the signer."""
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                kf, sf = os.path.join(d, "k.json"), os.path.join(
+                    d, "s.json")
+                pv = FilePV.generate(kf, sf)
+                ep = SignerListenerEndpoint("tcp://127.0.0.1:0")
+                await ep.start()
+                srv = SignerServer(ep.listen_addr, "c", pv)
+                await srv.start()
+                await ep.wait_for_signer(10)
+                cli = SignerClient(ep, "c")
+                await cli.fetch_pub_key()
+                await cli.sign_vote_async("c", _vote(9), False)
+                await srv.stop()
+                ep._drop_conn()
+
+                pv2 = FilePV.load(kf, sf)          # restart
+                srv2 = SignerServer(ep.listen_addr, "c", pv2)
+                await srv2.start()
+                await ep.wait_for_signer(10)
+                with pytest.raises(DoubleSignError):
+                    await cli.sign_vote_async("c", _vote(8), False)
+                # same height, same block: signature is REUSED, not
+                # re-signed (reference same-HRS rule)
+                v = _vote(9)
+                await cli.sign_vote_async("c", v, False)
+                assert v.signature
+                await srv2.stop()
+                await ep.stop()
+        asyncio.run(run())
+
+
+class TestNodeWithRemoteSigner:
+    def test_node_signs_via_external_signer_process(self):
+        """A validator node produces blocks with its key held by a
+        SEPARATE signer process over the privval socket protocol."""
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                signer_dir = os.path.join(d, "signer")
+                os.makedirs(signer_dir)
+                kf = os.path.join(signer_dir, "k.json")
+                sf = os.path.join(signer_dir, "s.json")
+                pv = FilePV.generate(kf, sf)
+
+                cfg = Config()
+                cfg.base.home = home
+                cfg.base.priv_validator_laddr = "tcp://127.0.0.1:26679"
+                cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                cfg.rpc.laddr = ""
+                cfg.consensus.timeout_commit = 0.05
+                os.makedirs(os.path.join(home, "config"), exist_ok=True)
+                os.makedirs(os.path.join(home, "data"), exist_ok=True)
+                NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+                GenesisDoc(
+                    chain_id="remote-chain",
+                    genesis_time=Timestamp.now(),
+                    validators=[GenesisValidator(
+                        address=b"", pub_key=pv.get_pub_key(),
+                        power=10)],
+                ).save_as(cfg.base.path(cfg.base.genesis_file))
+
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "cometbft_tpu.privval.signer",
+                     "--address", "tcp://127.0.0.1:26679",
+                     "--chain-id", "remote-chain",
+                     "--key-file", kf, "--state-file", sf],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    env={**os.environ, "JAX_PLATFORMS": ""})
+                try:
+                    node = Node(cfg)
+                    await node.start()
+                    for _ in range(400):
+                        if node.height >= 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert node.height >= 3, \
+                        "no blocks signed via remote signer"
+                    assert node.priv_validator.get_pub_key() == \
+                        pv.get_pub_key()
+                    await node.stop()
+                finally:
+                    proc.terminate()
+                    proc.wait(timeout=5)
+        asyncio.run(run())
