@@ -125,6 +125,28 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_light(args) -> int:
+    """Reference: cmd/cometbft/commands/light.go — stand-alone verifying
+    proxy daemon."""
+    import asyncio
+
+    from ..light.proxy import LightProxy
+
+    async def run():
+        proxy = LightProxy(
+            args.chain_id, args.primary, list(args.witness),
+            args.trusted_height, bytes.fromhex(args.trusted_hash),
+            args.laddr)
+        await proxy.start()
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_testnet(args) -> int:
     """Generate configs/genesis for an N-validator local testnet
     (reference: commands/testnet.go)."""
@@ -248,6 +270,19 @@ def main(argv=None) -> int:
                     help="validator key type: ed25519|secp256k1|bls12_381 "
                          "(reference: testnet.go --key-type)")
     sp.set_defaults(fn=cmd_testnet)
+
+    sp = sub.add_parser(
+        "light", help="run a light-client verifying RPC proxy")
+    sp.add_argument("chain_id")
+    sp.add_argument("--primary", required=True,
+                    help="primary full node RPC address")
+    sp.add_argument("--witness", action="append", default=[],
+                    help="witness RPC address (repeatable)")
+    sp.add_argument("--trusted-height", type=int, required=True)
+    sp.add_argument("--trusted-hash", required=True,
+                    help="hex header hash at the trusted height")
+    sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
+    sp.set_defaults(fn=cmd_light)
 
     sp = sub.add_parser("rollback", help="roll back one height")
     sp.add_argument("--hard", action="store_true",

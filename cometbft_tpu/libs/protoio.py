@@ -9,17 +9,9 @@ from __future__ import annotations
 import asyncio
 from typing import Optional, Type
 
+from ..wire.proto import encode_uvarint  # single canonical encoder
 
-def encode_uvarint(u: int) -> bytes:
-    out = bytearray()
-    while True:
-        b = u & 0x7F
-        u >>= 7
-        if u:
-            out.append(b | 0x80)
-        else:
-            out.append(b)
-            return bytes(out)
+__all__ = ["encode_uvarint", "read_delimited", "write_delimited"]
 
 
 async def read_delimited(reader: asyncio.StreamReader, max_size: int,

@@ -346,38 +346,54 @@ class Node:
         """Event-driven metric updates (reference: recordMetrics in
         internal/consensus/state.go + per-subsystem metrics.go)."""
         import time as _time
-        sub = self.event_bus.subscribe("node-metrics",
-                                       "tm.event = 'NewBlock'")
-        try:
-            while True:
-                msg = await sub.next()
-                now = _time.monotonic()
-                payload = msg.data.payload
-                block = payload.get("block")
-                if block is None:
-                    continue
-                self._m_height.set(block.header.height)
-                self._m_txs.add(len(block.data.txs))
-                if self._last_block_time_s:
-                    self._m_block_interval.observe(
-                        now - self._last_block_time_s)
-                self._last_block_time_s = now
-                state = self.state_store.load()
-                if state is not None:
-                    self._m_validators.set(state.validators.size())
-                if self.mempool is not None:
-                    self._m_mempool_size.set(self.mempool.size())
-                self._m_peers.set(self.switch.num_peers())
-                sent = recv = 0
-                for peer in self.switch.peers.values():
-                    sent += peer.mconn.send_limiter.total
-                    recv += peer.mconn.recv_limiter.total
-                self._m_p2p_sent.set(sent)
-                self._m_p2p_recv.set(recv)
-        except asyncio.CancelledError:
-            raise
-        except Exception:
-            self.logger.error("metrics watcher died", exc_info=True)
+        from ..libs.pubsub import PubSubError
+        while True:
+            try:
+                self.event_bus.unsubscribe_all("node-metrics")
+            except Exception:
+                pass
+            sub = self.event_bus.subscribe("node-metrics",
+                                           "tm.event = 'NewBlock'")
+            try:
+                await self._metrics_pump(sub)
+            except asyncio.CancelledError:
+                raise
+            except PubSubError:
+                # subscription overflowed (e.g. during fast sync):
+                # resubscribe instead of dying with frozen gauges
+                await asyncio.sleep(0.5)
+            except Exception:
+                self.logger.error("metrics watcher error",
+                                  exc_info=True)
+                await asyncio.sleep(5)
+
+    async def _metrics_pump(self, sub) -> None:
+        import time as _time
+        while True:
+            msg = await sub.next()
+            now = _time.monotonic()
+            payload = msg.data.payload
+            block = payload.get("block")
+            if block is None:
+                continue
+            self._m_height.set(block.header.height)
+            self._m_txs.add(len(block.data.txs))
+            if self._last_block_time_s:
+                self._m_block_interval.observe(
+                    now - self._last_block_time_s)
+            self._last_block_time_s = now
+            state = self.state_store.load()
+            if state is not None:
+                self._m_validators.set(state.validators.size())
+            if self.mempool is not None:
+                self._m_mempool_size.set(self.mempool.size())
+            self._m_peers.set(self.switch.num_peers())
+            sent = recv = 0
+            for peer in self.switch.peers.values():
+                sent += peer.mconn.send_limiter.total
+                recv += peer.mconn.recv_limiter.total
+            self._m_p2p_sent.set(sent)
+            self._m_p2p_recv.set(recv)
 
     # ------------------------------------------------------------------
     @property

@@ -1,0 +1,62 @@
+"""Fuzzed connection wrapper: byzantine-ish link-layer fault injection.
+
+Reference: p2p/internal/fuzz/fuzz.go:131 — a conn wrapper that randomly
+drops, delays, or corrupts frames, used to harden the p2p stack against
+misbehaving links.  Wraps the SecretConnection frame interface
+(read_msg/write_msg) so it slots under MConnection transparently.
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+
+
+@dataclass
+class FuzzConfig:
+    """Probabilities are per-frame and independent."""
+    prob_drop_write: float = 0.0      # silently discard an outgoing frame
+    prob_delay: float = 0.0           # sleep before delivering
+    max_delay_s: float = 0.05
+    prob_corrupt_read: float = 0.0    # flip a byte in an incoming frame
+    seed: int = 0
+
+
+class FuzzedConnection:
+    """Wraps any object with async read_msg()/write_msg(b)/close()."""
+
+    def __init__(self, conn, config: FuzzConfig):
+        self._conn = conn
+        self.config = config
+        self._rng = random.Random(config.seed or None)
+        self.dropped = 0
+        self.delayed = 0
+        self.corrupted = 0
+
+    async def write_msg(self, data: bytes) -> None:
+        cfg = self.config
+        if self._rng.random() < cfg.prob_drop_write:
+            self.dropped += 1
+            return
+        if self._rng.random() < cfg.prob_delay:
+            self.delayed += 1
+            await asyncio.sleep(self._rng.random() * cfg.max_delay_s)
+        await self._conn.write_msg(data)
+
+    async def read_msg(self) -> bytes:
+        data = await self._conn.read_msg()
+        cfg = self.config
+        if data and self._rng.random() < cfg.prob_corrupt_read:
+            self.corrupted += 1
+            i = self._rng.randrange(len(data))
+            data = data[:i] + bytes([data[i] ^ 0xFF]) + data[i + 1:]
+        if self._rng.random() < cfg.prob_delay:
+            self.delayed += 1
+            await asyncio.sleep(self._rng.random() * cfg.max_delay_s)
+        return data
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)

@@ -44,56 +44,132 @@ class KnownAddress:
     port: int
     last_seen: float = field(default_factory=time.time)
     attempts: int = 0
+    is_old: bool = False        # promoted after a successful connection
+    bucket: int = 0
 
     @property
     def dial_addr(self) -> str:
         return f"{self.ip}:{self.port}"
 
 
-class AddrBook:
-    """Reference: p2p/pex/addrbook.go — persistence + random pick."""
+# bucket geometry (reference: p2p/pex/params.go — 256 new buckets, 64
+# old buckets, 64 addresses each)
+_NEW_BUCKETS = 256
+_OLD_BUCKETS = 64
+_BUCKET_CAP = 64
+_MAX_ATTEMPTS_NEW = 16      # failed-dial cap before a NEW address is dropped
 
-    def __init__(self, path: str = "", strict: bool = True):
+
+class AddrBook:
+    """Bucketed address book (reference: p2p/pex/addrbook.go:921).
+
+    Addresses start in one of 256 NEW buckets (indexed by a keyed hash of
+    the node id, so an attacker cannot target a victim's buckets without
+    the local key); a successful connection promotes to one of 64 OLD
+    buckets.  Full buckets evict: NEW buckets drop their worst entry
+    (most failed attempts, then oldest), OLD buckets demote their oldest
+    entry back to NEW.  Repeated dial failures remove NEW addresses."""
+
+    def __init__(self, path: str = "", strict: bool = True,
+                 key: str = ""):
+        import secrets as _secrets
         self.path = path
         self.strict = strict
+        self.key = key or _secrets.token_hex(12)
         self._addrs: dict[str, KnownAddress] = {}
         if path and os.path.exists(path):
             self._load()
 
+    # -- bucket mechanics --------------------------------------------------
+    def _bucket_index(self, node_id: str, old: bool) -> int:
+        import hashlib as _hashlib
+        h = _hashlib.sha256(
+            (self.key + ("o" if old else "n") + node_id).encode()
+        ).digest()
+        n = _OLD_BUCKETS if old else _NEW_BUCKETS
+        return int.from_bytes(h[:4], "big") % n
+
+    def _bucket_members(self, old: bool, idx: int) -> list[KnownAddress]:
+        return [a for a in self._addrs.values()
+                if a.is_old == old and a.bucket == idx]
+
+    def _worst_of(self, members: list[KnownAddress]) -> KnownAddress:
+        return max(members, key=lambda a: (a.attempts, -a.last_seen))
+
+    # -- public surface ----------------------------------------------------
     def add_address(self, node_id: str, ip: str, port: int) -> bool:
         if not node_id or port <= 0:
             return False
         if self.strict and not _routable(ip):
             return False
         ka = self._addrs.get(node_id)
-        if ka is None:
-            self._addrs[node_id] = KnownAddress(node_id, ip, port)
-            return True
-        ka.ip, ka.port = ip, port
-        ka.last_seen = time.time()
-        return False
+        if ka is not None:
+            ka.ip, ka.port = ip, port
+            ka.last_seen = time.time()
+            return False
+        idx = self._bucket_index(node_id, old=False)
+        members = self._bucket_members(False, idx)
+        if len(members) >= _BUCKET_CAP:
+            # evict the worst NEW entry of this bucket (reference:
+            # addrbook.go addToNewBucket -> expireNew)
+            self._addrs.pop(self._worst_of(members).node_id, None)
+        self._addrs[node_id] = KnownAddress(node_id, ip, port,
+                                            bucket=idx)
+        return True
 
     def mark_good(self, node_id: str) -> None:
+        """Successful connection: promote NEW -> OLD (reference:
+        MarkGood -> moveToOld)."""
         ka = self._addrs.get(node_id)
-        if ka is not None:
-            ka.attempts = 0
-            ka.last_seen = time.time()
+        if ka is None:
+            return
+        ka.attempts = 0
+        ka.last_seen = time.time()
+        if ka.is_old:
+            return
+        idx = self._bucket_index(node_id, old=True)
+        members = self._bucket_members(True, idx)
+        if len(members) >= _BUCKET_CAP:
+            # demote the oldest OLD entry back to a NEW bucket
+            demoted = min(members, key=lambda a: a.last_seen)
+            demoted.is_old = False
+            demoted.bucket = self._bucket_index(demoted.node_id,
+                                                old=False)
+        ka.is_old = True
+        ka.bucket = idx
 
     def mark_attempt(self, node_id: str) -> None:
         ka = self._addrs.get(node_id)
-        if ka is not None:
-            ka.attempts += 1
+        if ka is None:
+            return
+        ka.attempts += 1
+        if not ka.is_old and ka.attempts > _MAX_ATTEMPTS_NEW:
+            # unreachable NEW addresses age out (reference: removeBad)
+            self._addrs.pop(node_id, None)
 
     def remove(self, node_id: str) -> None:
         self._addrs.pop(node_id, None)
 
     def pick_addresses(self, n: int,
-                       exclude: Optional[set] = None
-                       ) -> list[KnownAddress]:
-        pool = [a for a in self._addrs.values()
-                if not exclude or a.node_id not in exclude]
-        random.shuffle(pool)
-        return pool[:n]
+                       exclude: Optional[set] = None,
+                       old_bias_pct: int = 30) -> list[KnownAddress]:
+        """Random selection biased between OLD (proven) and NEW
+        addresses (reference: addrbook.go GetSelectionWithBias)."""
+        pool_old = [a for a in self._addrs.values()
+                    if a.is_old and (not exclude or
+                                     a.node_id not in exclude)]
+        pool_new = [a for a in self._addrs.values()
+                    if not a.is_old and (not exclude or
+                                         a.node_id not in exclude)]
+        random.shuffle(pool_old)
+        random.shuffle(pool_new)
+        n_old = min(len(pool_old), max(0, n * old_bias_pct // 100))
+        out = pool_old[:n_old] + pool_new[:n - n_old]
+        if len(out) < n:        # top up from whichever side has more
+            leftovers = pool_old[n_old:] + pool_new[n - n_old:]
+            out.extend(leftovers[:n - len(out)])
+        random.shuffle(out)
+        return out[:n]
 
     def size(self) -> int:
         return len(self._addrs)
@@ -103,17 +179,28 @@ class AddrBook:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
         with open(self.path, "w") as f:
-            json.dump([{"id": a.node_id, "ip": a.ip, "port": a.port,
-                        "last_seen": a.last_seen}
-                       for a in self._addrs.values()], f, indent=2)
+            json.dump({"key": self.key, "addrs": [
+                {"id": a.node_id, "ip": a.ip, "port": a.port,
+                 "last_seen": a.last_seen, "attempts": a.attempts,
+                 "is_old": a.is_old, "bucket": a.bucket}
+                for a in self._addrs.values()]}, f, indent=2)
 
     def _load(self) -> None:
         try:
             with open(self.path) as f:
-                for d in json.load(f):
-                    self._addrs[d["id"]] = KnownAddress(
-                        d["id"], d["ip"], int(d["port"]),
-                        d.get("last_seen", 0.0))
+                raw = json.load(f)
+            if isinstance(raw, dict):
+                self.key = raw.get("key", self.key)
+                entries = raw.get("addrs", [])
+            else:                      # legacy flat format
+                entries = raw
+            for d in entries:
+                self._addrs[d["id"]] = KnownAddress(
+                    d["id"], d["ip"], int(d["port"]),
+                    d.get("last_seen", 0.0),
+                    attempts=d.get("attempts", 0),
+                    is_old=d.get("is_old", False),
+                    bucket=d.get("bucket", 0))
         except (json.JSONDecodeError, KeyError, OSError):
             pass
 

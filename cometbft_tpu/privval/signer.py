@@ -14,7 +14,9 @@ Reference: privval/ —
     equivocate.
 
 Runnable signer:  python -m cometbft_tpu.privval.signer \
-    --address tcp://127.0.0.1:26659 --home <dir with priv_validator_*>
+    --address tcp://127.0.0.1:26659 --chain-id my-chain \
+    --key-file priv_validator_key.json \
+    --state-file priv_validator_state.json
 """
 from __future__ import annotations
 
@@ -28,7 +30,6 @@ from ..types.priv_validator import PrivValidator
 from ..types.proposal import Proposal
 from ..types.vote import Vote
 from ..wire import decode, encode, privval_pb
-from ..wire.proto import encode_uvarint
 from .file import DoubleSignError, FilePV, PrivValidatorError
 
 
@@ -37,8 +38,8 @@ class RemoteSignerError(PrivValidatorError):
 
 
 def _frame(msg: dict) -> bytes:
-    payload = encode(privval_pb.MESSAGE, msg)
-    return encode_uvarint(len(payload)) + payload
+    from ..libs.protoio import write_delimited
+    return write_delimited(encode(privval_pb.MESSAGE, msg))
 
 
 async def _read_frame(reader: asyncio.StreamReader) -> Optional[dict]:

@@ -291,6 +291,9 @@ class WSClient:
                 if not fut.done():
                     fut.set_exception(err)
             self._pending.clear()
+            for queue in self._subs.values():
+                queue.put_nowait(_WS_CLOSED)
+            self._subs.clear()
 
     async def _send_raw(self, data: bytes) -> None:
         self._writer.write(data)
@@ -327,6 +330,9 @@ class WSClient:
         await self.call("unsubscribe", query=query)
 
 
+_WS_CLOSED = object()
+
+
 class WsSubscription:
     def __init__(self, client: WSClient, rpc_id, query: str,
                  queue: asyncio.Queue):
@@ -337,11 +343,18 @@ class WsSubscription:
 
     async def next(self, timeout: Optional[float] = None) -> dict:
         if timeout is None:
-            return await self._queue.get()
-        return await asyncio.wait_for(self._queue.get(), timeout)
+            item = await self._queue.get()
+        else:
+            item = await asyncio.wait_for(self._queue.get(), timeout)
+        if item is _WS_CLOSED:
+            raise RPCClientError("websocket connection closed")
+        return item
 
     def __aiter__(self) -> AsyncIterator[dict]:
         return self
 
     async def __anext__(self) -> dict:
-        return await self._queue.get()
+        item = await self._queue.get()
+        if item is _WS_CLOSED:
+            raise StopAsyncIteration
+        return item

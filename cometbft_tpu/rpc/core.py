@@ -76,6 +76,13 @@ def routes(env: Environment) -> dict:
         order_by="asc": _block_search(env, query, page, per_page),
         "broadcast_evidence": lambda evidence="":
             _broadcast_evidence(env, evidence),
+        # data-companion pruning service (reference: rpc/grpc/server/
+        # services/pruningservice — served here over JSON-RPC, the
+        # engine's single RPC surface)
+        "pruning_set_block_retain_height": lambda height="0":
+            _pruning_set_retain(env, height),
+        "pruning_get_block_retain_height": lambda:
+            _pruning_get_retain(env),
     }
 
 
@@ -217,6 +224,33 @@ async def _broadcast_tx_commit(env, tx):
                 f"rpc-tx-{key.hex()[:16]}")
         except Exception:
             pass
+
+
+async def _pruning_set_retain(env, height):
+    pruner = getattr(env.node, "pruner", None)
+    if pruner is None:
+        from .server import RPCError
+        raise RPCError(-32603, "pruner unavailable")
+    pruner.companion_enabled = True
+    try:
+        pruner.set_companion_retain_height(int(height))
+    except ValueError as e:
+        from .server import RPCError
+        raise RPCError(-32602, str(e))
+    return {}
+
+
+async def _pruning_get_retain(env):
+    pruner = getattr(env.node, "pruner", None)
+    if pruner is None:
+        from .server import RPCError
+        raise RPCError(-32603, "pruner unavailable")
+    return {
+        "app_retain_height": str(
+            pruner.get_application_retain_height()),
+        "pruning_service_retain_height": str(
+            pruner.get_companion_retain_height()),
+    }
 
 
 async def _broadcast_evidence(env, evidence):

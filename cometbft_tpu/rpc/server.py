@@ -28,12 +28,15 @@ class RPCError(Exception):
 
 
 class RPCServer:
-    def __init__(self, node, config: RPCConfig):
+    def __init__(self, node, config: RPCConfig, routes=None):
+        """`routes` overrides the method table (used by the light
+        verifying proxy, which has no local node)."""
         self.node = node
         self.config = config
         self.logger = new_logger("rpc")
-        self.env = core.Environment(node)
-        self.routes = core.routes(self.env)
+        self.env = core.Environment(node) if node is not None else None
+        self.routes = routes if routes is not None \
+            else core.routes(self.env)
         self._server: Optional[asyncio.base_events.Server] = None
         self.listen_addr = ""
         self._ws_counter = 0

@@ -46,8 +46,9 @@ class Pruner:
         (reference: SetApplicationBlockRetainHeight)."""
         if height <= 0:
             return
-        if height < self._get(_APP_RETAIN_KEY):
-            return                          # never moves backwards
+        if height <= self._get(_APP_RETAIN_KEY):
+            return      # unchanged or backwards: skip the sync write —
+                        # this runs on the per-block commit path
         self._set(_APP_RETAIN_KEY, height)
         self._wake.set()
 

@@ -188,3 +188,46 @@ class TestRpcStateProvider:
                 finally:
                     await node.stop()
         asyncio.run(run())
+
+
+class TestLightProxy:
+    def test_verifying_proxy_serves_checked_rpc(self):
+        """`cometbft light` equivalent: a proxy serves commit/validators
+        /block RPC only after light verification (reference:
+        light/rpc/client.go + light/proxy)."""
+        from cometbft_tpu.light.proxy import LightProxy
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                node = await _start_node(d)
+                proxy = None
+                try:
+                    addr = f"http://{node._rpc_server.listen_addr}"
+                    provider = HttpProvider(addr, chain_id="rpc-chain")
+                    root = await provider.light_block(1)
+                    proxy = LightProxy(
+                        "rpc-chain", addr, [], 1,
+                        root.signed_header.header.hash(),
+                        "tcp://127.0.0.1:0")
+                    await proxy.start()
+                    cli = HTTPClient(
+                        f"http://{proxy.rpc_listen_addr}")
+                    # verified commit round-trips
+                    sh, _ = await cli.commit(2)
+                    assert sh.header.height == 2
+                    direct, _ = await HTTPClient(addr).commit(2)
+                    assert sh.header.hash() == direct.header.hash()
+                    # verified validators
+                    vals = await cli.validators(2)
+                    assert vals.size() == 1
+                    # block passthrough with header check
+                    res = await cli.block(2)
+                    assert int(res["block"]["header"]["height"]) == 2
+                    # broadcast passthrough works
+                    r = await cli.broadcast_tx_sync(b"via=proxy")
+                    assert r["code"] == 0
+                finally:
+                    if proxy is not None:
+                        await proxy.stop()
+                    await node.stop()
+        asyncio.run(run())

@@ -161,3 +161,74 @@ class TestPex:
                 await pex.stop()
                 await sw.stop()
         run(go())
+
+
+class TestAddrBookBuckets:
+    def test_new_bucket_eviction(self):
+        from cometbft_tpu.p2p import pex as pexmod
+        from cometbft_tpu.p2p.pex import AddrBook
+        book = AddrBook(strict=False, key="k")
+        # force tiny buckets so eviction triggers deterministically
+        old_cap = pexmod._BUCKET_CAP
+        pexmod._BUCKET_CAP = 4
+        try:
+            for i in range(2000):
+                book.add_address(f"node{i:04d}", "10.0.0.1", 26656 + i)
+            # every NEW bucket respects the cap
+            from collections import Counter
+            per_bucket = Counter(
+                a.bucket for a in book._addrs.values() if not a.is_old)
+            assert max(per_bucket.values()) <= 4
+            assert book.size() < 2000       # evictions happened
+        finally:
+            pexmod._BUCKET_CAP = old_cap
+
+    def test_mark_good_promotes_and_old_bucket_demotes(self):
+        from cometbft_tpu.p2p import pex as pexmod
+        from cometbft_tpu.p2p.pex import AddrBook
+        book = AddrBook(strict=False, key="k2")
+        old_cap = pexmod._BUCKET_CAP
+        pexmod._BUCKET_CAP = 2
+        try:
+            for i in range(200):
+                book.add_address(f"peer{i:03d}", "10.0.0.2", 1000 + i)
+                book.mark_good(f"peer{i:03d}")
+            olds = [a for a in book._addrs.values() if a.is_old]
+            news = [a for a in book._addrs.values() if not a.is_old]
+            assert olds, "promotion never happened"
+            from collections import Counter
+            per_old = Counter(a.bucket for a in olds)
+            assert max(per_old.values()) <= 2
+            assert news, "old-bucket overflow must demote back to new"
+        finally:
+            pexmod._BUCKET_CAP = old_cap
+
+    def test_failed_new_addresses_age_out(self):
+        from cometbft_tpu.p2p.pex import AddrBook, _MAX_ATTEMPTS_NEW
+        book = AddrBook(strict=False)
+        book.add_address("flaky", "10.1.1.1", 1)
+        for _ in range(_MAX_ATTEMPTS_NEW + 1):
+            book.mark_attempt("flaky")
+        assert book.size() == 0
+        # old addresses survive failures
+        book.add_address("good", "10.1.1.2", 2)
+        book.mark_good("good")
+        for _ in range(_MAX_ATTEMPTS_NEW + 5):
+            book.mark_attempt("good")
+        assert book.size() == 1
+
+    def test_pick_bias_and_persistence_roundtrip(self, tmp_path):
+        from cometbft_tpu.p2p.pex import AddrBook
+        path = str(tmp_path / "addrbook.json")
+        book = AddrBook(path=path, strict=False)
+        for i in range(30):
+            book.add_address(f"n{i:02d}", "10.2.0.1", 100 + i)
+        for i in range(10):
+            book.mark_good(f"n{i:02d}")
+        picked = book.pick_addresses(10)
+        assert len(picked) == 10
+        book.save()
+        book2 = AddrBook(path=path, strict=False)
+        assert book2.size() == 30
+        assert book2.key == book.key
+        assert sum(1 for a in book2._addrs.values() if a.is_old) == 10
