@@ -62,6 +62,9 @@ class _Session:
             _print(f"-> last_block_app_hash: "
                    f"{res.last_block_app_hash.hex().upper()}")
         elif name == "check_tx":
+            if not args:
+                _print("usage: check_tx <tx>")
+                return
             res = await c.check_tx(abci.CheckTxRequest(
                 tx=_parse_bytes(args[0]),
                 type=abci.CHECK_TX_TYPE_CHECK))

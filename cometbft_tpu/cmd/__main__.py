@@ -125,6 +125,157 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_inspect(args) -> int:
+    """Serve read-only RPC over the data stores of a stopped/crashed
+    node — no consensus, no p2p (reference: commands/inspect.go +
+    inspect/inspect.go)."""
+    import asyncio
+
+    cfg = _load_config(args.home)
+
+    class _InspectNode:
+        """The minimal node surface rpc/core needs for read paths."""
+
+        def __init__(self):
+            from ..db import new_db
+            from ..state.store import Store
+            from ..store import BlockStore
+            from ..types.events import EventBus
+            from ..types.genesis import GenesisDoc
+            db_dir = cfg.base.path(cfg.base.db_dir)
+            backend = cfg.base.db_backend
+            self.block_store = BlockStore(
+                new_db("blockstore", backend, db_dir))
+            self.state_store = Store(new_db("state", backend, db_dir))
+            self.genesis_doc = GenesisDoc.from_file(
+                cfg.base.path(cfg.base.genesis_file))
+            self.event_bus = EventBus()
+            self.mempool = None
+            self.consensus_state = None
+            self.config = cfg
+            from ..indexer import BlockIndexer, TxIndexer
+            idx_db = new_db("tx_index", backend, db_dir)
+            self.tx_indexer = TxIndexer(idx_db)
+            self.block_indexer = BlockIndexer(idx_db)
+            self.metrics_registry = None
+
+        def status(self):
+            h = self.block_store.height
+            meta = self.block_store.load_block_meta(h)
+            return {"node_info": {"moniker": "inspect"},
+                    "sync_info": {
+                        "latest_block_height": str(h),
+                        "latest_block_hash":
+                            meta.block_id.hash.hex().upper()
+                            if meta else "",
+                        "earliest_block_height":
+                            str(self.block_store.base),
+                        "catching_up": False}}
+
+    async def run():
+        from ..rpc import core as rpc_core
+        from ..rpc.server import RPCServer
+        node = _InspectNode()
+        cfg.rpc.laddr = args.rpc_laddr or cfg.rpc.laddr or \
+            "tcp://127.0.0.1:26657"
+        # restricted read-only route set (reference: inspect/rpc.go
+        # Routes) — store/index reads only, no mempool/consensus/p2p
+        env = rpc_core.Environment(node)
+        all_routes = rpc_core.routes(env)
+        routes = {name: all_routes[name] for name in (
+            "health", "status", "genesis", "block", "block_by_hash",
+            "block_results", "commit", "blockchain", "validators",
+            "consensus_params", "tx", "tx_search", "block_search",
+        ) if name in all_routes}
+        srv = RPCServer(node, cfg.rpc, routes=routes)
+        await srv.start()
+        print(f"inspect server on {srv.listen_addr} "
+              f"(height {node.block_store.height})")
+        await asyncio.Event().wait()
+
+    try:
+        asyncio.run(run())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
+def cmd_reindex_event(args) -> int:
+    """Rebuild the tx/block indexes from the block store + stored
+    FinalizeBlockResponses (reference: commands/reindex_event.go)."""
+    from ..abci import types as abci
+    from ..db import new_db
+    from ..indexer import BlockIndexer, TxIndexer
+    from ..state.store import Store
+    from ..store import BlockStore
+
+    cfg = _load_config(args.home)
+    db_dir = cfg.base.path(cfg.base.db_dir)
+    backend = cfg.base.db_backend
+    block_store = BlockStore(new_db("blockstore", backend, db_dir))
+    state_store = Store(new_db("state", backend, db_dir))
+    idx_db = new_db("tx_index", backend, db_dir)
+    txi, bi = TxIndexer(idx_db), BlockIndexer(idx_db)
+
+    start = args.start_height or block_store.base
+    end = args.end_height or block_store.height
+    n_txs = n_blocks = 0
+    for h in range(start, end + 1):
+        block = block_store.load_block(h)
+        resp = state_store.load_finalize_block_response(h)
+        if block is None or resp is None:
+            continue
+        bi.index(h, resp.events)
+        n_blocks += 1
+        for i, tx in enumerate(block.data.txs):
+            if i < len(resp.tx_results):
+                txi.index(abci.TxResult(height=h, index=i, tx=tx,
+                                        result=resp.tx_results[i]))
+                n_txs += 1
+    print(f"reindexed {n_blocks} blocks / {n_txs} txs "
+          f"(heights {start}..{end})")
+    return 0
+
+
+def cmd_debug_dump(args) -> int:
+    """Capture a diagnostic bundle from a RUNNING node over RPC
+    (reference: cmd/cometbft/commands/debug — status, net_info,
+    consensus state, config, metrics)."""
+    import asyncio
+    import json as _json
+    import os as _os
+
+    async def run():
+        from ..rpc.client import HTTPClient
+        cli = HTTPClient(args.rpc_laddr)
+        out_dir = args.output_directory
+        _os.makedirs(out_dir, exist_ok=True)
+        for method in ("status", "net_info", "consensus_state",
+                       "num_unconfirmed_txs"):
+            try:
+                res = await cli.call(method)
+            except Exception as e:  # noqa: BLE001 — best-effort bundle
+                res = {"error": str(e)}
+            with open(_os.path.join(out_dir, f"{method}.json"),
+                      "w") as f:
+                _json.dump(res, f, indent=2)
+        # metrics exposition
+        import urllib.request
+        try:
+            url = args.rpc_laddr.replace("tcp://", "http://")
+            with urllib.request.urlopen(f"{url}/metrics",
+                                        timeout=5) as r:
+                text = r.read().decode()
+        except Exception as e:  # noqa: BLE001
+            text = f"# error: {e}\n"
+        with open(_os.path.join(out_dir, "metrics.txt"), "w") as f:
+            f.write(text)
+        print(f"debug bundle written to {out_dir}")
+
+    asyncio.run(run())
+    return 0
+
+
 def cmd_light(args) -> int:
     """Reference: cmd/cometbft/commands/light.go — stand-alone verifying
     proxy daemon."""
@@ -283,6 +434,24 @@ def main(argv=None) -> int:
                     help="hex header hash at the trusted height")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser(
+        "inspect", help="read-only RPC over a stopped node's data")
+    sp.add_argument("--rpc.laddr", dest="rpc_laddr", default="")
+    sp.set_defaults(fn=cmd_inspect)
+
+    sp = sub.add_parser("reindex-event",
+                        help="rebuild tx/block indexes from stores")
+    sp.add_argument("--start-height", type=int, default=0)
+    sp.add_argument("--end-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_reindex_event)
+
+    sp = sub.add_parser("debug", help="debug a running node")
+    dbg = sp.add_subparsers(dest="debug_cmd", required=True)
+    dd = dbg.add_parser("dump", help="capture a diagnostic bundle")
+    dd.add_argument("output_directory")
+    dd.add_argument("--rpc-laddr", default="tcp://127.0.0.1:26657")
+    dd.set_defaults(fn=cmd_debug_dump)
 
     sp = sub.add_parser("rollback", help="roll back one height")
     sp.add_argument("--hard", action="store_true",

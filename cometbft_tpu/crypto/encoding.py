@@ -7,7 +7,7 @@ registry used by genesis / testnet key-type flags).
 """
 from __future__ import annotations
 
-from . import bls12381, ed25519, secp256k1
+from . import bls12381, ed25519, secp256k1, secp256k1eth
 from .keys import PrivKey, PubKey
 
 # proto oneof field name per key type
@@ -38,6 +38,8 @@ def pub_key_from_proto(d: dict) -> PubKey:
             return secp256k1.Secp256k1PubKey(d["secp256k1"])
         if "bls12381" in d:
             return bls12381.Bls12381PubKey(d["bls12381"])
+        if "secp256k1eth" in d:
+            return secp256k1eth.Secp256k1EthPubKey(d["secp256k1eth"])
     except ValueError as e:
         raise EncodingError(str(e)) from None
     raise EncodingError(f"unsupported proto pubkey {sorted(d)}")
@@ -52,6 +54,8 @@ def pub_key_from_type_and_bytes(key_type: str, raw: bytes) -> PubKey:
             return secp256k1.Secp256k1PubKey(raw)
         if key_type == bls12381.KEY_TYPE:
             return bls12381.Bls12381PubKey(raw)
+        if key_type == secp256k1eth.KEY_TYPE:
+            return secp256k1eth.Secp256k1EthPubKey(raw)
     except ValueError as e:
         raise EncodingError(str(e)) from None
     raise EncodingError(f"unsupported key type {key_type}")
@@ -64,11 +68,13 @@ AMINO_PUBKEY_NAMES = {
     "ed25519": "tendermint/PubKeyEd25519",
     "secp256k1": "tendermint/PubKeySecp256k1",
     "bls12_381": "cometbft/PubKeyBls12_381",
+    "secp256k1eth": "cometbft/PubKeySecp256k1eth",
 }
 AMINO_PRIVKEY_NAMES = {
     "ed25519": "tendermint/PrivKeyEd25519",
     "secp256k1": "tendermint/PrivKeySecp256k1",
     "bls12_381": "cometbft/PrivKeyBls12_381",
+    "secp256k1eth": "cometbft/PrivKeySecp256k1eth",
 }
 
 
@@ -78,6 +84,7 @@ _GENERATORS = {
     ed25519.KEY_TYPE: ed25519.gen_priv_key,
     secp256k1.KEY_TYPE: secp256k1.gen_priv_key,
     bls12381.KEY_TYPE: bls12381.gen_priv_key,
+    secp256k1eth.KEY_TYPE: secp256k1eth.gen_priv_key,
 }
 
 
@@ -101,6 +108,8 @@ def priv_key_from_type_and_bytes(key_type: str, raw: bytes) -> PrivKey:
             return secp256k1.Secp256k1PrivKey(raw)
         if key_type == bls12381.KEY_TYPE:
             return bls12381.Bls12381PrivKey(raw)
+        if key_type == secp256k1eth.KEY_TYPE:
+            return secp256k1eth.Secp256k1EthPrivKey(raw)
     except ValueError as e:
         raise EncodingError(str(e)) from None
     raise EncodingError(f"unsupported key type {key_type}")

@@ -1,0 +1,124 @@
+"""secp256k1eth: Ethereum-compatible secp256k1 keys.
+
+Reference: crypto/secp256k1eth/secp256k1eth.go (behind the secp256k1eth
+build tag; noop stub otherwise — this build enables it unconditionally).
+Differences from the Cosmos secp256k1 type:
+  * Address = last 20 bytes of Keccak-256(uncompressed pubkey sans 0x04
+    prefix) — the Ethereum address rule (go-ethereum crypto.PubkeyToAddress);
+  * pubkey serialized UNCOMPRESSED (65 bytes, 0x04 || X || Y);
+  * signatures are 64-byte R || S over Keccak-256(msg), lower-S enforced.
+"""
+from __future__ import annotations
+
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives import hashes
+from cryptography.hazmat.primitives.asymmetric import ec
+from cryptography.hazmat.primitives.asymmetric.utils import (
+    Prehashed,
+    decode_dss_signature,
+    encode_dss_signature,
+)
+from cryptography.hazmat.primitives.serialization import (
+    Encoding,
+    PublicFormat,
+)
+
+from ._keccak import keccak256
+from .keys import PrivKey, PubKey
+
+KEY_TYPE = "secp256k1eth"
+ENABLED = True
+PRIV_KEY_SIZE = 32
+PUB_KEY_SIZE = 65          # uncompressed: 0x04 || X || Y
+SIG_SIZE = 64
+
+_N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
+_HALF_N = _N // 2
+_CURVE = ec.SECP256K1()
+# ECDSA over an externally-computed Keccak-256 digest: SHA-256 here only
+# names a 32-byte digest length for the Prehashed wrapper
+_PREHASHED = ec.ECDSA(Prehashed(hashes.SHA256()))
+
+
+class Secp256k1EthPubKey(PubKey):
+    __slots__ = ("_raw", "_pk")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PUB_KEY_SIZE or raw[0] != 0x04:
+            raise ValueError(
+                f"secp256k1eth pubkey must be {PUB_KEY_SIZE} bytes "
+                f"starting 0x04")
+        self._raw = bytes(raw)
+        self._pk = None
+
+    def address(self) -> bytes:
+        """Ethereum rule: Keccak-256(X||Y)[12:]."""
+        return keccak256(self._raw[1:])[12:]
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+    def _parsed(self):
+        if self._pk is None:
+            self._pk = ec.EllipticCurvePublicKey.from_encoded_point(
+                _CURVE, self._raw)
+        return self._pk
+
+    def verify_signature(self, msg: bytes, sig: bytes) -> bool:
+        if len(sig) != SIG_SIZE:
+            return False
+        r = int.from_bytes(sig[:32], "big")
+        s = int.from_bytes(sig[32:], "big")
+        if not (0 < r < _N) or not (0 < s < _N) or s > _HALF_N:
+            return False
+        try:
+            self._parsed().verify(encode_dss_signature(r, s),
+                                  keccak256(msg), _PREHASHED)
+            return True
+        except (InvalidSignature, ValueError):
+            return False
+
+
+class Secp256k1EthPrivKey(PrivKey):
+    __slots__ = ("_raw", "_sk")
+
+    def __init__(self, raw: bytes):
+        if len(raw) != PRIV_KEY_SIZE:
+            raise ValueError(
+                f"secp256k1eth privkey must be {PRIV_KEY_SIZE} bytes")
+        d = int.from_bytes(raw, "big")
+        if not (0 < d < _N):
+            raise ValueError("secp256k1eth privkey scalar out of range")
+        self._raw = bytes(raw)
+        self._sk = ec.derive_private_key(d, _CURVE)
+
+    def bytes(self) -> bytes:
+        return self._raw
+
+    def sign(self, msg: bytes) -> bytes:
+        der = self._sk.sign(keccak256(msg), _PREHASHED)
+        r, s = decode_dss_signature(der)
+        if s > _HALF_N:
+            s = _N - s
+        return r.to_bytes(32, "big") + s.to_bytes(32, "big")
+
+    def pub_key(self) -> Secp256k1EthPubKey:
+        raw = self._sk.public_key().public_bytes(
+            Encoding.X962, PublicFormat.UncompressedPoint)
+        return Secp256k1EthPubKey(raw)
+
+    def type(self) -> str:
+        return KEY_TYPE
+
+
+def gen_priv_key() -> Secp256k1EthPrivKey:
+    while True:
+        raw = secrets.token_bytes(PRIV_KEY_SIZE)
+        d = int.from_bytes(raw, "big")
+        if 0 < d < _N:
+            return Secp256k1EthPrivKey(raw)

@@ -67,7 +67,13 @@ class VerifyingClient:
                 f"block {hdr.height} data does not hash to the "
                 f"verified data_hash")
         lc_json = res["block"].get("last_commit")
-        if lc_json is not None and hdr.height > 1:
+        if hdr.height > 1:
+            # a nil last_commit above height 1 is itself invalid
+            # (reference Block.ValidateBasic) — a stripped field must
+            # not bypass the hash check
+            if lc_json is None:
+                raise LightProxyError(
+                    f"block {hdr.height} is missing last_commit")
             if commit_from_json(lc_json).hash() != \
                     hdr.last_commit_hash:
                 raise LightProxyError(
@@ -164,13 +170,16 @@ class LightProxy:
             h = int(height) or await _latest_height()
             vals = await c.validators(h)
             from ..types import genesis as genesis_types
+            page_i = max(1, int(page))
+            per = min(100, max(1, int(per_page)))
+            sel = vals.validators[(page_i - 1) * per:page_i * per]
             return {"block_height": str(h), "validators": [
                 {"address": v.address.hex().upper(),
                  "pub_key": genesis_types.pub_key_to_json(v.pub_key),
                  "voting_power": str(v.voting_power),
                  "proposer_priority": str(v.proposer_priority)}
-                for v in vals.validators],
-                "count": str(vals.size()), "total": str(vals.size())}
+                for v in sel],
+                "count": str(len(sel)), "total": str(vals.size())}
 
         async def _block(height="0"):
             return await c.block(int(height) or await _latest_height())

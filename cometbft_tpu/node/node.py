@@ -144,6 +144,12 @@ class Node:
         self._m_mempool_size = m.gauge("mempool", "size",
                                        "Pending txs in the mempool")
         self._m_peers = m.gauge("p2p", "peers", "Connected peers")
+        self._m_step_duration = m.histogram(
+            "consensus", "step_duration_seconds",
+            "Time spent in each consensus step", labels=("step",),
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
+        self._m_rounds = m.gauge("consensus", "rounds",
+                                 "Round of the latest committed height")
         self._m_p2p_sent = m.gauge("p2p", "message_send_bytes_total",
                                    "Bytes sent to peers")
         self._m_p2p_recv = m.gauge("p2p", "message_receive_bytes_total",
@@ -244,11 +250,26 @@ class Node:
             block_store=self.block_store)
         block_exec.pruner = self.pruner
 
+        # consensus step timings (reference: consensus metrics.go
+        # StepDurationSeconds via recordMetrics)
+        import time as _time
+        step_clock = {"name": "", "t": _time.monotonic()}
+
+        def _on_step(rs):
+            now = _time.monotonic()
+            if step_clock["name"]:
+                self._m_step_duration.with_labels(
+                    step_clock["name"]).observe(now - step_clock["t"])
+            step_clock["name"] = rs.step_name()
+            step_clock["t"] = now
+            self._m_rounds.set(rs.round)
+
         wal_path = cfg.base.path(cfg.consensus.wal_file)
         self.consensus_state = ConsensusState(
             cfg.consensus, state, block_exec, self.block_store,
             priv_validator=self.priv_validator,
             event_bus=self.event_bus, wal=WAL(wal_path))
+        self.consensus_state.on_new_step.append(_on_step)
         try:
             await catchup_replay(self.consensus_state, wal_path)
         except ReplayError as e:

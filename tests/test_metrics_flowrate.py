@@ -243,3 +243,104 @@ class TestPrunerAndWALRotation:
             assert total <= 4096 + 1024
             # oldest file index is no longer 0
             assert int(rotated[0].rsplit(".", 1)[1]) > 0
+
+
+class TestCryptoExtras:
+    def test_secp256k1eth_eth_address_rule(self):
+        from cometbft_tpu.crypto import secp256k1eth
+        from cometbft_tpu.crypto._keccak import keccak256
+        sk = secp256k1eth.gen_priv_key()
+        pk = sk.pub_key()
+        assert len(pk.bytes()) == 65 and pk.bytes()[0] == 0x04
+        assert pk.address() == keccak256(pk.bytes()[1:])[12:]
+        sig = sk.sign(b"eth msg")
+        assert pk.verify_signature(b"eth msg", sig)
+        assert not pk.verify_signature(b"eth msg!", sig)
+        # high-S malleation rejected
+        n = secp256k1eth._N
+        s = int.from_bytes(sig[32:], "big")
+        assert not pk.verify_signature(
+            b"eth msg", sig[:32] + (n - s).to_bytes(32, "big"))
+
+    def test_armor_roundtrip_and_tamper(self):
+        import pytest as _pytest
+
+        from cometbft_tpu.crypto.armor import (
+            ArmorError, decode_armor, encode_armor,
+        )
+        data = bytes(range(200))
+        text = encode_armor("TENDERMINT PRIVATE KEY",
+                            {"kdf": "bcrypt", "salt": "ABCD"}, data)
+        btype, headers, out = decode_armor(text)
+        assert btype == "TENDERMINT PRIVATE KEY"
+        assert headers == {"kdf": "bcrypt", "salt": "ABCD"}
+        assert out == data
+        # flip a body byte -> CRC failure
+        lines = text.split("\n")
+        for i, ln in enumerate(lines):
+            if ln and not ln.startswith(("-", "=")) and ":" not in ln:
+                lines[i] = ("B" if ln[0] != "B" else "C") + ln[1:]
+                break
+        with _pytest.raises(ArmorError):
+            decode_armor("\n".join(lines))
+
+    def test_bench_helpers(self):
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.crypto.benchmarking import (
+            bench_batch_verify, bench_sign, bench_verify,
+        )
+        assert bench_sign(ed25519.gen_priv_key(), iters=10) > 0
+        assert bench_verify(ed25519.gen_priv_key(), iters=10) > 0
+        assert bench_batch_verify(ed25519.gen_priv_key,
+                                  batch_size=8, iters=1) > 0
+
+    def test_step_duration_metrics_on_live_node(self):
+        """consensus_step_duration_seconds appears with step labels."""
+        import os
+        import tempfile
+
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc, GenesisValidator,
+        )
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                cfg = Config()
+                cfg.base.home = home
+                cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                cfg.rpc.laddr = ""
+                cfg.consensus.timeout_commit = 0.02
+                os.makedirs(os.path.join(home, "config"), exist_ok=True)
+                os.makedirs(os.path.join(home, "data"), exist_ok=True)
+                pv = FilePV.generate(
+                    cfg.base.path(cfg.base.priv_validator_key_file),
+                    cfg.base.path(cfg.base.priv_validator_state_file))
+                NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+                GenesisDoc(
+                    chain_id="step-chain",
+                    genesis_time=Timestamp.now(),
+                    validators=[GenesisValidator(
+                        address=b"", pub_key=pv.get_pub_key(),
+                        power=10)],
+                ).save_as(cfg.base.path(cfg.base.genesis_file))
+                node = Node(cfg)
+                await node.start()
+                try:
+                    for _ in range(300):
+                        if node.height >= 3:
+                            break
+                        await asyncio.sleep(0.02)
+                    text = node.metrics_registry.render()
+                    assert "cometbft_consensus_step_duration_seconds" \
+                        in text
+                    assert 'step="Propose"' in text or \
+                        'step="Commit"' in text
+                finally:
+                    await node.stop()
+        asyncio.run(run())
