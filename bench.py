@@ -28,7 +28,7 @@ import numpy as np
 N = 10_000
 MSG_LEN = 110                      # ~vote sign-bytes size
 TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("COMETBFT_TPU_BENCH_TIMEOUT",
-                                           "1500"))
+                                           "1100"))
 CPU_ATTEMPT_TIMEOUT_S = 1200
 
 
@@ -104,9 +104,14 @@ def child_cpu() -> int:
 
 def child(platform: str) -> int:
     """Run the measurement on `platform` ('tpu' keeps the default backend;
-    'cpu' measures the engine's OpenSSL path).  Prints the JSON line."""
+    'cpu' measures the engine's OpenSSL path; 'tpu-pallas'/'tpu-xla' pin
+    the kernel).  Prints the JSON line."""
     if platform == "cpu":
         return child_cpu()
+    if platform == "tpu-pallas":
+        os.environ["COMETBFT_TPU_KERNEL"] = "pallas"
+    elif platform == "tpu-xla":
+        os.environ["COMETBFT_TPU_KERNEL"] = "xla"
     import jax
 
     t0 = time.perf_counter()
@@ -139,21 +144,40 @@ def child(platform: str) -> int:
     assert ok
     e2e_ms = float(np.median(times))
 
-    # device-only time: prepped arrays resident, one dispatch
+    # device-only time: prepped arrays resident, one dispatch of the
+    # SELECTED kernel (pallas or xla)
     import jax.numpy as jnp
     m = ej._bucket(N)
-    a = np.zeros((m, 32), np.uint8)
-    r = np.zeros((m, 32), np.uint8)
-    a[:] = np.frombuffer(ej._B_BYTES, np.uint8)
-    r[:] = np.frombuffer(ej._IDENTITY_BYTES, np.uint8)
-    win = np.zeros((ej._WINDOWS, m), np.int32)
-    da, dr = jnp.asarray(a), jnp.asarray(r)
-    dw = jnp.asarray(win)
-    ej._jit_verify(da, dr, dw, dw).block_until_ready()
+    kernel = ej._kernel_choice()
+    if kernel == "pallas":
+        from cometbft_tpu.ops import ed25519_pallas as ep
+        m = max(m, ep.BLOCK)
+        a = np.tile(np.frombuffer(ej._B_BYTES, np.uint8)
+                    .astype(np.int32).reshape(32, 1), (1, m))
+        r = np.tile(np.frombuffer(ej._IDENTITY_BYTES, np.uint8)
+                    .astype(np.int32).reshape(32, 1), (1, m))
+        win = np.zeros((ej._WINDOWS, m), np.int32)
+        da, dr = jnp.asarray(a), jnp.asarray(r)
+        dw = jnp.asarray(win)
+
+        def _dispatch():
+            return ep.verify_cols(da, dr, dw, dw).block_until_ready()
+    else:
+        a = np.zeros((m, 32), np.uint8)
+        r = np.zeros((m, 32), np.uint8)
+        a[:] = np.frombuffer(ej._B_BYTES, np.uint8)
+        r[:] = np.frombuffer(ej._IDENTITY_BYTES, np.uint8)
+        win = np.zeros((ej._WINDOWS, m), np.int32)
+        da, dr = jnp.asarray(a), jnp.asarray(r)
+        dw = jnp.asarray(win)
+
+        def _dispatch():
+            return ej._jit_verify(da, dr, dw, dw).block_until_ready()
+    _dispatch()
     dts = []
     for _ in range(5):
         t0 = time.perf_counter()
-        ej._jit_verify(da, dr, dw, dw).block_until_ready()
+        _dispatch()
         dts.append((time.perf_counter() - t0) * 1000.0)
     dev_ms = float(np.median(dts))
     log(f"[bench] platform={devs[0].platform} e2e_ms={e2e_ms:.2f} "
@@ -165,6 +189,7 @@ def child(platform: str) -> int:
         "unit": "ms",
         "vs_baseline": round(cpu_ms / e2e_ms, 3),
         "platform": devs[0].platform,
+        "kernel": kernel,
         "device_ms": round(dev_ms, 3),
         "baseline_cpu_ms": round(cpu_ms, 1),
     }))
@@ -199,11 +224,33 @@ def run_child(platform: str, timeout_s: int):
 
 
 def main() -> int:
-    log("[bench] TPU attempt 1")
-    result, err = run_child("tpu", TPU_ATTEMPT_TIMEOUT_S)
-    if result is None and not err.startswith("timeout"):
-        # fast failure (e.g. UNAVAILABLE after pool claim denial): one retry
-        log("[bench] TPU attempt 2")
+    # Try BOTH TPU kernels (the fused Pallas kernel and the portable XLA
+    # kernel) and report the faster successful measurement; if the first
+    # attempt TIMES OUT the pool is likely dead, so don't burn the budget
+    # on the second.
+    results = []
+    log("[bench] TPU attempt: pallas kernel")
+    r_pallas, err = run_child("tpu-pallas", TPU_ATTEMPT_TIMEOUT_S)
+    if r_pallas is not None:
+        results.append(r_pallas)
+    pool_dead = r_pallas is None and err.startswith("timeout")
+    if not pool_dead:
+        log("[bench] TPU attempt: xla kernel")
+        r_xla, err2 = run_child("tpu-xla", TPU_ATTEMPT_TIMEOUT_S)
+        if r_xla is not None:
+            results.append(r_xla)
+        err = err2 if r_xla is None else err
+    if results:
+        result = min(results, key=lambda r: r.get("value", 1e18))
+        if len(results) == 2:
+            other = max(results, key=lambda r: r.get("value", 1e18))
+            result["other_kernel_ms"] = other.get("value")
+            result["other_kernel"] = other.get("kernel")
+    else:
+        result = None
+    if result is None and not pool_dead:
+        # fast failure (e.g. UNAVAILABLE): one retry on the default path
+        log("[bench] TPU retry (default kernel)")
         result, err = run_child("tpu", TPU_ATTEMPT_TIMEOUT_S)
     if result is None:
         # Distinguishable failure modes are preserved in tpu_error: a

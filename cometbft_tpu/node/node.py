@@ -285,11 +285,18 @@ class Node:
         # state for the blocksync decision and reactor
         state = self.state_store.load() or state
 
+        # statesync runs only on a fresh node; it always hands off to
+        # blocksync, so blocksync is forced on behind it (reference:
+        # setup.go:569 startStateSync -> blocksync reactor)
+        run_statesync = (cfg.statesync.enable and
+                         state.last_block_height == 0)
+
         # blocksync decision (reference: setup.go — sync unless we are
         # the only validator)
-        run_blocksync = (cfg.blocksync.enable and
-                         not _only_validator_is_us(
-                             state, self.priv_validator.get_pub_key()))
+        run_blocksync = run_statesync or (
+            cfg.blocksync.enable and
+            not _only_validator_is_us(
+                state, self.priv_validator.get_pub_key()))
 
         self.consensus_reactor = ConsensusReactor(
             self.consensus_state, wait_sync=run_blocksync)
@@ -321,6 +328,29 @@ class Node:
         self.switch.add_reactor(self.blocksync_reactor)
         self._run_blocksync = run_blocksync
 
+        # statesync (reference: setup.go:569 startStateSync): a fresh
+        # node with statesync enabled bootstraps from a peer snapshot,
+        # with trusted state/commit fetched via the light client over
+        # the configured RPC servers; every node serves snapshots
+        from ..statesync.reactor import StatesyncReactor
+        from ..statesync.syncer import Syncer
+        self._statesync_syncer = None
+        if run_statesync:
+            sp = await self._make_state_provider(state)
+            syncer = Syncer(
+                self.app_conns, sp,
+                request_chunk=lambda snap, i:
+                    self.statesync_reactor.request_chunk(snap, i),
+                chunk_timeout_s=(cfg.statesync
+                                 .chunk_request_timeout_ns / 1e9),
+                chunk_dir=cfg.statesync.temp_dir or None)
+            self._statesync_syncer = syncer
+            self.statesync_reactor = StatesyncReactor(
+                self.app_conns, syncer)
+        else:
+            self.statesync_reactor = StatesyncReactor(self.app_conns)
+        self.switch.add_reactor(self.statesync_reactor)
+
         # RPC before p2p (reference: OnStart order)
         if cfg.rpc.laddr:
             from ..rpc.server import RPCServer
@@ -334,7 +364,21 @@ class Node:
             self.switch.dial_peers_async(
                 [a.split("@")[-1] for a in addrs])
 
-        if self._run_blocksync:
+        if self._statesync_syncer is not None:
+            new_state, commit = await self._statesync_syncer.sync_any(
+                cfg.statesync.discovery_time_ns / 1e9)
+            # bootstrap stores at the snapshot height (reference:
+            # statesync.Reactor -> state.Store.Bootstrap + the seen
+            # commit the blocksync verify path needs); consensus state
+            # is updated (with LastCommit reconstruction) by the
+            # blocksync->consensus handoff
+            self.state_store.bootstrap(new_state)
+            self.block_store.save_seen_commit_standalone(commit)
+            self.blocksync_reactor.state = new_state
+            self.logger.info("State sync complete",
+                             height=new_state.last_block_height)
+            await self.blocksync_reactor.start_sync()
+        elif self._run_blocksync:
             await self.blocksync_reactor.start_sync()
         else:
             await self.consensus_state.start()
@@ -362,6 +406,21 @@ class Node:
             await self._signer_endpoint.stop()
         self._started = False
         self.logger.info("Node stopped")
+
+    async def _make_state_provider(self, state):
+        """Light-client state provider over the configured RPC servers
+        (reference: stateprovider.go:29)."""
+        from ..statesync.syncer import new_rpc_state_provider
+        cfg = self.config.statesync
+        if not cfg.rpc_servers or not cfg.trust_hash or \
+                not cfg.trust_height:
+            raise NodeError(
+                "statesync.enable requires rpc_servers and "
+                "trust_height/trust_hash (reference config)")
+        return await new_rpc_state_provider(
+            self.genesis_doc.chain_id, self.genesis_doc,
+            list(cfg.rpc_servers), cfg.trust_height,
+            bytes.fromhex(cfg.trust_hash), cfg.trust_period_ns)
 
     async def _metrics_watcher(self) -> None:
         """Event-driven metric updates (reference: recordMetrics in

@@ -73,10 +73,10 @@ class StateProvider:
             chain_id=self.chain_id,
             initial_height=self.genesis_doc.initial_height,
             last_block_height=cur.height,
-            last_block_id=BlockID(
-                hash=cur.signed_header.header.hash(),
-                part_set_header=nxt.signed_header.commit.block_id
-                .part_set_header),
+            # the commit AT `height` carries block `height`'s BlockID —
+            # including the part-set header blocksync validates the next
+            # block's Header.LastBlockID against
+            last_block_id=cur.signed_header.commit.block_id,
             last_block_time=cur.signed_header.header.time,
             validators=nxt.validator_set,
             next_validators=nxt2.validator_set,

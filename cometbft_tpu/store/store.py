@@ -155,6 +155,26 @@ class BlockStore:
             self._save_store_state(batch)
             batch.write_sync()
 
+    def save_seen_commit_standalone(self, commit: Commit) -> None:
+        """Persist only a seen commit, without a block — the statesync
+        bootstrap artifact blocksync needs to start verifying from the
+        snapshot height (reference: store.go SaveSeenCommit, used by the
+        statesync reactor's Bootstrap)."""
+        with self._lock:
+            batch = self._db.new_batch()
+            batch.set(_seen_commit_key(commit.height),
+                      encode(pb.COMMIT, commit.to_proto()))
+            # advance height so blocksync resumes AFTER the snapshot;
+            # base points at the FIRST block we will actually store
+            # (H+1) — advertising base=H would promise a block we can
+            # never serve
+            if self._height < commit.height:
+                self._height = commit.height
+            if self._base <= commit.height:
+                self._base = commit.height + 1
+            self._save_store_state(batch)
+            batch.write_sync()
+
     # ------------------------------------------------------------------
     def load_block_meta(self, height: int) -> Optional[BlockMeta]:
         raw = self._db.get(_meta_key(height))
