@@ -239,6 +239,8 @@ def main() -> int:
         r_xla, err2 = run_child("tpu-xla", TPU_ATTEMPT_TIMEOUT_S)
         if r_xla is not None:
             results.append(r_xla)
+        else:
+            pool_dead = pool_dead or err2.startswith("timeout")
         err = err2 if r_xla is None else err
     if results:
         result = min(results, key=lambda r: r.get("value", 1e18))

@@ -125,6 +125,20 @@ def cmd_unsafe_reset_all(args) -> int:
     return 0
 
 
+def cmd_config_validate(args) -> int:
+    """Reference: `cometbft config` (internal/confix) — validate the
+    persisted config file."""
+    from ..config import ConfigError, validate_basic
+    cfg = _load_config(args.home)
+    try:
+        validate_basic(cfg)
+    except ConfigError as e:
+        print(f"config invalid: {e}")
+        return 1
+    print("config is valid")
+    return 0
+
+
 def cmd_inspect(args) -> int:
     """Serve read-only RPC over the data stores of a stopped/crashed
     node — no consensus, no p2p (reference: commands/inspect.go +
@@ -434,6 +448,11 @@ def main(argv=None) -> int:
                     help="hex header hash at the trusted height")
     sp.add_argument("--laddr", default="tcp://127.0.0.1:8888")
     sp.set_defaults(fn=cmd_light)
+
+    sp = sub.add_parser("config", help="config tooling")
+    cfgsub = sp.add_subparsers(dest="config_cmd", required=True)
+    cv = cfgsub.add_parser("validate", help="validate the config file")
+    cv.set_defaults(fn=cmd_config_validate)
 
     sp = sub.add_parser(
         "inspect", help="read-only RPC over a stopped node's data")

@@ -165,6 +165,71 @@ class Config:
         default_factory=InstrumentationConfig)
 
 
+class ConfigError(Exception):
+    pass
+
+
+def validate_basic(cfg: Config) -> None:
+    """Per-section sanity checks (reference: config.go ValidateBasic on
+    every sub-config, called from the root command).  Raises
+    ConfigError with the offending section.key."""
+    if cfg.base.db_backend not in ("memdb", "sqlite", "goleveldb",
+                                   "pebbledb"):
+        raise ConfigError(
+            f"base.db_backend: unknown backend {cfg.base.db_backend!r}")
+    if cfg.rpc.max_body_bytes <= 0:
+        raise ConfigError("rpc.max_body_bytes must be positive")
+    if cfg.rpc.timeout_broadcast_tx_commit_ns <= 0:
+        raise ConfigError(
+            "rpc.timeout_broadcast_tx_commit must be positive")
+    if cfg.p2p.send_rate < 0 or cfg.p2p.recv_rate < 0:
+        raise ConfigError("p2p.send_rate/recv_rate cannot be negative")
+    if cfg.p2p.max_num_inbound_peers < 0 or \
+            cfg.p2p.max_num_outbound_peers < 0:
+        raise ConfigError("p2p peer limits cannot be negative")
+    if cfg.mempool.size <= 0:
+        raise ConfigError("mempool.size must be positive")
+    if cfg.mempool.max_tx_bytes <= 0:
+        raise ConfigError("mempool.max_tx_bytes must be positive")
+    if cfg.mempool.max_txs_bytes < 0:
+        raise ConfigError("mempool.max_txs_bytes cannot be negative")
+    if cfg.statesync.enable:
+        if not cfg.statesync.rpc_servers:
+            raise ConfigError(
+                "statesync.rpc_servers required when statesync enabled")
+        if cfg.statesync.trust_height <= 0:
+            raise ConfigError(
+                "statesync.trust_height required when statesync enabled")
+        try:
+            bytes.fromhex(cfg.statesync.trust_hash)
+        except ValueError:
+            raise ConfigError(
+                "statesync.trust_hash must be hex") from None
+        if not cfg.statesync.trust_hash:
+            raise ConfigError(
+                "statesync.trust_hash required when statesync enabled")
+        if cfg.statesync.trust_period_ns <= 0:
+            raise ConfigError("statesync.trust_period must be positive")
+    for name in ("timeout_propose_ns", "timeout_propose_delta_ns",
+                 "timeout_vote_ns", "timeout_vote_delta_ns",
+                 "peer_gossip_sleep_duration_ns",
+                 "peer_query_maj23_sleep_duration_ns"):
+        if getattr(cfg.consensus, name) < 0:
+            raise ConfigError(f"consensus.{name} cannot be negative")
+    if cfg.consensus.create_empty_blocks_interval_ns < 0:
+        raise ConfigError(
+            "consensus.create_empty_blocks_interval cannot be negative")
+    if cfg.tx_index.indexer not in ("kv", "null"):
+        raise ConfigError(
+            f"tx_index.indexer must be kv|null, "
+            f"got {cfg.tx_index.indexer!r}")
+    if cfg.instrumentation.prometheus and \
+            not cfg.instrumentation.prometheus_listen_addr:
+        raise ConfigError(
+            "instrumentation.prometheus_listen_addr required when "
+            "prometheus enabled")
+
+
 def default_config() -> Config:
     return Config()
 

@@ -136,25 +136,28 @@ class WAL:
         strict."""
         with open(path, "rb") as f:
             data = f.read()
+        good = _scan_valid_prefix(data)
         pos = 0
-        n = len(data)
-        while pos < n:
-            if n - pos < 8:
-                if strict:
-                    raise CorruptWALError("truncated frame header")
-                return
+        while pos < good:
             crc, length = struct.unpack(">II", data[pos:pos + 8])
-            if length > MAX_MSG_SIZE_BYTES:
-                raise CorruptWALError(f"frame too large: {length}")
-            if n - pos - 8 < length:
-                if strict:
-                    raise CorruptWALError("truncated frame payload")
-                return
-            payload = data[pos + 8:pos + 8 + length]
-            if zlib.crc32(payload) & 0xFFFFFFFF != crc:
-                raise CorruptWALError(f"crc mismatch at offset {pos}")
-            yield json.loads(payload)
+            yield json.loads(data[pos + 8:pos + 8 + length])
             pos += 8 + length
+        if good < len(data):
+            # distinguish a torn tail (clean-stop unless strict) from
+            # mid-file corruption (always an error)
+            tail = len(data) - good
+            if tail >= 8:
+                crc, length = struct.unpack(">II",
+                                            data[good:good + 8])
+                if length <= MAX_MSG_SIZE_BYTES and \
+                        len(data) - good - 8 >= length:
+                    raise CorruptWALError(
+                        f"crc mismatch at offset {good}")
+                if length > MAX_MSG_SIZE_BYTES:
+                    raise CorruptWALError(
+                        f"frame too large: {length}")
+            if strict:
+                raise CorruptWALError("truncated frame")
 
     @staticmethod
     def search_for_end_height(path: str, height: int
@@ -172,6 +175,55 @@ class WAL:
                     msg.get("height") == height:
                 found = True
         return out if found else None
+
+
+def _scan_valid_prefix(data: bytes) -> int:
+    """Byte offset of the first invalid frame (== len(data) when all
+    frames are intact).  THE corruption rule — iter_messages and repair
+    share it so replay and repair always agree on the cut point."""
+    pos = 0
+    n = len(data)
+    while pos < n:
+        if n - pos < 8:
+            return pos
+        crc, length = struct.unpack(">II", data[pos:pos + 8])
+        if length > MAX_MSG_SIZE_BYTES or n - pos - 8 < length:
+            return pos
+        payload = data[pos + 8:pos + 8 + length]
+        if zlib.crc32(payload) & 0xFFFFFFFF != crc:
+            return pos
+        pos += 8 + length
+    return pos
+
+
+def repair_wal_file(path: str) -> int:
+    """Repair the WAL GROUP: truncate the first file containing a
+    corrupt frame and drop every later file — nothing after a corrupt
+    frame can be trusted as a contiguous record (reference:
+    consensus/wal.go repair driven by state.go OnStart's corruption
+    retry).  Corrupt content is stashed in .corrupted files.  Returns
+    bytes dropped."""
+    import shutil
+    dropped = 0
+    cut = False
+    for f_path in WAL.group_files(path):
+        if cut:
+            dropped += os.path.getsize(f_path)
+            shutil.move(f_path, f_path + ".corrupted")
+            continue
+        with open(f_path, "rb") as f:
+            data = f.read()
+        good = _scan_valid_prefix(data)
+        if good < len(data):
+            cut = True
+            dropped += len(data) - good
+            shutil.copy(f_path, f_path + ".corrupted")
+            with open(f_path, "r+b") as f:
+                f.truncate(good)
+    # the head file must exist for reopen even if it was dropped
+    if not os.path.exists(path):
+        open(path, "ab").close()
+    return dropped
 
 
 class NilWAL:

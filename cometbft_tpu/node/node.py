@@ -16,7 +16,7 @@ from ..config import Config
 from ..consensus.reactor import ConsensusReactor
 from ..consensus.replay import Handshaker, ReplayError, catchup_replay
 from ..consensus.state import ConsensusState
-from ..consensus.wal import WAL
+from ..consensus.wal import WAL, CorruptWALError
 from ..db import new_db
 from ..libs.log import Logger, new_logger, set_level
 from ..mempool import CListMempool
@@ -73,6 +73,8 @@ class Node:
                  genesis_doc: Optional[GenesisDoc] = None,
                  logger: Optional[Logger] = None):
         self.config = config
+        from ..config import validate_basic
+        validate_basic(config)
         self.logger = logger if logger is not None else \
             new_logger("node")
         set_level(config.base.log_level)
@@ -271,8 +273,18 @@ class Node:
             event_bus=self.event_bus, wal=WAL(wal_path))
         self.consensus_state.on_new_step.append(_on_step)
         try:
-            await catchup_replay(self.consensus_state, wal_path)
-        except ReplayError as e:
+            try:
+                await catchup_replay(self.consensus_state, wal_path)
+            except CorruptWALError as e:
+                # reference state.go OnStart: one repair attempt — keep
+                # the valid prefix, stash the corrupt tail, replay again
+                from ..consensus.wal import repair_wal_file
+                dropped = repair_wal_file(wal_path)
+                self.logger.error(
+                    "WAL corrupted; repaired by truncating",
+                    err=str(e), dropped_bytes=dropped)
+                await catchup_replay(self.consensus_state, wal_path)
+        except (ReplayError, CorruptWALError) as e:
             # reference state.go OnStart: a non-corruption catchup error
             # (e.g. the end-height barrier was never written because we
             # crashed between block save and WAL fsync — the handshake
@@ -365,8 +377,15 @@ class Node:
                 [a.split("@")[-1] for a in addrs])
 
         if self._statesync_syncer is not None:
-            new_state, commit = await self._statesync_syncer.sync_any(
-                cfg.statesync.discovery_time_ns / 1e9)
+            try:
+                new_state, commit = \
+                    await self._statesync_syncer.sync_any(
+                        cfg.statesync.discovery_time_ns / 1e9)
+            except Exception:
+                # boot failed mid-way: tear down what already started
+                # (switch, RPC, pruner, indexer) instead of leaking it
+                await self.stop()
+                raise
             # bootstrap stores at the snapshot height (reference:
             # statesync.Reactor -> state.Store.Bootstrap + the seen
             # commit the blocksync verify path needs); consensus state

@@ -192,18 +192,27 @@ class Syncer:
         q.add(index, chunk, sender)
 
     # ------------------------------------------------------------------
-    async def sync_any(self, discovery_time_s: float = 2.0
+    async def sync_any(self, discovery_time_s: float = 2.0,
+                       max_discovery_rounds: int = 20
                        ) -> tuple[SMState, Commit]:
-        """Try snapshots best-first until one applies (reference:
-        SyncAny)."""
+        """Try snapshots best-first until one applies; keeps
+        re-discovering while none are available (reference: SyncAny
+        retries discovery instead of failing on a slow peer)."""
         await asyncio.sleep(discovery_time_s)
         tried: set[SnapshotKey] = set()
+        rounds = 0
         while True:
             best = self._best_snapshot(tried)
             if best is None:
-                raise StatesyncError(
-                    "no viable snapshots (discovered "
-                    f"{len(self.snapshots)})")
+                rounds += 1
+                if rounds >= max_discovery_rounds:
+                    raise StatesyncError(
+                        "no viable snapshots (discovered "
+                        f"{len(self.snapshots)})")
+                self.logger.info("no snapshots yet; rediscovering",
+                                 round=rounds)
+                await asyncio.sleep(discovery_time_s)
+                continue
             tried.add(best)
             try:
                 return await self._sync(best)

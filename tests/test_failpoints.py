@@ -66,3 +66,31 @@ class TestCrashConsistency:
         with tempfile.TemporaryDirectory() as d:
             home = os.path.join(d, "node")
             assert _run(home, target=3) == 0
+
+
+class TestCorruptWALRecovery:
+    def test_node_repairs_corrupt_wal_and_restarts(self):
+        """Append garbage to the WAL tail (torn/corrupt write), restart:
+        the node truncates the corrupt tail, keeps a forensics copy, and
+        keeps committing (reference: state.go OnStart repair retry)."""
+        import glob
+
+        with tempfile.TemporaryDirectory() as d:
+            home = os.path.join(d, "node")
+            assert _run(home, target=5) == 0
+            wal = os.path.join(home, "data", "cs.wal", "wal")
+            if not os.path.exists(wal):
+                cands = glob.glob(os.path.join(home, "data", "**",
+                                               "wal*"),
+                                  recursive=True)
+                assert cands, "no WAL file found"
+                wal = cands[0]
+            with open(wal, "r+b") as f:
+                f.seek(0, 2)
+                size = f.tell()
+                # corrupt the last frame's payload bytes
+                f.seek(max(0, size - 20))
+                f.write(b"\xde\xad\xbe\xef" * 5)
+            assert _run(home, target=8) == 0, \
+                "node failed to recover from corrupt WAL"
+            assert os.path.exists(wal + ".corrupted")

@@ -46,7 +46,6 @@ class TestStatesyncE2E:
                 _mk_home(d, "a", cfg_a)
                 cfg_a.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg_a.rpc.laddr = "tcp://127.0.0.1:0"
-                cfg_a.consensus.timeout_commit = 0.05
                 pv = FilePV.generate(
                     cfg_a.base.path(cfg_a.base.priv_validator_key_file),
                     cfg_a.base.path(
@@ -82,7 +81,6 @@ class TestStatesyncE2E:
                     _mk_home(d, "b", cfg_b)
                     cfg_b.p2p.laddr = "tcp://127.0.0.1:0"
                     cfg_b.rpc.laddr = ""
-                    cfg_b.consensus.timeout_commit = 0.05
                     cfg_b.statesync.enable = True
                     cfg_b.statesync.rpc_servers = [rpc_a]
                     cfg_b.statesync.trust_height = 1
