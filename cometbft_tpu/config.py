@@ -49,6 +49,19 @@ class RPCConfig:
 
 
 @dataclass
+class GRPCConfig:
+    """gRPC data-companion API (reference: config.go GRPCConfig —
+    grpc.laddr plus a separate privileged endpoint whose pruning
+    service lets an external companion drive retain heights)."""
+    laddr: str = ""                       # e.g. "tcp://127.0.0.1:26670"
+    version_service_enabled: bool = True
+    block_service_enabled: bool = True
+    block_results_service_enabled: bool = True
+    privileged_laddr: str = ""            # e.g. "tcp://127.0.0.1:26671"
+    pruning_service_enabled: bool = False
+
+
+@dataclass
 class P2PConfig:
     laddr: str = "tcp://0.0.0.0:26656"
     external_address: str = ""
@@ -154,6 +167,7 @@ class InstrumentationConfig:
 class Config:
     base: BaseConfig = field(default_factory=BaseConfig)
     rpc: RPCConfig = field(default_factory=RPCConfig)
+    grpc: GRPCConfig = field(default_factory=GRPCConfig)
     p2p: P2PConfig = field(default_factory=P2PConfig)
     mempool: MempoolConfig = field(default_factory=MempoolConfig)
     statesync: StateSyncConfig = field(default_factory=StateSyncConfig)
@@ -173,8 +187,8 @@ def validate_basic(cfg: Config) -> None:
     """Per-section sanity checks (reference: config.go ValidateBasic on
     every sub-config, called from the root command).  Raises
     ConfigError with the offending section.key."""
-    if cfg.base.db_backend not in ("memdb", "sqlite", "goleveldb",
-                                   "pebbledb"):
+    if cfg.base.db_backend not in ("memdb", "mem", "sqlite",
+                                   "goleveldb", "pebbledb"):
         raise ConfigError(
             f"base.db_backend: unknown backend {cfg.base.db_backend!r}")
     if cfg.rpc.max_body_bytes <= 0:

@@ -47,11 +47,40 @@ class WAL:
         self._path = path
         self._head_size_limit = head_size_limit
         self._total_size_limit = total_size_limit
-        self._f = open(path, "ab")
+        self._f = self._open_head()
+
+    def _open_head(self):
+        """Open the head for append, truncating any torn tail first.
+        A crash mid-write leaves a partial frame at EOF; appending
+        after it would make every later (valid) frame unreachable to
+        replay, which stops at the first bad frame."""
+        if os.path.exists(self._path):
+            with open(self._path, "rb") as f:
+                data = f.read()
+            good = _scan_valid_prefix(data)
+            if good < len(data):
+                # keep a forensics copy of the cut bytes (mirrors
+                # repair_wal_file's .corrupted stash)
+                with open(self._path + ".corrupted", "ab") as f:
+                    f.write(data[good:])
+                with open(self._path, "r+b") as f:
+                    f.truncate(good)
+        return open(self._path, "ab")
 
     @property
     def path(self) -> str:
         return self._path
+
+    def reopen(self) -> None:
+        """Re-acquire the head-file handle.  Required after
+        repair_wal_file: repair may rename the head to .corrupted and
+        recreate it, and an already-open append handle would keep
+        writing to the renamed inode."""
+        try:
+            self._f.close()
+        except OSError:
+            pass
+        self._f = self._open_head()
 
     def write(self, msg: dict) -> None:
         """Buffered append (reference: WAL.Write for peer messages)."""

@@ -18,6 +18,11 @@ from ..wire import abci_pb, encode, decode
 _TX_RESULT = b"tx/"
 _TX_EVENT = b"te/"
 _BLOCK_EVENT = b"be/"
+_BLOCK_HEIGHT_REG = b"bh/"      # height -> hex key list (for pruning)
+
+
+def _hex(k: bytes) -> bytes:
+    return k.hex().encode()
 _BLOCK_HEIGHT_KEY = "block.height"
 _TX_HEIGHT_KEY = "tx.height"
 _TX_HASH_KEY = "tx.hash"
@@ -56,6 +61,42 @@ class TxIndexer:
                              str(tx_result.height), tx_result.height,
                              h), h)
         batch.write()
+
+    def prune(self, from_height: int, to_height: int) -> int:
+        """Delete indexed txs with height in [from, to) (reference:
+        state/txindex/kv Prune, driven by the pruning service).  The
+        txs at each height are found via the implicit tx.height index
+        entries, then their event keys are recomputed from the stored
+        TxResult — deletion is proportional to the data pruned, not
+        the index size."""
+        if to_height <= from_height:
+            return 0
+        pruned = 0
+        batch = self._db.new_batch()
+        for h in range(from_height, to_height):
+            hk = _event_key(_TX_EVENT, _TX_HEIGHT_KEY, str(h), h, b"")
+            for k, tx_hash_ in list(self._db.iterator(
+                    hk, hk + b"\xff" * 40)):
+                raw = self._db.get(_TX_RESULT + tx_hash_)
+                # only delete the stored record if it belongs to THIS
+                # height — the same tx hash re-committed later
+                # overwrites the record, and the retained copy must
+                # survive (its event keys embed the later height)
+                if raw is not None:
+                    d = decode(abci_pb.TX_RESULT, raw)
+                    if d.get("height", 0) == h:
+                        res = _exec_result_from_proto(
+                            d.get("result") or {})
+                        for composite, value in _iter_event_attrs(
+                                res.events):
+                            batch.delete(_event_key(
+                                _TX_EVENT, composite, value, h,
+                                tx_hash_))
+                        batch.delete(_TX_RESULT + tx_hash_)
+                        pruned += 1
+                batch.delete(k)
+        batch.write()
+        return pruned
 
     def get(self, tx_hash_: bytes) -> Optional[abci.TxResult]:
         raw = self._db.get(_TX_RESULT + tx_hash_)
@@ -96,12 +137,56 @@ class BlockIndexer:
     def index(self, height: int, events: list) -> None:
         batch = self._db.new_batch()
         tie = struct.pack(">q", height)
-        batch.set(_event_key(_BLOCK_EVENT, _BLOCK_HEIGHT_KEY,
-                             str(height), height, tie), tie)
+        keys = [_event_key(_BLOCK_EVENT, _BLOCK_HEIGHT_KEY,
+                           str(height), height, tie)]
         for composite, value in _iter_event_attrs(events):
-            batch.set(_event_key(_BLOCK_EVENT, composite, value,
-                                 height, tie), tie)
+            keys.append(_event_key(_BLOCK_EVENT, composite, value,
+                                   height, tie))
+        for k in keys:
+            batch.set(k, tie)
+        # per-height registry of emitted keys so pruning touches only
+        # the pruned heights (keys can't be recomputed from height
+        # alone — the events aren't stored here)
+        batch.set(_BLOCK_HEIGHT_REG + tie,
+                  b"\x00".join(_hex(k) for k in keys))
         batch.write()
+
+    def prune(self, from_height: int, to_height: int) -> int:
+        """Delete block-event index entries with height in [from, to)
+        (reference: state/indexer/block/kv Prune).  Uses the
+        per-height key registry written by index(), so the pass only
+        touches the pruned heights."""
+        if to_height <= from_height:
+            return 0
+        pruned = 0
+        need_scan = False
+        batch = self._db.new_batch()
+        for h in range(from_height, to_height):
+            reg_key = _BLOCK_HEIGHT_REG + struct.pack(">q", h)
+            reg = self._db.get(reg_key)
+            if reg is None:
+                # height indexed before the registry existed — fall
+                # back to one legacy scan below rather than silently
+                # leaking its entries past the watermark
+                need_scan = True
+                continue
+            for hexkey in reg.split(b"\x00"):
+                if hexkey:
+                    batch.delete(bytes.fromhex(hexkey.decode()))
+                    pruned += 1
+            batch.delete(reg_key)
+        if need_scan:
+            for k, _ in list(self._db.iterator(
+                    _BLOCK_EVENT, _BLOCK_EVENT + b"\xff" * 64)):
+                # key tail is fixed-width: ...<height:8>\0<tie:8>
+                if len(k) < 17 or k[-9] != 0:
+                    continue
+                h = struct.unpack(">q", k[-17:-9])[0]
+                if from_height <= h < to_height:
+                    batch.delete(k)
+                    pruned += 1
+        batch.write()
+        return pruned
 
     def search(self, query: Query, limit: int = 100) -> list[int]:
         result: Optional[set[int]] = None

@@ -219,8 +219,14 @@ class Node:
         self.pruner = Pruner(
             self.state_store, self.block_store,
             new_db("pruner", cfg.base.db_backend,
-                   cfg.base.path(cfg.base.db_dir)))
-        await self.pruner.start()
+                   cfg.base.path(cfg.base.db_dir)),
+            # must be known BEFORE the first prune pass: with a
+            # companion configured, blocks it hasn't released must
+            # survive restarts
+            companion_enabled=bool(cfg.grpc.privileged_laddr and
+                                   cfg.grpc.pruning_service_enabled))
+        # started below, once the indexers are attached — a pass that
+        # ran before attachment would skip indexer pruning
 
         # evidence pool
         from ..evidence import EvidencePool
@@ -244,6 +250,10 @@ class Node:
             self.tx_indexer = None
             self.block_indexer = None
             self.indexer_service = None
+        # companion pruning covers the indexers too (pruner.go)
+        self.pruner.tx_indexer = self.tx_indexer
+        self.pruner.block_indexer = self.block_indexer
+        await self.pruner.start()
 
         block_exec = BlockExecutor(
             self.state_store, self.app_conns.consensus,
@@ -280,6 +290,9 @@ class Node:
                 # the valid prefix, stash the corrupt tail, replay again
                 from ..consensus.wal import repair_wal_file
                 dropped = repair_wal_file(wal_path)
+                # repair may have renamed the head file out from under
+                # the already-open append handle
+                self.consensus_state.wal.reopen()
                 self.logger.error(
                     "WAL corrupted; repaired by truncating",
                     err=str(e), dropped_bytes=dropped)
@@ -369,6 +382,27 @@ class Node:
             self._rpc_server = RPCServer(self, cfg.rpc)
             await self._rpc_server.start()
 
+        # gRPC data-companion services (reference: node.go grpcSrv +
+        # grpcPrivSrv, config.go GRPCConfig)
+        if cfg.grpc.laddr:
+            from ..rpc.grpc import GRPCServer
+            self._grpc_server = GRPCServer(
+                block_store=self.block_store,
+                state_store=self.state_store,
+                event_bus=self.event_bus,
+                version_service=cfg.grpc.version_service_enabled,
+                block_service=cfg.grpc.block_service_enabled,
+                block_results_service=(
+                    cfg.grpc.block_results_service_enabled))
+            await self._grpc_server.start(cfg.grpc.laddr)
+        if cfg.grpc.privileged_laddr and \
+                cfg.grpc.pruning_service_enabled:
+            from ..rpc.grpc import GRPCServer
+            self._grpc_priv_server = GRPCServer(
+                pruner=self.pruner, pruning_service=True)
+            await self._grpc_priv_server.start(
+                cfg.grpc.privileged_laddr)
+
         await self.switch.start()
         if cfg.p2p.persistent_peers:
             addrs = [a.strip() for a in
@@ -420,6 +454,10 @@ class Node:
         await self.switch.stop()
         if self._rpc_server is not None:
             await self._rpc_server.stop()
+        if getattr(self, "_grpc_server", None) is not None:
+            await self._grpc_server.stop()
+        if getattr(self, "_grpc_priv_server", None) is not None:
+            await self._grpc_priv_server.stop()
         await self.app_conns.stop()
         if getattr(self, "_signer_endpoint", None) is not None:
             await self._signer_endpoint.stop()

@@ -1,0 +1,17 @@
+"""gRPC data-companion API.
+
+Reference: rpc/grpc/server/services/{versionservice,blockservice,
+blockresultservice,pruningservice} and the corresponding
+proto/cometbft/services/*/v1 schemas.  Real gRPC on the wire
+(grpc.aio with generic handlers); messages are encoded with the
+engine's descriptor codec (wire/proto.py), so no generated stubs are
+needed.
+"""
+from .server import GRPCServer
+from .client import (VersionServiceClient, BlockServiceClient,
+                     BlockResultsServiceClient, PruningServiceClient)
+
+__all__ = [
+    "GRPCServer", "VersionServiceClient", "BlockServiceClient",
+    "BlockResultsServiceClient", "PruningServiceClient",
+]

@@ -1,0 +1,251 @@
+"""gRPC data-companion server.
+
+Reference: rpc/grpc/server/server.go (Serve/ServePrivileged) and the
+four services under rpc/grpc/server/services/.  Built on grpc.aio
+generic handlers — each method is registered by full name with the
+engine's descriptor codec as (de)serializer, which keeps the wire
+format identical to the reference schemas without generated stubs.
+"""
+from __future__ import annotations
+
+import asyncio
+from typing import Optional
+
+import grpc
+
+from ...libs.log import Logger, new_logger
+from ...wire import encode, decode
+from ... import version as ver
+from . import pb
+
+
+def _grpc_addr(laddr: str) -> str:
+    """tcp://host:port → host:port (grpc target syntax)."""
+    if "://" in laddr:
+        laddr = laddr.split("://", 1)[1]
+    return laddr
+
+
+class _Handlers(grpc.GenericRpcHandler):
+    """Routes /<service>/<method> to registered method handlers."""
+
+    def __init__(self):
+        self._methods: dict[str, grpc.RpcMethodHandler] = {}
+
+    def add_unary(self, service: str, method: str, req, resp, fn):
+        self._methods[f"/{service}/{method}"] = \
+            grpc.unary_unary_rpc_method_handler(
+                fn,
+                request_deserializer=lambda b, d=req: decode(d, b),
+                response_serializer=lambda m, d=resp: encode(d, m))
+
+    def add_server_stream(self, service: str, method: str, req, resp,
+                          fn):
+        self._methods[f"/{service}/{method}"] = \
+            grpc.unary_stream_rpc_method_handler(
+                fn,
+                request_deserializer=lambda b, d=req: decode(d, b),
+                response_serializer=lambda m, d=resp: encode(d, m))
+
+    def service(self, handler_call_details):
+        return self._methods.get(handler_call_details.method)
+
+
+class GRPCServer:
+    """One listener exposing a configured subset of the companion
+    services.  The pruning service belongs on a separate privileged
+    listener (reference: config.go GRPCConfig.Privileged)."""
+
+    def __init__(self, *, block_store=None, state_store=None,
+                 event_bus=None, pruner=None,
+                 version_service: bool = False,
+                 block_service: bool = False,
+                 block_results_service: bool = False,
+                 pruning_service: bool = False,
+                 logger: Optional[Logger] = None):
+        self.block_store = block_store
+        self.state_store = state_store
+        self.event_bus = event_bus
+        self.pruner = pruner
+        self.logger = logger or new_logger("grpc")
+        self._server: Optional[grpc.aio.Server] = None
+        self.port: Optional[int] = None
+
+        self._handlers = _Handlers()
+        if version_service:
+            self._register_version()
+        if block_service:
+            self._register_block()
+        if block_results_service:
+            self._register_block_results()
+        if pruning_service:
+            self._register_pruning()
+
+    # -- lifecycle ---------------------------------------------------------
+    async def start(self, laddr: str) -> int:
+        self._server = grpc.aio.server()
+        self._server.add_generic_rpc_handlers((self._handlers,))
+        self.port = self._server.add_insecure_port(_grpc_addr(laddr))
+        await self._server.start()
+        self.logger.info("gRPC server listening", addr=laddr,
+                         port=self.port)
+        return self.port
+
+    async def stop(self) -> None:
+        if self._server is not None:
+            await self._server.stop(grace=1.0)
+            self._server = None
+
+    # -- version service ---------------------------------------------------
+    def _register_version(self) -> None:
+        async def get_version(req, ctx):
+            return {"node": ver.CMT_SEM_VER, "abci": ver.ABCI_SEM_VER,
+                    "p2p": ver.P2P_PROTOCOL,
+                    "block": ver.BLOCK_PROTOCOL}
+        self._handlers.add_unary(
+            pb.VERSION_SERVICE, "GetVersion",
+            pb.GET_VERSION_REQUEST, pb.GET_VERSION_RESPONSE,
+            get_version)
+
+    # -- block service -----------------------------------------------------
+    def _register_block(self) -> None:
+        async def get_by_height(req, ctx):
+            height = req.get("height", 0)
+            store = self.block_store
+            if height == 0:
+                height = store.height
+            if height < store.base or height > store.height:
+                await ctx.abort(
+                    grpc.StatusCode.NOT_FOUND,
+                    f"height {height} not in store "
+                    f"[{store.base},{store.height}]")
+            block = store.load_block(height)
+            meta = store.load_block_meta(height)
+            if block is None or meta is None:
+                await ctx.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no block at height {height}")
+            return {"block_id": meta.block_id.to_proto(),
+                    "block": block.to_proto()}
+
+        async def get_latest_height(req, ctx):
+            """Long-lived stream of committed heights (reference:
+            blockservice GetLatestHeight)."""
+            from ...types import events as ev
+            sub = self.event_bus.subscribe(
+                f"grpc-latest-height-{id(ctx)}",
+                ev.EVENT_QUERY_NEW_BLOCK_HEADER, out_capacity=16)
+            try:
+                h = self.block_store.height
+                if h > 0:
+                    yield {"height": h}
+                while True:
+                    msg = await sub.next()
+                    yield {"height": msg.data.payload["header"].height}
+            finally:
+                self.event_bus.unsubscribe_all(
+                    f"grpc-latest-height-{id(ctx)}")
+
+        self._handlers.add_unary(
+            pb.BLOCK_SERVICE, "GetByHeight",
+            pb.GET_BY_HEIGHT_REQUEST, pb.GET_BY_HEIGHT_RESPONSE,
+            get_by_height)
+        self._handlers.add_server_stream(
+            pb.BLOCK_SERVICE, "GetLatestHeight",
+            pb.GET_LATEST_HEIGHT_REQUEST, pb.GET_LATEST_HEIGHT_RESPONSE,
+            get_latest_height)
+
+    # -- block results service ---------------------------------------------
+    def _register_block_results(self) -> None:
+        async def get_block_results(req, ctx):
+            height = req.get("height", 0)
+            if height == 0:
+                height = self.block_store.height
+            if height < 0 or height > self.block_store.height:
+                await ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                f"height {height} out of range")
+            resp = self.state_store.load_finalize_block_response(height)
+            if resp is None:
+                await ctx.abort(grpc.StatusCode.NOT_FOUND,
+                                f"no results for height {height}")
+            from ...state.store import _fbr_to_proto
+            d = _fbr_to_proto(resp)
+            return {"height": height,
+                    "tx_results": d.get("tx_results", []),
+                    "finalize_block_events": d.get("events", []),
+                    "validator_updates": d.get("validator_updates", []),
+                    **({"consensus_param_updates":
+                        d["consensus_param_updates"]}
+                       if d.get("consensus_param_updates") else {}),
+                    "app_hash": d.get("app_hash", b"")}
+
+        self._handlers.add_unary(
+            pb.BLOCK_RESULTS_SERVICE, "GetBlockResults",
+            pb.GET_BLOCK_RESULTS_REQUEST, pb.GET_BLOCK_RESULTS_RESPONSE,
+            get_block_results)
+
+    # -- pruning service (privileged) --------------------------------------
+    def _register_pruning(self) -> None:
+        def _setter(set_fn):
+            async def handler(req, ctx):
+                try:
+                    set_fn(req.get("height", 0))
+                except ValueError as e:
+                    await ctx.abort(grpc.StatusCode.INVALID_ARGUMENT,
+                                    str(e))
+                return {}
+            return handler
+
+        p = self.pruner
+        svc = pb.PRUNING_SERVICE
+        add = self._handlers.add_unary
+
+        add(svc, "SetBlockRetainHeight",
+            pb.SET_BLOCK_RETAIN_HEIGHT_REQUEST,
+            pb.SET_BLOCK_RETAIN_HEIGHT_RESPONSE,
+            _setter(p.set_companion_retain_height))
+
+        async def get_block_retain(req, ctx):
+            return {"app_retain_height":
+                    p.get_application_retain_height(),
+                    "pruning_service_retain_height":
+                    p.get_companion_retain_height()}
+        add(svc, "GetBlockRetainHeight",
+            pb.GET_BLOCK_RETAIN_HEIGHT_REQUEST,
+            pb.GET_BLOCK_RETAIN_HEIGHT_RESPONSE, get_block_retain)
+
+        add(svc, "SetBlockResultsRetainHeight",
+            pb.SET_BLOCK_RESULTS_RETAIN_HEIGHT_REQUEST,
+            pb.SET_BLOCK_RESULTS_RETAIN_HEIGHT_RESPONSE,
+            _setter(p.set_abci_results_retain_height))
+
+        async def get_results_retain(req, ctx):
+            return {"pruning_service_retain_height":
+                    p.get_abci_results_retain_height()}
+        add(svc, "GetBlockResultsRetainHeight",
+            pb.GET_BLOCK_RESULTS_RETAIN_HEIGHT_REQUEST,
+            pb.GET_BLOCK_RESULTS_RETAIN_HEIGHT_RESPONSE,
+            get_results_retain)
+
+        add(svc, "SetTxIndexerRetainHeight",
+            pb.SET_TX_INDEXER_RETAIN_HEIGHT_REQUEST,
+            pb.SET_TX_INDEXER_RETAIN_HEIGHT_RESPONSE,
+            _setter(p.set_tx_indexer_retain_height))
+
+        async def get_tx_indexer_retain(req, ctx):
+            return {"height": p.get_tx_indexer_retain_height()}
+        add(svc, "GetTxIndexerRetainHeight",
+            pb.GET_TX_INDEXER_RETAIN_HEIGHT_REQUEST,
+            pb.GET_TX_INDEXER_RETAIN_HEIGHT_RESPONSE,
+            get_tx_indexer_retain)
+
+        add(svc, "SetBlockIndexerRetainHeight",
+            pb.SET_BLOCK_INDEXER_RETAIN_HEIGHT_REQUEST,
+            pb.SET_BLOCK_INDEXER_RETAIN_HEIGHT_RESPONSE,
+            _setter(p.set_block_indexer_retain_height))
+
+        async def get_block_indexer_retain(req, ctx):
+            return {"height": p.get_block_indexer_retain_height()}
+        add(svc, "GetBlockIndexerRetainHeight",
+            pb.GET_BLOCK_INDEXER_RETAIN_HEIGHT_REQUEST,
+            pb.GET_BLOCK_INDEXER_RETAIN_HEIGHT_RESPONSE,
+            get_block_indexer_retain)

@@ -191,6 +191,20 @@ class Store:
         return _fbr_from_proto(fb) if fb is not None else None
 
     # ------------------------------------------------------------------
+    def prune_abci_responses(self, from_height: int,
+                             to_height: int) -> int:
+        """Delete stored FinalizeBlockResponses in [from, to) — the
+        data-companion artifact class (reference: store.go
+        PruneABCIResponses).  Returns number deleted."""
+        if from_height <= 0 or to_height <= from_height:
+            return 0
+        batch = self._db.new_batch()
+        for h in range(from_height, to_height):
+            batch.delete(_abci_responses_key(h))
+        batch.write()
+        return to_height - from_height
+
+    # ------------------------------------------------------------------
     def prune_states(self, from_height: int, to_height: int,
                      evidence_threshold_height: int) -> int:
         """Delete state records in [from, to) (reference: store.go

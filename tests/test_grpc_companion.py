@@ -1,0 +1,183 @@
+"""gRPC data-companion services.
+
+Reference: rpc/grpc/server/services/* and proto/cometbft/services/*/v1.
+A live node exposes version/block/block-results services on the public
+gRPC listener and the pruning service on the privileged listener; real
+grpc.aio channels drive them.
+"""
+import asyncio
+import os
+import tempfile
+
+import pytest
+
+
+def _make_node_cfg(d: str):
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    home = os.path.join(d, "node")
+    cfg = Config()
+    cfg.base.home = home
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.privileged_laddr = "tcp://127.0.0.1:0"
+    cfg.grpc.pruning_service_enabled = True
+    cfg.consensus.timeout_commit = 0.02
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.generate(
+        cfg.base.path(cfg.base.priv_validator_key_file),
+        cfg.base.path(cfg.base.priv_validator_state_file))
+    NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    GenesisDoc(
+        chain_id="grpc-chain",
+        genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(
+            address=b"", pub_key=pv.get_pub_key(), power=10)],
+    ).save_as(cfg.base.path(cfg.base.genesis_file))
+    return cfg
+
+
+class TestGRPCCompanion:
+    def test_services_against_live_node(self):
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.rpc.grpc import (
+            BlockResultsServiceClient, BlockServiceClient,
+            PruningServiceClient, VersionServiceClient,
+        )
+        from cometbft_tpu import version as ver
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                cfg = _make_node_cfg(d)
+                node = Node(cfg)
+                await node.start()
+                try:
+                    for _ in range(400):
+                        if node.height >= 6:
+                            break
+                        await asyncio.sleep(0.02)
+                    assert node.height >= 6
+                    addr = f"127.0.0.1:{node._grpc_server.port}"
+                    priv = f"127.0.0.1:{node._grpc_priv_server.port}"
+
+                    async with VersionServiceClient(addr) as vc:
+                        v = await vc.get_version()
+                    assert v["node"] == ver.CMT_SEM_VER
+                    assert v["block"] == ver.BLOCK_PROTOCOL
+
+                    async with BlockServiceClient(addr) as bc:
+                        b3 = await bc.get_by_height(3)
+                        assert b3["block"]["header"]["height"] == 3
+                        assert b3["block_id"]["hash"]
+                        latest = await bc.get_by_height()
+                        assert latest["block"]["header"]["height"] >= 6
+                        # stream: first yield is the current height,
+                        # then newly committed heights
+                        heights = []
+                        async for h in bc.get_latest_height():
+                            heights.append(h)
+                            if len(heights) >= 3:
+                                break
+                        assert heights[0] >= 6
+                        assert heights[1] >= heights[0]
+                        # NOT_FOUND for pruned-or-future heights
+                        import grpc as grpclib
+                        with pytest.raises(grpclib.aio.AioRpcError) as ei:
+                            await bc.get_by_height(10_000)
+                        assert ei.value.code() == \
+                            grpclib.StatusCode.NOT_FOUND
+
+                    async with BlockResultsServiceClient(addr) as rc:
+                        r = await rc.get_block_results(2)
+                        assert r["height"] == 2
+                        assert r.get("app_hash", b"") != b""
+
+                    async with PruningServiceClient(priv) as pc:
+                        await pc.set_block_retain_height(4)
+                        got = await pc.get_block_retain_height()
+                        assert got["pruning_service_retain_height"] == 4
+                        await pc.set_block_results_retain_height(4)
+                        assert await \
+                            pc.get_block_results_retain_height() == 4
+                        await pc.set_tx_indexer_retain_height(4)
+                        assert await \
+                            pc.get_tx_indexer_retain_height() == 4
+                        await pc.set_block_indexer_retain_height(4)
+                        assert await \
+                            pc.get_block_indexer_retain_height() == 4
+                        # backwards movement is INVALID_ARGUMENT
+                        import grpc as grpclib
+                        with pytest.raises(grpclib.aio.AioRpcError) as ei:
+                            await pc.set_block_retain_height(2)
+                        assert ei.value.code() == \
+                            grpclib.StatusCode.INVALID_ARGUMENT
+
+                    # the companion knobs prune ABCI results once the
+                    # pass runs (blocks wait for the app knob)
+                    node.pruner.prune_once()
+                    assert node.state_store.load_finalize_block_response(
+                        2) is None
+                    assert node.state_store.load_finalize_block_response(
+                        node.height) is not None
+                finally:
+                    await node.stop()
+        asyncio.run(run())
+
+
+class TestPrunerCompanionArtifacts:
+    def test_indexer_and_results_pruning(self):
+        """Unit-level: the pruner drives tx/block indexer pruning and
+        ABCI-result deletion up to the companion retain heights."""
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.db.db import MemDB
+        from cometbft_tpu.indexer import BlockIndexer, TxIndexer
+        from cometbft_tpu.libs.pubsub import Query
+        from cometbft_tpu.state.pruner import Pruner
+
+        class _Stores:
+            height = 10
+            base = 1
+
+        tx_idx = TxIndexer(MemDB())
+        blk_idx = BlockIndexer(MemDB())
+        for h in range(1, 11):
+            ev = abci.Event(type="transfer", attributes=[
+                abci.EventAttribute(key="acct", value=f"a{h}",
+                                    index=True)])
+            tx_idx.index(abci.TxResult(
+                height=h, index=0, tx=b"tx%d" % h,
+                result=abci.ExecTxResult(code=0, events=[ev])))
+            blk_idx.index(h, [ev])
+
+        class _StateStore:
+            def __init__(self):
+                self.deleted = []
+
+            def prune_abci_responses(self, lo, hi):
+                self.deleted.append((lo, hi))
+                return hi - lo
+
+        ss = _StateStore()
+        p = Pruner(ss, _Stores(), MemDB(), companion_enabled=True,
+                   tx_indexer=tx_idx, block_indexer=blk_idx)
+        p.set_abci_results_retain_height(6)
+        p.set_tx_indexer_retain_height(6)
+        p.set_block_indexer_retain_height(6)
+        p.prune_once()
+        assert ss.deleted == [(1, 6)]
+        # indexed txs below 6 are gone, 6+ remain
+        assert tx_idx.search(Query("transfer.acct = 'a3'")) == []
+        assert len(tx_idx.search(Query("transfer.acct = 'a7'"))) == 1
+        assert blk_idx.search(Query("transfer.acct = 'a4'")) == []
+        assert blk_idx.search(Query("transfer.acct = 'a8'")) == [8]
+        # watermark: a second pass re-prunes nothing
+        assert p.prune_once() == (0, 1)
+        # retain heights cannot move backwards
+        with pytest.raises(ValueError):
+            p.set_tx_indexer_retain_height(3)

@@ -225,6 +225,39 @@ class TestPrunerAndWALRotation:
             assert tail[0]["height"] == 16
             assert WAL.search_for_end_height(path, 99) is None
 
+    def test_repair_with_open_handle_writes_to_new_head(self):
+        """Corruption in a ROTATED file makes repair rename the head
+        to .corrupted; an already-open WAL must reopen so later writes
+        land in the recreated head, not the renamed inode."""
+        import os
+        import tempfile
+
+        from cometbft_tpu.consensus.wal import WAL, repair_wal_file
+
+        with tempfile.TemporaryDirectory() as d:
+            path = os.path.join(d, "wal")
+            w = WAL(path, head_size_limit=1024)
+            for h in range(1, 12):
+                for i in range(10):
+                    w.write({"type": "vote", "height": h, "i": i,
+                             "pad": "x" * 48})
+                w.write_end_height(h)
+            w.flush_and_sync()
+            rotated = WAL.group_files(path)[:-1]
+            assert rotated, "needs at least one rotated file"
+            # corrupt the first rotated file mid-way
+            with open(rotated[0], "r+b") as f:
+                f.seek(os.path.getsize(rotated[0]) // 2)
+                f.write(b"\xff" * 16)
+            repair_wal_file(path)
+            w.reopen()                  # what node boot does
+            w.write_sync({"type": "vote", "height": 99, "i": 0})
+            w.close()
+            msgs = list(WAL.iter_group(path))
+            assert any(m.get("height") == 99 for m in msgs), \
+                "post-repair write lost"
+            assert os.path.getsize(path) > 0
+
     def test_wal_total_size_cap_drops_oldest(self):
         import os
         import tempfile
