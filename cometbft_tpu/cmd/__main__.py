@@ -15,21 +15,8 @@ import sys
 
 
 def _load_config(home: str):
-    from ..config import Config
-    cfg = Config()
-    cfg.base.home = home
-    cfg_path = os.path.join(home, "config", "config.json")
-    if os.path.exists(cfg_path):
-        with open(cfg_path) as f:
-            overrides = json.load(f)
-        for section, values in overrides.items():
-            target = getattr(cfg, section, None)
-            if target is None:
-                continue
-            for k, v in values.items():
-                if hasattr(target, k):
-                    setattr(target, k, v)
-    return cfg
+    from ..confix import effective_config
+    return effective_config(home)
 
 
 def cmd_init(args) -> int:
@@ -136,6 +123,113 @@ def cmd_config_validate(args) -> int:
         print(f"config invalid: {e}")
         return 1
     print("config is valid")
+    return 0
+
+
+def cmd_config_view(args) -> int:
+    """Print the effective config (defaults + overrides) as JSON
+    (reference: confix view)."""
+    from .. import confix
+    print(json.dumps(
+        confix.config_to_dict(confix.effective_config(args.home)),
+        indent=2, sort_keys=True))
+    return 0
+
+
+def cmd_config_get(args) -> int:
+    from .. import confix
+    try:
+        print(json.dumps(confix.get_value(args.home, args.key)))
+    except KeyError:
+        print(f"unknown key {args.key!r}")
+        return 1
+    return 0
+
+
+def cmd_config_set(args) -> int:
+    from .. import confix
+    try:
+        v = confix.set_value(args.home, args.key, args.value)
+    except (KeyError, ValueError) as e:
+        print(f"cannot set {args.key!r}: {e}")
+        return 1
+    print(f"{args.key} = {json.dumps(v)}")
+    return 0
+
+
+def cmd_config_diff(args) -> int:
+    """Show overrides differing from defaults plus unknown entries
+    (reference: confix diff)."""
+    from .. import confix
+    print(json.dumps(confix.diff_from_defaults(args.home), indent=2,
+                     sort_keys=True))
+    return 0
+
+
+def cmd_config_migrate(args) -> int:
+    """Normalize the persisted config against the current schema
+    (reference: confix migrate)."""
+    from .. import confix
+    log = confix.migrate(args.home, dry_run=args.dry_run)
+    for line in log:
+        print(("would have " if args.dry_run else "") + line)
+    if not log:
+        print("config already up to date")
+    return 0
+
+
+def cmd_generate_manifests(args) -> int:
+    """Reference: test/e2e/generator — write N random manifests."""
+    from ..tools.manifest import generate
+
+    os.makedirs(args.o, exist_ok=True)
+    for i in range(args.n):
+        m = generate(seed=args.seed + i)
+        path = os.path.join(args.o, f"gen-{args.seed + i:03d}.json")
+        m.save(path)
+        print(path)
+    return 0
+
+
+def cmd_load(args) -> int:
+    """Timestamped-tx load generation (reference: test/loadtime
+    cmd/load)."""
+    import asyncio
+
+    from ..tools import loadtime
+
+    async def run():
+        res = await loadtime.generate(
+            args.endpoints.split(","), rate=args.rate,
+            connections=args.connections,
+            duration_s=args.duration, size=args.size,
+            method=args.broadcast_tx_method)
+        print(json.dumps({
+            "experiment_id": res.experiment_id, "sent": res.sent,
+            "accepted": res.accepted, "errors": res.errors,
+            "duration_s": round(res.duration_s, 3)}))
+        if args.report:
+            rep = await loadtime.report(
+                args.endpoints.split(",")[0],
+                experiment_id=res.experiment_id)
+            print(json.dumps(rep.to_dict()))
+    asyncio.run(run())
+    return 0
+
+
+def cmd_load_report(args) -> int:
+    """Latency + block-interval report over committed blocks
+    (reference: test/loadtime cmd/report + e2e runner/benchmark.go)."""
+    import asyncio
+
+    from ..tools import loadtime
+
+    async def run():
+        rep = await loadtime.report(
+            args.endpoint, experiment_id=args.experiment_id or None,
+            from_height=args.from_height, to_height=args.to_height)
+        print(json.dumps(rep.to_dict(), indent=2))
+    asyncio.run(run())
     return 0
 
 
@@ -453,6 +547,52 @@ def main(argv=None) -> int:
     cfgsub = sp.add_subparsers(dest="config_cmd", required=True)
     cv = cfgsub.add_parser("validate", help="validate the config file")
     cv.set_defaults(fn=cmd_config_validate)
+    cv = cfgsub.add_parser("view", help="print the effective config")
+    cv.set_defaults(fn=cmd_config_view)
+    cv = cfgsub.add_parser("get", help="print one config value")
+    cv.add_argument("key", help="section.key")
+    cv.set_defaults(fn=cmd_config_get)
+    cv = cfgsub.add_parser("set", help="persist one config value")
+    cv.add_argument("key", help="section.key")
+    cv.add_argument("value")
+    cv.set_defaults(fn=cmd_config_set)
+    cv = cfgsub.add_parser("diff",
+                           help="show changes vs the defaults")
+    cv.set_defaults(fn=cmd_config_diff)
+    cv = cfgsub.add_parser(
+        "migrate", help="normalize the config file to this schema")
+    cv.add_argument("--dry-run", action="store_true")
+    cv.set_defaults(fn=cmd_config_migrate)
+
+    sp = sub.add_parser(
+        "generate-manifests",
+        help="randomly sample testnet manifests (e2e generator)")
+    sp.add_argument("-o", default=".", help="output directory")
+    sp.add_argument("-n", type=int, default=4,
+                    help="number of manifests")
+    sp.add_argument("--seed", type=int, default=0)
+    sp.set_defaults(fn=cmd_generate_manifests)
+
+    sp = sub.add_parser("load", help="generate timestamped tx load")
+    sp.add_argument("--endpoints", required=True,
+                    help="comma-separated RPC base URLs")
+    sp.add_argument("--rate", type=int, default=100)
+    sp.add_argument("--connections", type=int, default=1)
+    sp.add_argument("--duration", type=float, default=10.0)
+    sp.add_argument("--size", type=int, default=256)
+    sp.add_argument("--broadcast-tx-method", default="sync",
+                    choices=["sync", "async"])
+    sp.add_argument("--report", action="store_true",
+                    help="print the latency report afterwards")
+    sp.set_defaults(fn=cmd_load)
+
+    sp = sub.add_parser(
+        "load-report", help="latency report over committed blocks")
+    sp.add_argument("--endpoint", required=True)
+    sp.add_argument("--experiment-id", default="")
+    sp.add_argument("--from-height", type=int, default=0)
+    sp.add_argument("--to-height", type=int, default=0)
+    sp.set_defaults(fn=cmd_load_report)
 
     sp = sub.add_parser(
         "inspect", help="read-only RPC over a stopped node's data")

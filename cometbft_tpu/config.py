@@ -32,6 +32,9 @@ class BaseConfig:
     priv_validator_laddr: str = ""
     node_key_file: str = "config/node_key.json"
     filter_peers: bool = False
+    # record the ABCI call trace for the grammar checker
+    # (reference: the e2e app's request recording)
+    abci_grammar_trace: bool = False
 
     def path(self, rel: str) -> str:
         return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
@@ -152,7 +155,10 @@ class StorageConfig:
 
 @dataclass
 class TxIndexConfig:
-    indexer: str = "kv"
+    indexer: str = "kv"          # kv | psql | null
+    # for the psql sink: database target (an embedded-engine path in
+    # this build; reference: config.go TxIndexConfig.PsqlConn)
+    psql_conn: str = ""
 
 
 @dataclass
@@ -233,9 +239,9 @@ def validate_basic(cfg: Config) -> None:
     if cfg.consensus.create_empty_blocks_interval_ns < 0:
         raise ConfigError(
             "consensus.create_empty_blocks_interval cannot be negative")
-    if cfg.tx_index.indexer not in ("kv", "null"):
+    if cfg.tx_index.indexer not in ("kv", "psql", "null"):
         raise ConfigError(
-            f"tx_index.indexer must be kv|null, "
+            f"tx_index.indexer must be kv|psql|null, "
             f"got {cfg.tx_index.indexer!r}")
     if cfg.instrumentation.prometheus and \
             not cfg.instrumentation.prometheus_listen_addr:

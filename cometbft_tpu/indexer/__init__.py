@@ -1,5 +1,7 @@
 """Tx and block event indexing for RPC search queries."""
 from .kv import BlockIndexer, TxIndexer
 from .service import IndexerService
+from .sink_sql import SQLEventSink
 
-__all__ = ["BlockIndexer", "TxIndexer", "IndexerService"]
+__all__ = ["BlockIndexer", "TxIndexer", "IndexerService",
+           "SQLEventSink"]

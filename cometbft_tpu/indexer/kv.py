@@ -110,13 +110,14 @@ class TxIndexer:
 
     def search(self, query: Query, limit: int = 100) -> list[bytes]:
         """Tx hashes whose indexed events satisfy the query (AND of
-        conditions, like the reference's kv search)."""
+        conditions, like the reference's kv search).  Equality
+        conditions narrow the scan to the exact value's key range."""
         result: Optional[set[bytes]] = None
         for cond in query.conditions:
             matches = set()
             prefix = _TX_EVENT + cond.key.encode() + b"\x00"
-            for k, v in self._db.iterator(prefix,
-                                          prefix + b"\xff" * 64):
+            lo, hi = _cond_range(prefix, cond)
+            for k, v in self._db.iterator(lo, hi):
                 rest = k[len(prefix):]
                 value = rest.split(b"\x00", 1)[0].decode(
                     errors="replace")
@@ -193,8 +194,8 @@ class BlockIndexer:
         for cond in query.conditions:
             matches = set()
             prefix = _BLOCK_EVENT + cond.key.encode() + b"\x00"
-            for k, v in self._db.iterator(prefix,
-                                          prefix + b"\xff" * 64):
+            lo, hi = _cond_range(prefix, cond)
+            for k, v in self._db.iterator(lo, hi):
                 rest = k[len(prefix):]
                 value = rest.split(b"\x00", 1)[0].decode(
                     errors="replace")
@@ -204,6 +205,19 @@ class BlockIndexer:
             if not result:
                 return []
         return sorted(result or [])[:limit]
+
+
+def _cond_range(prefix: bytes, cond) -> tuple[bytes, bytes]:
+    """Key range for one condition scan.  String equality narrows to
+    the exact value's range (O(matches) instead of O(all values for
+    the key)); everything else scans the composite-key prefix.
+    Numeric equality can't narrow: '7' matches event value '7.0'."""
+    from ..libs.pubsub import _as_number
+    if cond.op == "=" and isinstance(cond.value, str) and \
+            _as_number(cond.value) is None:
+        exact = prefix + cond.value.encode() + b"\x00"
+        return exact, exact + b"\xff" * 64
+    return prefix, prefix + b"\xff" * 64
 
 
 def _iter_event_attrs(events):

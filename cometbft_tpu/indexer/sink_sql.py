@@ -1,0 +1,263 @@
+"""SQL event sink.
+
+Reference: state/indexer/sink/psql (psql.go + schema.sql) — an
+operator-queryable relational mirror of block/tx events.  The
+reference targets PostgreSQL; this build uses the embedded SQLite
+engine with the SAME relational schema (blocks, tx_results, events,
+attributes + the joined views), so operator SQL written for the
+reference's views runs unchanged.  Like the reference sink, it is
+write-only from the node's perspective: tx_search/block_search RPCs
+are NOT served from this sink (psql.go returns "not supported" for
+reads) — operators query the database directly.
+"""
+from __future__ import annotations
+
+import sqlite3
+from datetime import datetime, timezone
+from typing import Optional
+
+from ..abci import types as abci
+from ..wire import abci_pb, encode
+
+_SCHEMA = """
+CREATE TABLE IF NOT EXISTS blocks (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  height     BIGINT NOT NULL,
+  chain_id   VARCHAR NOT NULL,
+  created_at TIMESTAMPTZ NOT NULL,
+  UNIQUE (height, chain_id)
+);
+CREATE INDEX IF NOT EXISTS idx_blocks_height_chain
+  ON blocks(height, chain_id);
+CREATE TABLE IF NOT EXISTS tx_results (
+  rowid      INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id   BIGINT NOT NULL REFERENCES blocks(rowid),
+  "index"    INTEGER NOT NULL,
+  created_at TIMESTAMPTZ NOT NULL,
+  tx_hash    VARCHAR NOT NULL,
+  tx_result  BLOB NOT NULL,
+  UNIQUE (block_id, "index")
+);
+CREATE TABLE IF NOT EXISTS events (
+  rowid    INTEGER PRIMARY KEY AUTOINCREMENT,
+  block_id BIGINT NOT NULL REFERENCES blocks(rowid),
+  tx_id    BIGINT NULL REFERENCES tx_results(rowid),
+  type     VARCHAR NOT NULL
+);
+CREATE TABLE IF NOT EXISTS attributes (
+  event_id      BIGINT NOT NULL REFERENCES events(rowid),
+  key           VARCHAR NOT NULL,
+  composite_key VARCHAR NOT NULL,
+  value         VARCHAR NULL,
+  UNIQUE (event_id, key)
+);
+CREATE VIEW IF NOT EXISTS event_attributes AS
+  SELECT block_id, tx_id, type, key, composite_key, value
+  FROM events LEFT JOIN attributes
+    ON (events.rowid = attributes.event_id);
+CREATE VIEW IF NOT EXISTS block_events AS
+  SELECT blocks.rowid as block_id, height, chain_id, type, key,
+         composite_key, value
+  FROM blocks JOIN event_attributes
+    ON (blocks.rowid = event_attributes.block_id)
+  WHERE event_attributes.tx_id IS NULL;
+CREATE VIEW IF NOT EXISTS tx_events AS
+  SELECT height, "index", chain_id, type, key, composite_key, value,
+         tx_results.created_at
+  FROM blocks JOIN tx_results ON (blocks.rowid = tx_results.block_id)
+  JOIN event_attributes ON
+    (tx_results.rowid = event_attributes.tx_id)
+  WHERE event_attributes.tx_id IS NOT NULL;
+"""
+
+
+class SQLEventSink:
+    """Write-side event sink with the reference's psql schema."""
+
+    def __init__(self, conn_str: str, chain_id: str):
+        # conn_str is a filesystem path (or :memory:) — the embedded
+        # engine's analog of the reference's postgres conn string
+        self._conn = sqlite3.connect(conn_str, check_same_thread=False)
+        self._conn.executescript(_SCHEMA)
+        self._conn.commit()
+        self.chain_id = chain_id
+
+    def close(self) -> None:
+        self._conn.close()
+
+    # -- write side --------------------------------------------------------
+    def index_block_events(self, height: int, events: list) -> None:
+        """Reference: psql.go IndexBlockEvents — insert the block row
+        plus its begin/end-block-style events."""
+        now = datetime.now(timezone.utc).isoformat()
+        cur = self._conn.cursor()
+        cur.execute(
+            "INSERT INTO blocks (height, chain_id, created_at) "
+            "VALUES (?, ?, ?) "
+            "ON CONFLICT (height, chain_id) DO UPDATE SET "
+            "created_at = excluded.created_at",
+            (height, self.chain_id, now))
+        cur.execute(
+            "SELECT rowid FROM blocks WHERE height = ? AND "
+            "chain_id = ?", (height, self.chain_id))
+        block_rowid = cur.fetchone()[0]
+        # the reference also records the implicit block.height event
+        self._insert_events(cur, block_rowid, None, [
+            abci.Event(type="block", attributes=[
+                abci.EventAttribute(key="height", value=str(height),
+                                    index=True)])] + list(events or []))
+        self._conn.commit()
+
+    def index_tx_events(self, tx_results: list) -> None:
+        """Reference: psql.go IndexTxEvents — insert tx_results rows
+        and their events (the TxResult proto bytes are stored for
+        round-tripping)."""
+        from ..types.tx import tx_hash
+        now = datetime.now(timezone.utc).isoformat()
+        cur = self._conn.cursor()
+        for txr in tx_results:
+            cur.execute(
+                "SELECT rowid FROM blocks WHERE height = ? AND "
+                "chain_id = ?", (txr.height, self.chain_id))
+            row = cur.fetchone()
+            if row is None:
+                cur.execute(
+                    "INSERT INTO blocks (height, chain_id, created_at)"
+                    " VALUES (?, ?, ?)",
+                    (txr.height, self.chain_id, now))
+                block_rowid = cur.lastrowid
+            else:
+                block_rowid = row[0]
+            raw = encode(abci_pb.TX_RESULT, {
+                **({"height": txr.height} if txr.height else {}),
+                **({"index": txr.index} if txr.index else {}),
+                **({"tx": txr.tx} if txr.tx else {}),
+                "result": _exec_result_proto(txr.result),
+            })
+            cur.execute(
+                "INSERT INTO tx_results "
+                "(block_id, \"index\", created_at, tx_hash, tx_result)"
+                " VALUES (?, ?, ?, ?, ?) "
+                "ON CONFLICT (block_id, \"index\") DO UPDATE SET "
+                "tx_result = excluded.tx_result",
+                (block_rowid, txr.index, now,
+                 tx_hash(txr.tx).hex().upper(), raw))
+            cur.execute(
+                "SELECT rowid FROM tx_results WHERE block_id = ? AND "
+                "\"index\" = ?", (block_rowid, txr.index))
+            tx_rowid = cur.fetchone()[0]
+            implicit = [
+                abci.Event(type="tx", attributes=[
+                    abci.EventAttribute(
+                        key="hash",
+                        value=tx_hash(txr.tx).hex().upper(),
+                        index=True)]),
+                abci.Event(type="tx", attributes=[
+                    abci.EventAttribute(key="height",
+                                        value=str(txr.height),
+                                        index=True)]),
+            ]
+            self._insert_events(cur, block_rowid, tx_rowid,
+                                implicit + list(txr.result.events or []))
+        self._conn.commit()
+
+    def _insert_events(self, cur, block_id: int, tx_id: Optional[int],
+                       events: list) -> None:
+        for ev in events:
+            if not ev.type:
+                continue
+            cur.execute(
+                "INSERT INTO events (block_id, tx_id, type) "
+                "VALUES (?, ?, ?)", (block_id, tx_id, ev.type))
+            event_id = cur.lastrowid
+            for attr in ev.attributes or []:
+                if not attr.key:
+                    continue
+                cur.execute(
+                    "INSERT OR REPLACE INTO attributes "
+                    "(event_id, key, composite_key, value) "
+                    "VALUES (?, ?, ?, ?)",
+                    (event_id, attr.key, f"{ev.type}.{attr.key}",
+                     attr.value))
+
+    # -- adapters so IndexerService can drive the sink ---------------------
+    @property
+    def tx_indexer(self) -> "_SinkTxAdapter":
+        return _SinkTxAdapter(self)
+
+    @property
+    def block_indexer(self) -> "_SinkBlockAdapter":
+        return _SinkBlockAdapter(self)
+
+
+class _SinkTxAdapter:
+    def __init__(self, sink: SQLEventSink):
+        self._sink = sink
+
+    def index(self, tx_result) -> None:
+        self._sink.index_tx_events([tx_result])
+
+    def get(self, tx_hash_: bytes):
+        return None         # reads unsupported (reference psql.go)
+
+    def search(self, query, limit: int = 100) -> list:
+        raise NotImplementedError(
+            "the SQL sink does not serve searches; query the "
+            "database directly (reference: psql sink)")
+
+    def prune(self, from_height: int, to_height: int) -> int:
+        cur = self._sink._conn.cursor()
+        cur.execute(
+            "DELETE FROM attributes WHERE event_id IN "
+            "(SELECT events.rowid FROM events JOIN blocks "
+            " ON events.block_id = blocks.rowid "
+            " WHERE blocks.height >= ? AND blocks.height < ? "
+            " AND events.tx_id IS NOT NULL)",
+            (from_height, to_height))
+        cur.execute(
+            "DELETE FROM events WHERE tx_id IS NOT NULL AND "
+            "block_id IN (SELECT rowid FROM blocks WHERE "
+            "height >= ? AND height < ?)",
+            (from_height, to_height))
+        cur.execute(
+            "DELETE FROM tx_results WHERE block_id IN "
+            "(SELECT rowid FROM blocks WHERE height >= ? AND "
+            "height < ?)", (from_height, to_height))
+        n = cur.rowcount
+        self._sink._conn.commit()
+        return max(n, 0)
+
+
+class _SinkBlockAdapter:
+    def __init__(self, sink: SQLEventSink):
+        self._sink = sink
+
+    def index(self, height: int, events: list) -> None:
+        self._sink.index_block_events(height, events)
+
+    def search(self, query, limit: int = 100) -> list:
+        raise NotImplementedError(
+            "the SQL sink does not serve searches; query the "
+            "database directly (reference: psql sink)")
+
+    def prune(self, from_height: int, to_height: int) -> int:
+        cur = self._sink._conn.cursor()
+        cur.execute(
+            "DELETE FROM attributes WHERE event_id IN "
+            "(SELECT events.rowid FROM events JOIN blocks "
+            " ON events.block_id = blocks.rowid "
+            " WHERE blocks.height >= ? AND blocks.height < ? "
+            " AND events.tx_id IS NULL)",
+            (from_height, to_height))
+        cur.execute(
+            "DELETE FROM events WHERE tx_id IS NULL AND block_id IN "
+            "(SELECT rowid FROM blocks WHERE height >= ? AND "
+            "height < ?)", (from_height, to_height))
+        n = cur.rowcount
+        self._sink._conn.commit()
+        return max(n, 0)
+
+
+def _exec_result_proto(r) -> dict:
+    from .kv import _exec_result_proto as impl
+    return impl(r)

@@ -13,7 +13,7 @@ strings, numbers, dates (treated as strings here).  Tags are multi-valued
 from __future__ import annotations
 
 import asyncio
-import re
+from datetime import datetime, timezone
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
 
@@ -26,11 +26,60 @@ class QueryError(PubSubError):
     pass
 
 
-_COND_RE = re.compile(
-    r"\s*(?P<key>[\w.\-/]+)\s*"
-    r"(?P<op>=|<=|>=|<|>|CONTAINS|EXISTS)\s*"
-    r"(?P<val>'(?:[^'\\]|\\.)*'|[\w.\-:+TZ]+)?\s*$",
-    re.IGNORECASE)
+def _tokenize(s: str) -> list[tuple[str, str]]:
+    """Tokens: ("str", text) for 'quoted' literals (escapes honoured,
+    may contain AND/spaces), ("op", =|<|<=|>|>=), ("word", text) for
+    keys, AND, CONTAINS, EXISTS, DATE, TIME and bare values."""
+    tokens: list[tuple[str, str]] = []
+    i, n = 0, len(s)
+    while i < n:
+        c = s[i]
+        if c.isspace():
+            i += 1
+            continue
+        if c == "'":
+            j, buf = i + 1, []
+            while j < n and s[j] != "'":
+                if s[j] == "\\" and j + 1 < n:
+                    buf.append(s[j + 1])
+                    j += 2
+                else:
+                    buf.append(s[j])
+                    j += 1
+            if j >= n:
+                raise QueryError(f"unterminated string in {s!r}")
+            tokens.append(("str", "".join(buf)))
+            i = j + 1
+            continue
+        if c in "<>=":
+            if s[i:i + 2] in ("<=", ">="):
+                tokens.append(("op", s[i:i + 2]))
+                i += 2
+            else:
+                tokens.append(("op", c))
+                i += 1
+            continue
+        j = i
+        while j < n and not s[j].isspace() and s[j] not in "<>='":
+            j += 1
+        tokens.append(("word", s[i:j]))
+        i = j
+    return tokens
+
+
+def _parse_time_like(raw: str):
+    """RFC3339 timestamp or yyyy-mm-dd date → aware datetime, else
+    None (reference: query grammar TIME/DATE literals)."""
+    txt = raw.strip()
+    if txt.endswith("Z"):
+        txt = txt[:-1] + "+00:00"
+    try:
+        dt = datetime.fromisoformat(txt)
+    except ValueError:
+        return None
+    if dt.tzinfo is None:
+        dt = dt.replace(tzinfo=timezone.utc)
+    return dt
 
 
 def _parse_value(raw: str):
@@ -68,6 +117,15 @@ class Condition:
             return True
         if op == "CONTAINS":
             return str(self.value) in ev_val
+        if isinstance(self.value, datetime):
+            # DATE/TIME literal: the event value must parse as a
+            # timestamp too
+            t = _parse_time_like(ev_val)
+            if t is None:
+                return False
+            v = self.value
+            return {"=": t == v, "<": t < v, "<=": t <= v,
+                    ">": t > v, ">=": t >= v}[op]
         if op == "=":
             n, m = _as_number(self.value), _as_number(ev_val)
             if n is not None and m is not None:
@@ -75,7 +133,7 @@ class Condition:
             return str(self.value) == ev_val
         n, m = _as_number(self.value), _as_number(ev_val)
         if n is None or m is None:
-            # fall back to lexicographic comparison for dates/strings
+            # fall back to lexicographic comparison for strings
             a, b = ev_val, str(self.value)
             return {"<": a < b, "<=": a <= b,
                     ">": a > b, ">=": a >= b}[op]
@@ -90,22 +148,57 @@ class Query:
         self.conditions: list[Condition] = []
         if not self.query_str:
             return
-        for part in re.split(r"\s+AND\s+", self.query_str,
-                             flags=re.IGNORECASE):
-            m = _COND_RE.match(part)
-            if not m:
-                raise QueryError(f"invalid condition {part!r}")
-            op = m.group("op").upper()
-            raw_val = m.group("val")
-            if op == "EXISTS":
-                if raw_val:
-                    raise QueryError(f"EXISTS takes no value: {part!r}")
-                self.conditions.append(Condition(m.group("key"), op))
+        toks = _tokenize(self.query_str)
+        i = 0
+        while i < len(toks):
+            kind, key = toks[i]
+            if kind != "word":
+                raise QueryError(
+                    f"expected key, got {key!r} in {query_str!r}")
+            i += 1
+            if i >= len(toks):
+                raise QueryError(f"missing operator in {query_str!r}")
+            kind, op = toks[i]
+            op_up = op.upper()
+            i += 1
+            if kind == "word" and op_up == "EXISTS":
+                self.conditions.append(Condition(key, "EXISTS"))
+            elif kind == "op" or (kind == "word" and
+                                  op_up == "CONTAINS"):
+                if i >= len(toks):
+                    raise QueryError(f"missing value in {query_str!r}")
+                vkind, vtext = toks[i]
+                i += 1
+                if vkind == "str":
+                    value: Any = vtext
+                elif vtext.upper() in ("DATE", "TIME"):
+                    # DATE yyyy-mm-dd / TIME RFC3339 literal
+                    if i >= len(toks):
+                        raise QueryError(
+                            f"missing {vtext} literal in {query_str!r}")
+                    _, raw = toks[i]
+                    i += 1
+                    value = _parse_time_like(raw)
+                    if value is None:
+                        raise QueryError(
+                            f"bad {vtext} literal {raw!r}")
+                else:
+                    value = _parse_value(vtext)
+                self.conditions.append(
+                    Condition(key, "CONTAINS" if op_up == "CONTAINS"
+                              else op, value))
             else:
-                if raw_val is None:
-                    raise QueryError(f"missing value in {part!r}")
-                self.conditions.append(Condition(
-                    m.group("key"), op, _parse_value(raw_val)))
+                raise QueryError(
+                    f"expected operator, got {op!r} in {query_str!r}")
+            if i < len(toks):
+                kind, word = toks[i]
+                if kind != "word" or word.upper() != "AND":
+                    raise QueryError(
+                        f"expected AND, got {word!r} in {query_str!r}")
+                i += 1
+                if i >= len(toks):
+                    raise QueryError(
+                        f"dangling AND in {query_str!r}")
 
     def matches(self, events: dict[str, list[str]]) -> bool:
         """events: composite key ("type.attr") → list of values."""

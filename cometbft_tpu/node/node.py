@@ -199,6 +199,17 @@ class Node:
         # (reference: createAndStartProxyAppConns, setup.go:179)
         await self.app_conns.start()
 
+        # optional ABCI call-trace recording for the grammar checker
+        # (reference: the e2e app records requests for
+        # test/e2e/pkg/grammar/checker.go)
+        if cfg.base.abci_grammar_trace:
+            from ..abci.grammar import RecordingClient
+            self.abci_trace: list = []
+            for _conn in ("consensus", "mempool", "query", "snapshot"):
+                setattr(self.app_conns, _conn,
+                        RecordingClient(getattr(self.app_conns, _conn),
+                                        self.abci_trace))
+
         # ABCI handshake reconciles app and store
         handshaker = Handshaker(self.state_store, self.initial_state,
                                 self.block_store, self.genesis_doc,
@@ -243,6 +254,20 @@ class Node:
                             cfg.base.path(cfg.base.db_dir))
             self.tx_indexer = TxIndexer(idx_db)
             self.block_indexer = BlockIndexer(idx_db)
+            self.indexer_service = IndexerService(
+                self.tx_indexer, self.block_indexer, self.event_bus)
+            await self.indexer_service.start()
+        elif cfg.tx_index.indexer == "psql":
+            # relational event sink (reference: state/indexer/sink/psql
+            # wired via setup.go; embedded SQL engine in this build)
+            import os as _os
+            from ..indexer import SQLEventSink
+            conn = cfg.tx_index.psql_conn or cfg.base.path(
+                _os.path.join(cfg.base.db_dir, "events.sqlite"))
+            self._event_sink = SQLEventSink(
+                conn, self.genesis_doc.chain_id)
+            self.tx_indexer = self._event_sink.tx_indexer
+            self.block_indexer = self._event_sink.block_indexer
             self.indexer_service = IndexerService(
                 self.tx_indexer, self.block_indexer, self.event_bus)
             await self.indexer_service.start()

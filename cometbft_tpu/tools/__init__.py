@@ -1,0 +1,2 @@
+"""Operational tooling: load generation, latency reports, testnet
+manifests (reference: test/loadtime, test/e2e/runner, test/e2e/pkg)."""

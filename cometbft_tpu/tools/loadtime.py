@@ -1,0 +1,228 @@
+"""Load generation and latency reporting.
+
+Reference: test/loadtime — the `load` tool paces timestamped
+transactions into a running network over c connections at r tx/s
+(payload/payload.go: "a=" + hex(encoded payload) so the kvstore only
+ever stores one key), and the `report` tool reads committed blocks
+back, matches payloads by experiment id, and reports latency
+statistics (report/report.go).  Block-interval statistics mirror
+test/e2e/runner/benchmark.go (avg/stddev/min/max production time).
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import math
+import secrets
+import time
+import uuid
+from dataclasses import dataclass, field
+from datetime import datetime, timezone
+from typing import Optional
+
+_KEY_PREFIX = b"a="
+MAX_PAYLOAD_SIZE = 4 * 1024 * 1024
+
+
+def payload_bytes(experiment_id: str, size: int = 256, rate: int = 0,
+                  connections: int = 0,
+                  now_ns: Optional[int] = None) -> bytes:
+    """One timestamped tx (reference: payload.NewBytes).  The tx is
+    kvstore-compatible: a single "a" key whose value is the
+    hex-encoded payload, padded with random hex up to `size`."""
+    if size > MAX_PAYLOAD_SIZE:
+        raise ValueError(f"size {size} too large")
+    body = {
+        "id": experiment_id,
+        "time_ns": time.time_ns() if now_ns is None else now_ns,
+        "rate": rate,
+        "connections": connections,
+    }
+    raw = json.dumps(body, separators=(",", ":")).encode().hex()
+    tx = _KEY_PREFIX + raw.encode()
+    if len(tx) < size:
+        # random hex padding outside the JSON (split by '.')
+        pad = size - len(tx) - 1
+        tx += b"." + secrets.token_hex((pad + 1) // 2)[:pad].encode()
+    return tx
+
+
+def payload_from_tx(tx: bytes) -> Optional[dict]:
+    """Reference: payload.FromBytes — None if not a load payload."""
+    if not tx.startswith(_KEY_PREFIX):
+        return None
+    body = tx[len(_KEY_PREFIX):].split(b".", 1)[0]
+    try:
+        return json.loads(bytes.fromhex(body.decode()))
+    except (ValueError, json.JSONDecodeError):
+        return None
+
+
+@dataclass
+class LoadResult:
+    experiment_id: str
+    sent: int = 0
+    accepted: int = 0
+    errors: int = 0
+    duration_s: float = 0.0
+
+
+async def generate(endpoints: list[str], *, rate: int = 100,
+                   connections: int = 1, duration_s: float = 10.0,
+                   size: int = 256,
+                   experiment_id: Optional[str] = None,
+                   method: str = "sync") -> LoadResult:
+    """Pace `rate` tx/s total across `connections` tasks per endpoint
+    for `duration_s` (reference: loadtime/cmd/load main.go via
+    cometbft-load-test's transactor loop)."""
+    from ..rpc.client import HTTPClient
+
+    exp_id = experiment_id or uuid.uuid4().hex[:16]
+    res = LoadResult(experiment_id=exp_id)
+    start = time.monotonic()
+    deadline = start + duration_s
+    n_workers = max(1, connections) * len(endpoints)
+    per_worker_interval = n_workers / max(1, rate)
+
+    async def worker(endpoint: str, widx: int) -> None:
+        cli = HTTPClient(endpoint, timeout=10.0)
+        # stagger workers across the pacing interval
+        await asyncio.sleep(per_worker_interval * widx / n_workers)
+        next_at = time.monotonic()
+        while time.monotonic() < deadline:
+            tx = payload_bytes(exp_id, size=size, rate=rate,
+                               connections=connections)
+            res.sent += 1
+            try:
+                if method == "async":
+                    r = await cli.broadcast_tx_async(tx)
+                else:
+                    r = await cli.broadcast_tx_sync(tx)
+                if int(r.get("code", 0)) == 0:
+                    res.accepted += 1
+                else:
+                    res.errors += 1
+            except Exception:
+                res.errors += 1
+            next_at += per_worker_interval
+            delay = next_at - time.monotonic()
+            if delay > 0:
+                await asyncio.sleep(delay)
+
+    await asyncio.gather(*(worker(ep, i)
+                           for i, ep in enumerate(
+                               ep for ep in endpoints
+                               for _ in range(max(1, connections)))))
+    res.duration_s = time.monotonic() - start
+    return res
+
+
+# ---------------------------------------------------------------------------
+# reporting
+
+@dataclass
+class Stats:
+    count: int = 0
+    min_s: float = 0.0
+    max_s: float = 0.0
+    avg_s: float = 0.0
+    stddev_s: float = 0.0
+    p50_s: float = 0.0
+    p90_s: float = 0.0
+    p99_s: float = 0.0
+
+    @classmethod
+    def from_samples(cls, xs: list[float]) -> "Stats":
+        if not xs:
+            return cls()
+        s = sorted(xs)
+
+        def pct(p: float) -> float:
+            return s[min(len(s) - 1, int(p * len(s)))]
+        avg = sum(s) / len(s)
+        var = sum((x - avg) ** 2 for x in s) / len(s)
+        return cls(count=len(s), min_s=s[0], max_s=s[-1], avg_s=avg,
+                   stddev_s=math.sqrt(var), p50_s=pct(0.50),
+                   p90_s=pct(0.90), p99_s=pct(0.99))
+
+    def to_dict(self) -> dict:
+        return {k: (round(v, 6) if isinstance(v, float) else v)
+                for k, v in self.__dict__.items()}
+
+
+@dataclass
+class Report:
+    experiment_id: str = ""
+    latency: Stats = field(default_factory=Stats)
+    block_interval: Stats = field(default_factory=Stats)
+    negative_latencies: int = 0
+    heights: int = 0
+
+    def to_dict(self) -> dict:
+        return {"experiment_id": self.experiment_id,
+                "heights": self.heights,
+                "negative_latencies": self.negative_latencies,
+                "latency": self.latency.to_dict(),
+                "block_interval": self.block_interval.to_dict()}
+
+
+def _parse_block_time(raw: str) -> float:
+    txt = raw.strip()
+    if txt.endswith("Z"):
+        txt = txt[:-1] + "+00:00"
+    # RFC3339 with up to ns precision: trim to µs for fromisoformat
+    if "." in txt:
+        head, _, frac_tz = txt.partition(".")
+        frac = frac_tz
+        tz = ""
+        for sep in ("+", "-"):
+            if sep in frac_tz:
+                frac, _, rest = frac_tz.partition(sep)
+                tz = sep + rest
+                break
+        txt = f"{head}.{frac[:6].ljust(6, '0')}{tz}"
+    return datetime.fromisoformat(txt).astimezone(
+        timezone.utc).timestamp()
+
+
+async def report(endpoint: str, experiment_id: Optional[str] = None,
+                 from_height: int = 0,
+                 to_height: int = 0) -> Report:
+    """Scan committed blocks over RPC, extract load payloads, compute
+    tx latency (block time - payload time) and block-interval stats
+    (reference: loadtime/report/report.go + runner/benchmark.go)."""
+    import base64
+
+    from ..rpc.client import HTTPClient
+
+    cli = HTTPClient(endpoint, timeout=30.0)
+    st = await cli.status()
+    base = int(st["sync_info"]["earliest_block_height"] or 1)
+    tip = int(st["sync_info"]["latest_block_height"])
+    lo = max(base, from_height or base)
+    hi = min(tip, to_height or tip)
+    rep = Report(experiment_id=experiment_id or "")
+    lat: list[float] = []
+    times: list[float] = []
+    for h in range(lo, hi + 1):
+        res = await cli.block(h)
+        block = res["block"]
+        bt = _parse_block_time(block["header"]["time"])
+        times.append(bt)
+        for tx64 in block["data"].get("txs", []):
+            p = payload_from_tx(base64.b64decode(tx64))
+            if p is None:
+                continue
+            if experiment_id and p.get("id") != experiment_id:
+                continue
+            if not rep.experiment_id:
+                rep.experiment_id = p.get("id", "")
+            d = bt - p.get("time_ns", 0) / 1e9
+            if d < 0:
+                rep.negative_latencies += 1
+            lat.append(d)
+    rep.heights = max(0, hi - lo + 1)
+    rep.latency = Stats.from_samples(lat)
+    rep.block_interval = Stats.from_samples(
+        [b - a for a, b in zip(times, times[1:])])
+    return rep

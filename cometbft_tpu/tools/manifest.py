@@ -1,0 +1,291 @@
+"""Testnet manifests, random generation, setup, and an in-process
+runner with perturbations and invariant checks.
+
+Reference: test/e2e/pkg/manifest.go (the TOML manifest schema),
+test/e2e/generator (random sampling of the config space for nightly
+runs), test/e2e/runner (setup.go writes per-node homes; start.go,
+perturb.go, wait.go drive the net; tests assert invariants).  The
+docker-compose layer is replaced by in-process `Node` objects on real
+localhost sockets — same protocols end to end, no containers.
+"""
+from __future__ import annotations
+
+import asyncio
+import dataclasses
+import json
+import os
+import random
+import socket
+from dataclasses import dataclass, field
+from typing import Optional
+
+# -- manifest schema ---------------------------------------------------------
+
+PERTURBATIONS = ("kill", "restart", "pause")
+MODES = ("validator", "full")
+
+
+@dataclass
+class ManifestNode:
+    """Reference: manifest.go ManifestNode."""
+    mode: str = "validator"            # validator | full
+    # height at which the node joins (0 = from genesis); late joiners
+    # exercise blocksync (reference: StartAt)
+    start_at: int = 0
+    key_type: str = "ed25519"
+    db_backend: str = "memdb"
+    # perturbations applied mid-run (reference: perturb.go)
+    perturb: list[str] = field(default_factory=list)
+    # reference: RetainBlocks drives app retain height
+    retain_blocks: int = 0
+    send_no_load: bool = False
+
+
+@dataclass
+class Manifest:
+    """Reference: manifest.go Manifest (the supported subset)."""
+    chain_id: str = "e2e-net"
+    initial_height: int = 1
+    key_type: str = "ed25519"
+    abci_protocol: str = "builtin"     # builtin | builtin_unsync
+    disable_pex: bool = False
+    # target load during the run
+    load_tx_rate: int = 40
+    load_tx_size: int = 200
+    nodes: dict[str, ManifestNode] = field(default_factory=dict)
+    # node name -> voting power (defaults: validators at 100)
+    validators: dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        return d
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Manifest":
+        nodes = {name: ManifestNode(**nd)
+                 for name, nd in (d.get("nodes") or {}).items()}
+        kw = {k: v for k, v in d.items() if k != "nodes"}
+        m = cls(**kw)
+        m.nodes = nodes
+        return m
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(self.to_dict(), f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    @classmethod
+    def load(cls, path: str) -> "Manifest":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+
+def generate(seed: int = 0, max_nodes: int = 4) -> Manifest:
+    """Randomly sample the testnet config space (reference:
+    test/e2e/generator/generate.go)."""
+    rng = random.Random(seed)
+    n_vals = rng.randint(2, max(2, max_nodes - 1))
+    n_full = rng.randint(0, max(0, max_nodes - n_vals))
+    m = Manifest(
+        chain_id=f"gen-{seed}",
+        key_type=rng.choice(["ed25519", "secp256k1"]),
+        abci_protocol=rng.choice(["builtin", "builtin_unsync"]),
+        disable_pex=rng.random() < 0.25,
+        load_tx_rate=rng.choice([20, 40, 80]),
+        load_tx_size=rng.choice([128, 256, 1024]),
+    )
+    for i in range(n_vals):
+        node = ManifestNode(mode="validator",
+                            key_type=m.key_type,
+                            db_backend=rng.choice(["memdb", "sqlite"]))
+        # perturb at most one validator so the net keeps quorum
+        if i == n_vals - 1 and n_vals > 2 and rng.random() < 0.5:
+            node.perturb = [rng.choice(PERTURBATIONS)]
+        m.nodes[f"validator{i:02d}"] = node
+        m.validators[f"validator{i:02d}"] = rng.choice([50, 100])
+    for i in range(n_full):
+        m.nodes[f"full{i:02d}"] = ManifestNode(
+            mode="full", key_type=m.key_type,
+            start_at=rng.choice([0, 3]))
+    return m
+
+
+# -- setup (reference: runner/setup.go) --------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def setup(manifest: Manifest, outdir: str) -> dict[str, "object"]:
+    """Write per-node homes (keys, genesis, config overrides with
+    pre-allocated ports and persistent-peer wiring).  Returns
+    node name -> Config."""
+    from ..config import Config
+    from ..p2p.key import NodeKey
+    from ..privval import FilePV
+    from ..types.genesis import GenesisDoc, GenesisValidator
+    from ..types.timestamp import Timestamp
+
+    cfgs: dict[str, Config] = {}
+    pvs: dict[str, object] = {}
+    peer_addrs: dict[str, str] = {}
+    for name, nm in manifest.nodes.items():
+        home = os.path.join(outdir, name)
+        cfg = Config()
+        cfg.base.home = home
+        cfg.base.moniker = name
+        cfg.base.db_backend = nm.db_backend
+        p2p_port, rpc_port = _free_port(), _free_port()
+        cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
+        cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
+        cfg.p2p.pex = not manifest.disable_pex
+        cfg.p2p.allow_duplicate_ip = True
+        cfg.consensus.timeout_commit = 0.05
+        cfg.blocksync.enable = True
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        os.makedirs(os.path.join(home, "data"), exist_ok=True)
+        pv = FilePV.load_or_generate(
+            cfg.base.path(cfg.base.priv_validator_key_file),
+            cfg.base.path(cfg.base.priv_validator_state_file),
+            key_type=nm.key_type)
+        nk = NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+        peer_addrs[name] = f"{nk.id}@127.0.0.1:{p2p_port}"
+        cfgs[name] = cfg
+        pvs[name] = pv
+    doc = GenesisDoc(
+        chain_id=manifest.chain_id,
+        genesis_time=Timestamp.now(),
+        initial_height=manifest.initial_height,
+        validators=[GenesisValidator(
+            address=b"", pub_key=pvs[name].get_pub_key(),
+            power=manifest.validators.get(name, 100))
+            for name, nm in manifest.nodes.items()
+            if nm.mode == "validator"],
+    )
+    for name, cfg in cfgs.items():
+        doc.save_as(cfg.base.path(cfg.base.genesis_file))
+        others = [a for n, a in peer_addrs.items() if n != name]
+        cfg.p2p.persistent_peers = ",".join(others)
+    return cfgs
+
+
+# -- runner (reference: runner/{start,perturb,wait}.go) ----------------------
+
+@dataclass
+class RunReport:
+    target_height: int = 0
+    heights: dict[str, int] = field(default_factory=dict)
+    load_sent: int = 0
+    load_accepted: int = 0
+    perturbed: list[str] = field(default_factory=list)
+    mismatches: list[str] = field(default_factory=list)
+
+
+async def run_manifest(manifest: Manifest, outdir: str,
+                       target_height: int = 8,
+                       timeout_s: float = 90.0) -> RunReport:
+    """Boot every node, inject load, apply perturbations once the net
+    is past the halfway height, wait for target_height everywhere,
+    then check cross-node block-hash/app-hash invariants
+    (reference: runner/main.go stage order; tests/block_test.go)."""
+    from ..node.node import Node
+    from ..rpc.client import HTTPClient
+    from . import loadtime
+
+    cfgs = setup(manifest, outdir)
+    nodes: dict[str, Node] = {}
+    report = RunReport(target_height=target_height)
+    load_task: Optional[asyncio.Task] = None
+    try:
+        # start_at=0 nodes boot now; late joiners wait for the height
+        for name, cfg in cfgs.items():
+            if manifest.nodes[name].start_at == 0:
+                nodes[name] = Node(cfg)
+                await nodes[name].start()
+        if not nodes:
+            raise ValueError(
+                "manifest needs at least one node with start_at=0")
+
+        first = next(iter(nodes.values()))
+        endpoint = f"http://{first._rpc_server.listen_addr}"
+
+        load_res = loadtime.LoadResult(experiment_id="")
+
+        async def _load():
+            nonlocal load_res
+            load_res = await loadtime.generate(
+                [endpoint], rate=manifest.load_tx_rate,
+                connections=1, duration_s=timeout_s / 3,
+                size=manifest.load_tx_size, method="async")
+
+        load_task = asyncio.get_running_loop().create_task(_load())
+
+        async def wait_height(h: int, budget: float) -> None:
+            deadline = asyncio.get_running_loop().time() + budget
+            while asyncio.get_running_loop().time() < deadline:
+                if all(n.height >= h for n in nodes.values()):
+                    return
+                await asyncio.sleep(0.05)
+            raise TimeoutError(
+                f"heights {[n.height for n in nodes.values()]} "
+                f"< {h} after {budget}s")
+
+        await wait_height(target_height // 2, timeout_s / 3)
+
+        # late joiners enter mid-run and must blocksync to catch up
+        for name, cfg in cfgs.items():
+            if name not in nodes:
+                nodes[name] = Node(cfg)
+                await nodes[name].start()
+
+        # perturbations (reference: perturb.go — one node at a time)
+        for name, nm in manifest.nodes.items():
+            for p in nm.perturb:
+                report.perturbed.append(f"{name}:{p}")
+                # kill/restart/pause all stop the node and boot a
+                # fresh one on the same durable stores (pause maps to
+                # a short stop: asyncio tasks can't be frozen the way
+                # docker pause freezes a process)
+                await nodes[name].stop()
+                await asyncio.sleep(0.2 if p != "pause" else 1.0)
+                nodes[name] = Node(cfgs[name])
+                await nodes[name].start()
+
+        await wait_height(target_height, timeout_s / 2)
+    finally:
+        if load_task is not None:
+            await load_task
+        report.load_sent = load_res.sent
+        report.load_accepted = load_res.accepted
+        for name, n in nodes.items():
+            report.heights[name] = n.height
+            try:
+                await n.stop()
+            except Exception:
+                pass
+
+    # invariants on the durable stores: identical block ids and app
+    # hashes at every common height (reference: tests/block_test.go,
+    # app_test.go)
+    ref_name = next(iter(nodes))
+    ref = nodes[ref_name]
+    for h in range(manifest.initial_height, target_height + 1):
+        want = ref.block_store.load_block_meta(h)
+        if want is None:
+            report.mismatches.append(f"{ref_name} missing meta @{h}")
+            continue
+        for name, n in nodes.items():
+            got = n.block_store.load_block_meta(h)
+            if got is None:
+                continue            # pruned or still syncing
+            if got.block_id.hash != want.block_id.hash:
+                report.mismatches.append(
+                    f"{name}@{h}: block hash mismatch")
+            if got.header.app_hash != want.header.app_hash:
+                report.mismatches.append(
+                    f"{name}@{h}: app hash mismatch")
+    return report

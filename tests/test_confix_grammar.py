@@ -1,0 +1,209 @@
+"""Config tooling (confix) and the ABCI grammar checker.
+
+Reference: internal/confix; test/e2e/pkg/grammar/checker.go.
+"""
+import asyncio
+import json
+import os
+import tempfile
+
+import pytest
+
+
+class TestConfix:
+    def _write(self, home, overrides):
+        os.makedirs(os.path.join(home, "config"), exist_ok=True)
+        with open(os.path.join(home, "config", "config.json"),
+                  "w") as f:
+            json.dump(overrides, f)
+
+    def test_migrate_renames_durations_and_drops(self):
+        from cometbft_tpu import confix
+
+        with tempfile.TemporaryDirectory() as home:
+            self._write(home, {
+                "base": {"fast_sync": False, "log_level": "debug"},
+                "consensus": {"timeout_propose": "3s",
+                              "timeout_prevote": "500ms"},
+                "mempool": {"version": "v1", "size": 2000},
+                "junk": {"x": 1},
+            })
+            log = confix.migrate(home)
+            assert any("renamed base.fast_sync" in line
+                       for line in log)
+            assert any("dropped mempool.version" in line
+                       for line in log)
+            assert any("dropped junk.x" in line for line in log)
+            cfg = confix.effective_config(home)
+            assert cfg.blocksync.enable is False
+            assert cfg.consensus.timeout_propose_ns == 3_000_000_000
+            assert cfg.consensus.timeout_vote_ns == 500_000_000
+            assert cfg.mempool.size == 2000
+            # idempotent
+            assert confix.migrate(home) == []
+
+    def test_dry_run_leaves_file_untouched(self):
+        from cometbft_tpu import confix
+
+        with tempfile.TemporaryDirectory() as home:
+            self._write(home, {"mempool": {"version": "v1"}})
+            before = confix.load_overrides(home)
+            log = confix.migrate(home, dry_run=True)
+            assert log and confix.load_overrides(home) == before
+
+    def test_get_set_diff(self):
+        from cometbft_tpu import confix
+
+        with tempfile.TemporaryDirectory() as home:
+            confix.set_value(home, "mempool.size", "7000")
+            confix.set_value(home, "consensus.timeout_propose_ns",
+                             "2s")
+            assert confix.get_value(home, "mempool.size") == 7000
+            assert confix.get_value(
+                home, "consensus.timeout_propose_ns") == 2_000_000_000
+            d = confix.diff_from_defaults(home)
+            assert d["mempool"]["size"]["status"] == "changed"
+            with pytest.raises(KeyError):
+                confix.set_value(home, "mempool.nope", "1")
+            with pytest.raises(KeyError):
+                confix.get_value(home, "nope.size")
+
+
+class TestGrammarChecker:
+    def _check(self, trace, **kw):
+        from cometbft_tpu.abci.grammar import GrammarChecker
+        return GrammarChecker().verify(trace, **kw)
+
+    def test_valid_traces(self):
+        # clean start, two heights, round calls interleaved
+        assert self._check([
+            "init_chain",
+            "prepare_proposal", "process_proposal",
+            "finalize_block", "commit",
+            "process_proposal", "extend_vote",
+            "verify_vote_extension",
+            "finalize_block", "commit",
+        ])
+        # state-sync start: attempts then success
+        assert self._check([
+            "offer_snapshot",                       # failed attempt
+            "offer_snapshot", "apply_snapshot_chunk",
+            "apply_snapshot_chunk",                 # success
+            "finalize_block", "commit",
+        ])
+        # recovery without init_chain
+        assert self._check(["finalize_block", "commit"],
+                           clean_start=False)
+        # non-grammar calls are ignored
+        assert self._check(["info", "init_chain", "check_tx",
+                            "finalize_block", "echo", "commit"])
+
+    def test_violations(self):
+        from cometbft_tpu.abci.grammar import GrammarError
+
+        cases = [
+            # consensus before handshake on clean start
+            ["finalize_block", "commit"],
+            # commit without finalize
+            ["init_chain", "commit"],
+            # round call between finalize and commit
+            ["init_chain", "finalize_block", "prepare_proposal",
+             "commit"],
+            # init_chain mid-stream
+            ["init_chain", "finalize_block", "commit", "init_chain"],
+            # statesync after consensus
+            ["init_chain", "finalize_block", "commit",
+             "offer_snapshot"],
+            # last snapshot attempt applied no chunks
+            ["offer_snapshot", "finalize_block", "commit"],
+            # chunk without offer
+            ["init_chain", "apply_snapshot_chunk"],
+            # ends mid-height
+            ["init_chain", "finalize_block"],
+            # no height at all
+            ["init_chain"],
+        ]
+        for trace in cases:
+            with pytest.raises(GrammarError):
+                self._check(trace)
+
+    def test_live_node_trace_is_grammatical(self):
+        """A real node run (handshake -> consensus heights with txs)
+        produces a trace the checker accepts."""
+        from cometbft_tpu.abci.grammar import GrammarChecker
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.rpc.client import HTTPClient
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc, GenesisValidator,
+        )
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                home = os.path.join(d, "node")
+                cfg = Config()
+                cfg.base.home = home
+                cfg.base.abci_grammar_trace = True
+                cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                cfg.rpc.laddr = "tcp://127.0.0.1:0"
+                cfg.consensus.timeout_commit = 0.02
+                os.makedirs(os.path.join(home, "config"),
+                            exist_ok=True)
+                os.makedirs(os.path.join(home, "data"), exist_ok=True)
+                pv = FilePV.generate(
+                    cfg.base.path(cfg.base.priv_validator_key_file),
+                    cfg.base.path(cfg.base.priv_validator_state_file))
+                NodeKey.load_or_gen(
+                    cfg.base.path(cfg.base.node_key_file))
+                GenesisDoc(
+                    chain_id="grammar-chain",
+                    genesis_time=Timestamp.now(),
+                    validators=[GenesisValidator(
+                        address=b"", pub_key=pv.get_pub_key(),
+                        power=10)],
+                ).save_as(cfg.base.path(cfg.base.genesis_file))
+                node = Node(cfg)
+                await node.start()
+                try:
+                    cli = HTTPClient(
+                        f"http://{node._rpc_server.listen_addr}",
+                        timeout=30.0)
+                    res = await cli.broadcast_tx_commit(b"g=1")
+                    assert res["tx_result"]["code"] == 0
+                    for _ in range(200):
+                        if node.height >= 4:
+                            break
+                        await asyncio.sleep(0.02)
+                finally:
+                    await node.stop()
+                trace = list(node.abci_trace)
+                assert "finalize_block" in trace
+                assert "prepare_proposal" in trace
+                GrammarChecker().verify(trace)
+        asyncio.run(run())
+
+
+class TestConfixConflicts:
+    def test_explicit_key_beats_legacy_alias_any_order(self):
+        import json
+
+        from cometbft_tpu import confix
+
+        for order in (("timeout_prevote", "timeout_vote_ns"),
+                      ("timeout_vote_ns", "timeout_prevote")):
+            with tempfile.TemporaryDirectory() as home:
+                os.makedirs(os.path.join(home, "config"))
+                vals = {"timeout_prevote": "500ms",
+                        "timeout_vote_ns": 2_000_000_000}
+                with open(os.path.join(home, "config",
+                                       "config.json"), "w") as f:
+                    json.dump({"consensus": {k: vals[k]
+                                             for k in order}}, f)
+                log = confix.migrate(home)
+                assert confix.get_value(
+                    home, "consensus.timeout_vote_ns") == \
+                    2_000_000_000, (order, log)
+                assert any("conflict" in line for line in log)

@@ -217,3 +217,59 @@ class TestIndexerQueryGrammar:
             Query("transfer.memo CONTAINS 'pay-7'"))) == 1
         assert len(idx.search(Query("transfer.memo EXISTS"))) == 10
         assert idx.search(Query("transfer.amount = 11")) == []
+
+
+class TestQueryTokenizer:
+    def test_quoted_values_with_and_and_escapes(self):
+        """The query parser is a real tokenizer (reference:
+        libs/pubsub/query grammar): quoted values may contain AND,
+        spaces, operators and escaped quotes."""
+        from cometbft_tpu.libs.pubsub import Query, QueryError
+
+        q = Query("app.note = 'alice AND bob = friends'")
+        assert q.matches({"app.note": ["alice AND bob = friends"]})
+        q = Query(r"app.note = 'it\'s > fine'")
+        assert q.matches({"app.note": ["it's > fine"]})
+        # no-space operators
+        assert Query("tx.height<=10").matches({"tx.height": ["10"]})
+        for bad in ["tx.height >", "AND", "a = 1 AND", "x ! 3",
+                    "a = 'unterminated"]:
+            try:
+                Query(bad)
+            except QueryError:
+                continue
+            raise AssertionError(f"{bad!r} should not parse")
+
+    def test_date_time_literals(self):
+        """DATE yyyy-mm-dd and TIME RFC3339 literals compare as
+        timestamps, not strings (reference: query grammar TIME/DATE)."""
+        from cometbft_tpu.libs.pubsub import Query
+
+        q = Query("tx.time >= TIME 2023-05-03T14:45:00Z")
+        assert q.matches({"tx.time": ["2023-05-03T15:00:00Z"]})
+        assert q.matches({"tx.time": ["2023-05-03T14:45:00+00:00"]})
+        assert not q.matches({"tx.time": ["2023-05-03T14:00:00Z"]})
+        assert not q.matches({"tx.time": ["not-a-time"]})
+        q = Query("block.date = DATE 2023-05-03")
+        assert q.matches({"block.date": ["2023-05-03"]})
+        assert not q.matches({"block.date": ["2023-05-04"]})
+
+
+class TestSearchNarrowing:
+    def test_numeric_string_equality_not_narrowed(self):
+        """Equality range-narrowing must not break numeric
+        cross-format matches ('7' == '7.0')."""
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.db.db import MemDB
+        from cometbft_tpu.indexer import TxIndexer
+        from cometbft_tpu.libs.pubsub import Query
+
+        idx = TxIndexer(MemDB())
+        idx.index(abci.TxResult(
+            height=1, index=0, tx=b"t",
+            result=abci.ExecTxResult(code=0, events=[
+                abci.Event(type="x", attributes=[
+                    abci.EventAttribute(key="n", value="7.0",
+                                        index=True)])])))
+        assert len(idx.search(Query("x.n = '7'"))) == 1
+        assert len(idx.search(Query("x.n = '7.0'"))) == 1

@@ -1,0 +1,92 @@
+"""Load generation + latency report (reference: test/loadtime,
+test/e2e/runner/benchmark.go)."""
+import asyncio
+import os
+import tempfile
+
+
+def _mk_node_cfg(d):
+    from cometbft_tpu.config import Config
+    from cometbft_tpu.p2p.key import NodeKey
+    from cometbft_tpu.privval import FilePV
+    from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    home = os.path.join(d, "node")
+    cfg = Config()
+    cfg.base.home = home
+    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+    cfg.rpc.laddr = "tcp://127.0.0.1:0"
+    cfg.consensus.timeout_commit = 0.05
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    pv = FilePV.generate(
+        cfg.base.path(cfg.base.priv_validator_key_file),
+        cfg.base.path(cfg.base.priv_validator_state_file))
+    NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    GenesisDoc(
+        chain_id="load-chain", genesis_time=Timestamp.now(),
+        validators=[GenesisValidator(
+            address=b"", pub_key=pv.get_pub_key(), power=10)],
+    ).save_as(cfg.base.path(cfg.base.genesis_file))
+    return cfg
+
+
+class TestPayload:
+    def test_roundtrip_and_padding(self):
+        from cometbft_tpu.tools.loadtime import (
+            payload_bytes, payload_from_tx,
+        )
+
+        tx = payload_bytes("exp1", size=300, rate=50, connections=2)
+        assert len(tx) >= 300
+        assert tx.startswith(b"a=")        # kvstore single-key form
+        p = payload_from_tx(tx)
+        assert p["id"] == "exp1" and p["rate"] == 50
+        assert p["time_ns"] > 0
+        assert payload_from_tx(b"other=tx") is None
+        assert payload_from_tx(b"a=nothex!") is None
+
+    def test_stats(self):
+        from cometbft_tpu.tools.loadtime import Stats
+
+        s = Stats.from_samples([1.0, 2.0, 3.0, 4.0])
+        assert s.count == 4 and s.min_s == 1.0 and s.max_s == 4.0
+        assert abs(s.avg_s - 2.5) < 1e-9
+        assert s.p50_s in (2.0, 3.0)
+        assert Stats.from_samples([]).count == 0
+
+
+class TestLoadAgainstLiveNode:
+    def test_generate_and_report(self):
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.tools import loadtime
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                node = Node(_mk_node_cfg(d))
+                await node.start()
+                try:
+                    ep = f"http://{node._rpc_server.listen_addr}"
+                    res = await loadtime.generate(
+                        [ep], rate=40, connections=2,
+                        duration_s=2.0, size=200)
+                    assert res.accepted > 10, \
+                        f"only {res.accepted}/{res.sent} accepted"
+                    assert res.errors == 0
+                    # let the tail commit
+                    h = node.height
+                    for _ in range(200):
+                        if node.height > h + 1:
+                            break
+                        await asyncio.sleep(0.02)
+                    rep = await loadtime.report(
+                        ep, experiment_id=res.experiment_id)
+                    assert rep.latency.count > 10
+                    assert rep.negative_latencies == 0
+                    assert 0 < rep.latency.p50_s < 10
+                    assert rep.block_interval.count > 1
+                    assert rep.block_interval.avg_s > 0
+                finally:
+                    await node.stop()
+        asyncio.run(run())
