@@ -82,7 +82,7 @@ def effective_config(home: str) -> Config:
         target = getattr(cfg, section, None)
         if target is None:
             continue
-        for k, v in values.items():
+        for k, v in (values or {}).items():
             if hasattr(target, k):
                 setattr(target, k, v)
     return cfg
@@ -206,7 +206,31 @@ def set_value(home: str, dotted: str, raw: str) -> Any:
         if ns is None:
             raise ValueError(f"{dotted}: bad duration {raw!r}")
         value = ns
+    # type + semantic checks BEFORE persisting: a value the node
+    # would refuse to boot with must be rejected here
+    default = schema[section][key]
+    if default is not None and value is not None:
+        if isinstance(default, bool) != isinstance(value, bool) or \
+                not isinstance(value, (type(default), int)
+                               if isinstance(default, float)
+                               else type(default)):
+            raise ValueError(
+                f"{dotted}: expected {type(default).__name__}, "
+                f"got {type(value).__name__}")
     overrides = load_overrides(home)
     overrides.setdefault(section, {})[key] = value
+    from .config import ConfigError, validate_basic
+    cfg = Config()
+    for sec, values in overrides.items():
+        target = getattr(cfg, sec, None)
+        if target is None:
+            continue
+        for k, v in (values or {}).items():
+            if hasattr(target, k):
+                setattr(target, k, v)
+    try:
+        validate_basic(cfg)
+    except ConfigError as e:
+        raise ValueError(f"{dotted}: rejected by validation: {e}")
     save_overrides(home, overrides)
     return value

@@ -1,0 +1,91 @@
+"""Build/load the C++ fast-path module (cometbft_tpu._native).
+
+The native source lives in native/ at the repo root; it is compiled
+on first use with g++ (no external deps — SHA-256 is self-contained)
+and cached next to this package.  Pure-Python implementations remain
+the fallback everywhere, gated by COMETBFT_TPU_NATIVE=0.
+"""
+from __future__ import annotations
+
+import os
+import subprocess
+import sysconfig
+from typing import Optional
+
+_mod = None
+_failed = False
+
+
+def _source_dir() -> str:
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))), "native")
+
+
+def _target_path() -> str:
+    suffix = sysconfig.get_config_var("EXT_SUFFIX") or ".so"
+    return os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "_native" + suffix)
+
+
+def _build() -> Optional[str]:
+    src = os.path.join(_source_dir(), "_native.cpp")
+    hdr = os.path.join(_source_dir(), "sha256.hpp")
+    if not os.path.exists(src):
+        return None
+    target = _target_path()
+    if os.path.exists(target) and \
+            os.path.getmtime(target) >= max(os.path.getmtime(src),
+                                            os.path.getmtime(hdr)):
+        return target
+    include = sysconfig.get_paths()["include"]
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           f"-I{include}", f"-I{_source_dir()}", src, "-o", target]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True,
+                       timeout=120)
+    except (OSError, subprocess.SubprocessError):
+        return None
+    return target
+
+
+def load(allow_build: bool = True):
+    """The _native module, or None (no compiler / disabled).
+
+    With allow_build=False this never shells out to g++ — it only
+    imports an already-built module.  Hot paths (merkle hashing runs
+    inside the consensus loop) use that form; the node pre-builds in
+    a thread at startup, and CLIs/tests build on first use."""
+    global _mod, _failed
+    if _mod is not None:
+        return _mod
+    if _failed or os.environ.get("COMETBFT_TPU_NATIVE", "1") == "0":
+        return None
+    fresh = False
+    try:
+        src = os.path.join(_source_dir(), "_native.cpp")
+        target = _target_path()
+        fresh = os.path.exists(target) and os.path.exists(src) and \
+            os.path.getmtime(target) >= os.path.getmtime(src)
+    except OSError:
+        pass
+    if not fresh:
+        if not allow_build:
+            return None
+        if _build() is None:
+            _failed = True
+            return None
+    try:
+        from cometbft_tpu import _native  # noqa: F401
+        _mod = _native
+    except ImportError:
+        _failed = True
+        _mod = None
+    return _mod
+
+
+def prebuild_async() -> None:
+    """Kick the g++ build on a daemon thread (node startup calls this
+    so the first big merkle hash never blocks the event loop)."""
+    import threading
+    threading.Thread(target=load, daemon=True,
+                     name="native-build").start()

@@ -39,8 +39,19 @@ def _split_point(n: int) -> int:
 
 
 def hash_from_byte_slices(items: Sequence[bytes]) -> bytes:
-    """Merkle root of items (reference: crypto/merkle/tree.go:11)."""
+    """Merkle root of items (reference: crypto/merkle/tree.go:11).
+    Large trees route through the C++ fast path when available."""
     n = len(items)
+    if n >= 8:
+        from ._native_loader import load
+        # never compile on this path — it runs inside the consensus
+        # loop; the node pre-builds at startup (prebuild_async)
+        native = load(allow_build=False)
+        if native is not None:
+            try:
+                return native.merkle_root(list(items))
+            except TypeError:
+                pass        # non-bytes items: python path raises too
     if n == 0:
         return empty_hash()
     if n == 1:

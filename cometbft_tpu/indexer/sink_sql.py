@@ -101,6 +101,8 @@ class SQLEventSink:
             "SELECT rowid FROM blocks WHERE height = ? AND "
             "chain_id = ?", (height, self.chain_id))
         block_rowid = cur.fetchone()[0]
+        # re-indexing the same height must replace, not duplicate
+        self._delete_events(cur, block_rowid, tx_events=False)
         # the reference also records the implicit block.height event
         self._insert_events(cur, block_rowid, None, [
             abci.Event(type="block", attributes=[
@@ -146,6 +148,13 @@ class SQLEventSink:
                 "SELECT rowid FROM tx_results WHERE block_id = ? AND "
                 "\"index\" = ?", (block_rowid, txr.index))
             tx_rowid = cur.fetchone()[0]
+            # replace any events from an earlier delivery of this tx
+            cur.execute(
+                "DELETE FROM attributes WHERE event_id IN "
+                "(SELECT rowid FROM events WHERE tx_id = ?)",
+                (tx_rowid,))
+            cur.execute("DELETE FROM events WHERE tx_id = ?",
+                        (tx_rowid,))
             implicit = [
                 abci.Event(type="tx", attributes=[
                     abci.EventAttribute(
@@ -160,6 +169,17 @@ class SQLEventSink:
             self._insert_events(cur, block_rowid, tx_rowid,
                                 implicit + list(txr.result.events or []))
         self._conn.commit()
+
+    def _delete_events(self, cur, block_id: int,
+                       tx_events: bool) -> None:
+        cond = "IS NOT NULL" if tx_events else "IS NULL"
+        cur.execute(
+            "DELETE FROM attributes WHERE event_id IN "
+            f"(SELECT rowid FROM events WHERE block_id = ? "
+            f" AND tx_id {cond})", (block_id,))
+        cur.execute(
+            f"DELETE FROM events WHERE block_id = ? AND "
+            f"tx_id {cond}", (block_id,))
 
     def _insert_events(self, cur, block_id: int, tx_id: Optional[int],
                        events: list) -> None:

@@ -182,6 +182,11 @@ class Node:
         """Boot order mirrors node.OnStart."""
         cfg = self.config
 
+        # compile the C++ fast paths off-thread so the first big
+        # merkle hash in the consensus loop never waits on g++
+        from ..crypto._native_loader import prebuild_async
+        prebuild_async()
+
         if cfg.base.priv_validator_laddr:
             from ..privval.signer import (
                 RetrySignerClient, SignerClient, SignerListenerEndpoint,
@@ -474,6 +479,8 @@ class Node:
             await self.pruner.stop()
         if getattr(self, "indexer_service", None) is not None:
             await self.indexer_service.stop()
+        if getattr(self, "_event_sink", None) is not None:
+            self._event_sink.close()
         if self.consensus_state is not None:
             await self.consensus_state.stop()
         await self.switch.stop()

@@ -17,7 +17,6 @@ import secrets
 import time
 import uuid
 from dataclasses import dataclass, field
-from datetime import datetime, timezone
 from typing import Optional
 
 _KEY_PREFIX = b"a="
@@ -167,22 +166,11 @@ class Report:
 
 
 def _parse_block_time(raw: str) -> float:
-    txt = raw.strip()
-    if txt.endswith("Z"):
-        txt = txt[:-1] + "+00:00"
-    # RFC3339 with up to ns precision: trim to µs for fromisoformat
-    if "." in txt:
-        head, _, frac_tz = txt.partition(".")
-        frac = frac_tz
-        tz = ""
-        for sep in ("+", "-"):
-            if sep in frac_tz:
-                frac, _, rest = frac_tz.partition(sep)
-                tz = sep + rest
-                break
-        txt = f"{head}.{frac[:6].ljust(6, '0')}{tz}"
-    return datetime.fromisoformat(txt).astimezone(
-        timezone.utc).timestamp()
+    from ..libs.pubsub import _parse_time_like
+    dt = _parse_time_like(raw)
+    if dt is None:
+        raise ValueError(f"bad block time {raw!r}")
+    return dt.timestamp()
 
 
 async def report(endpoint: str, experiment_id: Optional[str] = None,

@@ -1,0 +1,170 @@
+// cometbft_tpu._native — C++ fast paths for the host runtime.
+//
+// Reference parity note: the reference engine is Go with one native
+// dep (blst); this build keeps the hot host-side hashing in C++
+// instead.  Implements the RFC-6962-style merkle tree of
+// crypto/merkle/tree.go (leaf prefix 0x00, inner prefix 0x01,
+// getSplitPoint recursion) and batch SHA-256 for tx/part hashing —
+// the (f) hot loop in the survey's hot-path list.
+//
+// Built by cometbft_tpu/crypto/_native_loader.py (g++ -O3); the
+// Python implementations remain the fallback when no compiler is
+// available.
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+
+#include <cstdint>
+#include <vector>
+
+#include "sha256.hpp"
+
+namespace {
+
+constexpr uint8_t kLeafPrefix = 0x00;
+constexpr uint8_t kInnerPrefix = 0x01;
+
+struct Slice {
+    const uint8_t* p;
+    Py_ssize_t n;
+};
+
+size_t split_point(size_t n) {
+    // largest power of two strictly less than n (tree.go:89)
+    size_t b = 1;
+    while (b * 2 < n) b *= 2;
+    return b;
+}
+
+void inner_hash(const uint8_t l[32], const uint8_t r[32],
+                uint8_t out[32]) {
+    sha256::Ctx c;
+    sha256::init(&c);
+    sha256::update(&c, &kInnerPrefix, 1);
+    sha256::update(&c, l, 32);
+    sha256::update(&c, r, 32);
+    sha256::final(&c, out);
+}
+
+void tree_hash(const std::vector<Slice>& items, size_t lo, size_t hi,
+               uint8_t out[32]) {
+    size_t n = hi - lo;
+    if (n == 1) {
+        sha256::hash_prefixed(kLeafPrefix, items[lo].p,
+                              size_t(items[lo].n), out);
+        return;
+    }
+    size_t k = split_point(n);
+    uint8_t left[32], right[32];
+    tree_hash(items, lo, lo + k, left);
+    tree_hash(items, lo + k, hi, right);
+    inner_hash(left, right, out);
+}
+
+bool collect(PyObject* seq_in, std::vector<Slice>* items,
+             PyObject** fast_out) {
+    PyObject* fast = PySequence_Fast(seq_in, "expected a sequence");
+    if (!fast) return false;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    items->reserve(size_t(n));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
+        char* buf;
+        Py_ssize_t len;
+        if (PyBytes_AsStringAndSize(it, &buf, &len) < 0) {
+            Py_DECREF(fast);
+            return false;
+        }
+        items->push_back(
+            {reinterpret_cast<const uint8_t*>(buf), len});
+    }
+    *fast_out = fast;
+    return true;
+}
+
+PyObject* merkle_root(PyObject*, PyObject* arg) {
+    std::vector<Slice> items;
+    PyObject* fast;
+    if (!collect(arg, &items, &fast)) return nullptr;
+    uint8_t out[32];
+    if (items.empty()) {
+        sha256::hash(nullptr, 0, out);
+    } else {
+        tree_hash(items, 0, items.size(), out);
+    }
+    Py_DECREF(fast);
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(out), 32);
+}
+
+PyObject* leaf_hashes(PyObject*, PyObject* arg) {
+    // concatenated 32-byte RFC-6962 leaf hashes
+    std::vector<Slice> items;
+    PyObject* fast;
+    if (!collect(arg, &items, &fast)) return nullptr;
+    PyObject* out =
+        PyBytes_FromStringAndSize(nullptr, Py_ssize_t(items.size()) * 32);
+    if (!out) {
+        Py_DECREF(fast);
+        return nullptr;
+    }
+    uint8_t* p =
+        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+    for (size_t i = 0; i < items.size(); i++)
+        sha256::hash_prefixed(kLeafPrefix, items[i].p,
+                              size_t(items[i].n), p + i * 32);
+    Py_DECREF(fast);
+    return out;
+}
+
+PyObject* sha256_many(PyObject*, PyObject* arg) {
+    // concatenated plain SHA-256 digests (tx hashing)
+    std::vector<Slice> items;
+    PyObject* fast;
+    if (!collect(arg, &items, &fast)) return nullptr;
+    PyObject* out =
+        PyBytes_FromStringAndSize(nullptr, Py_ssize_t(items.size()) * 32);
+    if (!out) {
+        Py_DECREF(fast);
+        return nullptr;
+    }
+    uint8_t* p =
+        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+    for (size_t i = 0; i < items.size(); i++)
+        sha256::hash(items[i].p, size_t(items[i].n), p + i * 32);
+    Py_DECREF(fast);
+    return out;
+}
+
+PyObject* sha256_one(PyObject*, PyObject* arg) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(arg, &buf, &len) < 0) return nullptr;
+    uint8_t out[32];
+    sha256::hash(reinterpret_cast<const uint8_t*>(buf), size_t(len),
+                 out);
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<const char*>(out), 32);
+}
+
+PyMethodDef kMethods[] = {
+    {"merkle_root", merkle_root, METH_O,
+     "RFC-6962/CometBFT merkle root of a sequence of bytes"},
+    {"leaf_hashes", leaf_hashes, METH_O,
+     "concatenated 32-byte leaf hashes"},
+    {"sha256_many", sha256_many, METH_O,
+     "concatenated SHA-256 digests of a sequence of bytes"},
+    {"sha256", sha256_one, METH_O, "SHA-256 of one bytes object"},
+    {nullptr, nullptr, 0, nullptr},
+};
+
+PyModuleDef kModule = {
+    PyModuleDef_HEAD_INIT, "_native",
+    "C++ fast paths: merkle tree + batch SHA-256", -1, kMethods,
+    nullptr, nullptr, nullptr, nullptr,
+};
+
+}  // namespace
+
+PyMODINIT_FUNC PyInit__native(void) {
+    return PyModule_Create(&kModule);
+}

@@ -1,0 +1,22 @@
+"""Build the optional C++ fast-path extension (cometbft_tpu._native).
+
+    python setup.py build_ext --inplace
+
+The engine also self-builds it on first use via
+cometbft_tpu/crypto/_native_loader.py; this setup.py is the standard
+packaging entry point.
+"""
+from setuptools import Extension, setup
+
+setup(
+    name="cometbft-tpu",
+    version="1.0.0",
+    packages=["cometbft_tpu"],
+    ext_modules=[Extension(
+        "cometbft_tpu._native",
+        sources=["native/_native.cpp"],
+        include_dirs=["native"],
+        extra_compile_args=["-O3", "-std=c++17"],
+        language="c++",
+    )],
+)

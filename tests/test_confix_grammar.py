@@ -207,3 +207,30 @@ class TestConfixConflicts:
                     home, "consensus.timeout_vote_ns") == \
                     2_000_000_000, (order, log)
                 assert any("conflict" in line for line in log)
+
+
+class TestConfixSetValidation:
+    def test_set_rejects_values_the_node_would_refuse(self):
+        from cometbft_tpu import confix
+
+        with tempfile.TemporaryDirectory() as home:
+            for key, raw in [("tx_index.indexer", "bogus"),
+                             ("mempool.size", '"abc"'),
+                             ("rpc.max_body_bytes", "-5")]:
+                with pytest.raises(ValueError):
+                    confix.set_value(home, key, raw)
+            confix.set_value(home, "mempool.size", "123")
+            assert confix.get_value(home, "mempool.size") == 123
+
+    def test_null_section_tolerated(self):
+        import json
+
+        from cometbft_tpu import confix
+
+        with tempfile.TemporaryDirectory() as home:
+            os.makedirs(os.path.join(home, "config"))
+            with open(os.path.join(home, "config",
+                                   "config.json"), "w") as f:
+                json.dump({"base": None}, f)
+            cfg = confix.effective_config(home)
+            assert cfg.mempool.size == 5000

@@ -1,0 +1,92 @@
+"""C++ fast-path module (native/_native.cpp): build, correctness
+against the pure-Python implementations, and fallback behavior."""
+import hashlib
+import secrets
+
+import pytest
+
+from cometbft_tpu.crypto import _native_loader, merkle
+
+
+def _native():
+    mod = _native_loader.load()
+    if mod is None:
+        pytest.skip("no compiler available")
+    return mod
+
+
+class TestNative:
+    def test_sha256_parity(self):
+        native = _native()
+        for n in [0, 1, 55, 56, 63, 64, 65, 119, 120, 1000, 65537]:
+            d = secrets.token_bytes(n)
+            assert native.sha256(d) == hashlib.sha256(d).digest(), n
+
+    def test_sha256_many(self):
+        native = _native()
+        items = [secrets.token_bytes(i * 13 % 300) for i in range(40)]
+        cat = native.sha256_many(items)
+        assert len(cat) == 40 * 32
+        for i, m in enumerate(items):
+            assert cat[i * 32:(i + 1) * 32] == \
+                hashlib.sha256(m).digest()
+
+    def test_merkle_root_parity(self):
+        native = _native()
+        for n in [0, 1, 2, 3, 5, 7, 8, 9, 64, 100, 257]:
+            items = [secrets.token_bytes(30 + i % 70)
+                     for i in range(n)]
+            want = _py_root(items)
+            assert native.merkle_root(items) == want, f"n={n}"
+            assert merkle.hash_from_byte_slices(items) == want
+
+    def test_leaf_hashes(self):
+        native = _native()
+        items = [b"a", b"bb", b"ccc"]
+        cat = native.leaf_hashes(items)
+        for i, it in enumerate(items):
+            assert cat[i * 32:(i + 1) * 32] == merkle.leaf_hash(it)
+
+    def test_proofs_still_verify_against_native_root(self):
+        native = _native()
+        items = [secrets.token_bytes(50) for _ in range(33)]
+        root, proofs = merkle.proofs_from_byte_slices(items)
+        assert root == native.merkle_root(items)
+        for i, p in enumerate(proofs):
+            p.verify(root, items[i])
+
+    def test_disabled_fallback(self, monkeypatch):
+        monkeypatch.setenv("COMETBFT_TPU_NATIVE", "0")
+        monkeypatch.setattr(_native_loader, "_failed", False)
+        monkeypatch.setattr(_native_loader, "_mod", None)
+        assert _native_loader.load() is None
+        items = [secrets.token_bytes(20) for _ in range(20)]
+        assert merkle.hash_from_byte_slices(items) == _py_root(items)
+        # restore for other tests
+        monkeypatch.setenv("COMETBFT_TPU_NATIVE", "1")
+        monkeypatch.setattr(_native_loader, "_failed", False)
+
+    def test_no_build_on_hot_path(self, monkeypatch, tmp_path):
+        """load(allow_build=False) must never shell out to g++."""
+        import subprocess
+
+        monkeypatch.setattr(_native_loader, "_failed", False)
+        monkeypatch.setattr(_native_loader, "_mod", None)
+        monkeypatch.setattr(_native_loader, "_target_path",
+                            lambda: str(tmp_path / "absent.so"))
+
+        def boom(*a, **kw):
+            raise AssertionError("hot path invoked the compiler")
+        monkeypatch.setattr(subprocess, "run", boom)
+        assert _native_loader.load(allow_build=False) is None
+
+
+def _py_root(items):
+    n = len(items)
+    if n == 0:
+        return hashlib.sha256(b"").digest()
+    if n == 1:
+        return hashlib.sha256(b"\x00" + items[0]).digest()
+    k = merkle._split_point(n)
+    return hashlib.sha256(b"\x01" + _py_root(items[:k]) +
+                          _py_root(items[k:])).digest()

@@ -125,3 +125,28 @@ class TestSQLEventSink:
                 assert "tx" in types
                 conn.close()
         asyncio.run(run())
+
+
+class TestSinkReindex:
+    def test_reindexing_replaces_not_duplicates(self):
+        """Re-delivery of a height/tx must not double event rows."""
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.indexer import SQLEventSink
+
+        sink = SQLEventSink(":memory:", "c")
+        ev = [abci.Event(type="t", attributes=[
+            abci.EventAttribute(key="k", value="v", index=True)])]
+        sink.index_block_events(1, ev)
+        sink.index_block_events(1, ev)
+        cur = sink._conn.cursor()
+        cur.execute("SELECT COUNT(*) FROM events WHERE tx_id IS NULL")
+        assert cur.fetchone()[0] == 2     # implicit block + t
+        txr = abci.TxResult(height=1, index=0, tx=b"x",
+                            result=abci.ExecTxResult(code=0,
+                                                     events=ev))
+        sink.index_tx_events([txr])
+        sink.index_tx_events([txr])
+        cur.execute(
+            "SELECT COUNT(*) FROM events WHERE tx_id IS NOT NULL")
+        assert cur.fetchone()[0] == 3     # 2 implicit + t
+        sink.close()
