@@ -125,6 +125,11 @@ class KVStoreApplication(abci.Application):
         self._val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
         self._gen_block_events = False
         self.next_block_delay_ns = 0
+        # artificial per-call delays (reference: e2e manifest
+        # prepare_proposal_delay / process_proposal_delay /
+        # check_tx_delay / finalize_block_delay / vote_extension_delay
+        # mimic app computation time)
+        self.abci_delays: dict[str, float] = {}
         self._height = 0
         self._size = 0
         self._load_state()
@@ -168,8 +173,15 @@ class KVStoreApplication(abci.Application):
             self._update_validator(v)
         return abci.InitChainResponse(app_hash=self._app_hash())
 
+    async def _delay(self, call: str) -> None:
+        d = self.abci_delays.get(call, 0.0)
+        if d > 0:
+            import asyncio
+            await asyncio.sleep(d)
+
     async def check_tx(self, req: abci.CheckTxRequest
                        ) -> abci.CheckTxResponse:
+        await self._delay("check_tx")
         if is_validator_tx(req.tx):
             try:
                 parse_validator_tx(req.tx)
@@ -187,6 +199,7 @@ class KVStoreApplication(abci.Application):
                                ) -> abci.PrepareProposalResponse:
         """Normalize 'k:v' to 'k=v', drop invalid txs (reference:
         formatTxs)."""
+        await self._delay("prepare_proposal")
         txs = []
         for tx in req.txs:
             if is_validator_tx(tx):
@@ -201,6 +214,7 @@ class KVStoreApplication(abci.Application):
 
     async def process_proposal(self, req: abci.ProcessProposalRequest
                                ) -> abci.ProcessProposalResponse:
+        await self._delay("process_proposal")
         for tx in req.txs:
             if is_validator_tx(tx):
                 try:
@@ -217,6 +231,7 @@ class KVStoreApplication(abci.Application):
 
     async def finalize_block(self, req: abci.FinalizeBlockRequest
                              ) -> abci.FinalizeBlockResponse:
+        await self._delay("finalize_block")
         self._val_updates = []
         self._staged_txs = []
 

@@ -75,10 +75,10 @@ def save_overrides(home: str, overrides: dict) -> None:
     os.replace(tmp, path)
 
 
-def effective_config(home: str) -> Config:
-    cfg = Config()
-    cfg.base.home = home
-    for section, values in load_overrides(home).items():
+def apply_overrides(cfg: Config, overrides: dict) -> Config:
+    """Apply a section->key override tree onto a Config (the single
+    loader used by the CLI, the node, and set_value validation)."""
+    for section, values in overrides.items():
         target = getattr(cfg, section, None)
         if target is None:
             continue
@@ -86,6 +86,12 @@ def effective_config(home: str) -> Config:
             if hasattr(target, k):
                 setattr(target, k, v)
     return cfg
+
+
+def effective_config(home: str) -> Config:
+    cfg = Config()
+    cfg.base.home = home
+    return apply_overrides(cfg, load_overrides(home))
 
 
 def config_to_dict(cfg: Config) -> dict:
@@ -220,16 +226,8 @@ def set_value(home: str, dotted: str, raw: str) -> Any:
     overrides = load_overrides(home)
     overrides.setdefault(section, {})[key] = value
     from .config import ConfigError, validate_basic
-    cfg = Config()
-    for sec, values in overrides.items():
-        target = getattr(cfg, sec, None)
-        if target is None:
-            continue
-        for k, v in (values or {}).items():
-            if hasattr(target, k):
-                setattr(target, k, v)
     try:
-        validate_basic(cfg)
+        validate_basic(apply_overrides(Config(), overrides))
     except ConfigError as e:
         raise ValueError(f"{dotted}: rejected by validation: {e}")
     save_overrides(home, overrides)

@@ -237,8 +237,15 @@ class ConsensusReactor(Reactor):
             loop.create_task(self._gossip_votes_routine(ps)),
             loop.create_task(self._query_maj23_routine(ps)),
         ]
-        # tell the new peer our current state
-        peer.send(STATE_CHANNEL, encode_p2p(self._new_round_step_msg()))
+        # tell the new peer our current state — but NOT while we're
+        # block/state syncing: we drop incoming votes in that mode, and
+        # advertising a live round makes peers gossip votes at us and
+        # mark them delivered, wedging the round once we join
+        # (reference: reactor.go AddPeer gates on !conR.WaitSync();
+        # SwitchToConsensus re-announces via the step broadcast)
+        if not self.wait_sync:
+            peer.send(STATE_CHANNEL,
+                      encode_p2p(self._new_round_step_msg()))
 
     async def remove_peer(self, peer: Peer, reason: str) -> None:
         self._peer_states.pop(peer.id, None)

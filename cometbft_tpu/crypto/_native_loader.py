@@ -10,10 +10,12 @@ from __future__ import annotations
 import os
 import subprocess
 import sysconfig
+import threading
 from typing import Optional
 
 _mod = None
 _failed = False
+_build_lock = threading.Lock()
 
 
 def _source_dir() -> str:
@@ -27,24 +29,48 @@ def _target_path() -> str:
         os.path.abspath(__file__))), "_native" + suffix)
 
 
+def _sources() -> list[str]:
+    d = _source_dir()
+    return [os.path.join(d, "_native.cpp"),
+            os.path.join(d, "sha256.hpp"),
+            os.path.join(d, "sha256_ni.hpp")]
+
+
+def _target_fresh() -> bool:
+    """True when the built module exists and is newer than EVERY
+    native source file (missing sources count as stale, not error)."""
+    try:
+        t = os.path.getmtime(_target_path())
+        return all(t >= os.path.getmtime(s) for s in _sources())
+    except OSError:
+        return False
+
+
 def _build() -> Optional[str]:
-    src = os.path.join(_source_dir(), "_native.cpp")
-    hdr = os.path.join(_source_dir(), "sha256.hpp")
+    """Compile to a temp file and atomically rename into place, under
+    a lock — a concurrent load(allow_build=False) must never see a
+    half-written .so."""
+    src = _sources()[0]
     if not os.path.exists(src):
         return None
     target = _target_path()
-    if os.path.exists(target) and \
-            os.path.getmtime(target) >= max(os.path.getmtime(src),
-                                            os.path.getmtime(hdr)):
-        return target
-    include = sysconfig.get_paths()["include"]
-    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-           f"-I{include}", f"-I{_source_dir()}", src, "-o", target]
-    try:
-        subprocess.run(cmd, check=True, capture_output=True,
-                       timeout=120)
-    except (OSError, subprocess.SubprocessError):
-        return None
+    with _build_lock:
+        if _target_fresh():
+            return target
+        include = sysconfig.get_paths()["include"]
+        tmp = target + f".build-{os.getpid()}"
+        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+               f"-I{include}", f"-I{_source_dir()}", src, "-o", tmp]
+        try:
+            subprocess.run(cmd, check=True, capture_output=True,
+                           timeout=120)
+            os.replace(tmp, target)
+        except (OSError, subprocess.SubprocessError):
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return None
     return target
 
 
@@ -60,15 +86,7 @@ def load(allow_build: bool = True):
         return _mod
     if _failed or os.environ.get("COMETBFT_TPU_NATIVE", "1") == "0":
         return None
-    fresh = False
-    try:
-        src = os.path.join(_source_dir(), "_native.cpp")
-        target = _target_path()
-        fresh = os.path.exists(target) and os.path.exists(src) and \
-            os.path.getmtime(target) >= os.path.getmtime(src)
-    except OSError:
-        pass
-    if not fresh:
+    if not _target_fresh():
         if not allow_build:
             return None
         if _build() is None:

@@ -10,7 +10,7 @@ A JAX-vectorized tree hash for large leaf counts lives in ops/merkle_jax.py.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Sequence
+from typing import Optional, Sequence
 
 from .tmhash import sum as _sha256
 
@@ -116,8 +116,23 @@ def _compute_from_aunts(index: int, total: int, lh: bytes,
 
 
 def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple[bytes, list[Proof]]:
-    """Root + one inclusion proof per item (reference: proof.go:40)."""
-    trails, root_node = _trails_from_byte_slices(items)
+    """Root + one inclusion proof per item (reference: proof.go:40).
+    Leaf hashing is batched through the C++ fast path when available
+    (part-set splitting runs this on every proposal block)."""
+    hashes: Optional[list[bytes]] = None
+    if len(items) >= 8:
+        from ._native_loader import load
+        native = load(allow_build=False)
+        if native is not None:
+            try:
+                cat = native.leaf_hashes(list(items))
+                hashes = [cat[i * 32:(i + 1) * 32]
+                          for i in range(len(items))]
+            except TypeError:
+                pass
+    if hashes is None:
+        hashes = [leaf_hash(it) for it in items]
+    trails, root_node = _trails_from_leaf_hashes(hashes)
     root = root_node.hash if root_node else empty_hash()
     proofs = []
     for i, trail in enumerate(trails):
@@ -148,16 +163,16 @@ class _Node:
         return aunts
 
 
-def _trails_from_byte_slices(items: Sequence[bytes]):
-    n = len(items)
+def _trails_from_leaf_hashes(hashes: Sequence[bytes]):
+    n = len(hashes)
     if n == 0:
         return [], None
     if n == 1:
-        node = _Node(leaf_hash(items[0]))
+        node = _Node(hashes[0])
         return [node], node
     k = _split_point(n)
-    lefts, left_root = _trails_from_byte_slices(items[:k])
-    rights, right_root = _trails_from_byte_slices(items[k:])
+    lefts, left_root = _trails_from_leaf_hashes(hashes[:k])
+    rights, right_root = _trails_from_leaf_hashes(hashes[k:])
     root = _Node(inner_hash(left_root.hash, right_root.hash))
     left_root.parent = root
     left_root.right = right_root

@@ -297,10 +297,16 @@ class Switch:
                     return
                 if "duplicate peer" in str(e):
                     peer = "duplicate"
-            except (ConnectionError, OSError):
-                pass
             except asyncio.CancelledError:
                 raise
+            except Exception as e:
+                # any transport/handshake failure (ConnectionError,
+                # IncompleteReadError — an EOFError, not an OSError —
+                # timeouts, garbage from a mid-reset peer) must NOT
+                # kill the persistent redial loop (reference:
+                # reconnectToPeer retries on every error)
+                self.logger.debug("dial failed", addr=addr,
+                                  err=str(e))
             if peer is None:
                 if not persistent:
                     return

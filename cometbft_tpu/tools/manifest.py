@@ -39,6 +39,9 @@ class ManifestNode:
     # reference: RetainBlocks drives app retain height
     retain_blocks: int = 0
     send_no_load: bool = False
+    # emulated-latency zone (reference: latency_emulation.go — tc/
+    # netem between zones; here a TCP relay adds the delay per link)
+    zone: str = ""
 
 
 @dataclass
@@ -55,6 +58,22 @@ class Manifest:
     nodes: dict[str, ManifestNode] = field(default_factory=dict)
     # node name -> voting power (defaults: validators at 100)
     validators: dict[str, int] = field(default_factory=dict)
+    # one-way link latency between zones, "zoneA:zoneB" -> ms
+    # (reference: manifest zones + latency_emulation.go)
+    latency_ms: dict[str, int] = field(default_factory=dict)
+    # artificial ABCI call delays in ms (reference: manifest
+    # prepare_proposal_delay etc.)
+    prepare_proposal_delay_ms: int = 0
+    process_proposal_delay_ms: int = 0
+    check_tx_delay_ms: int = 0
+    finalize_block_delay_ms: int = 0
+
+    def link_delay_s(self, za: str, zb: str) -> float:
+        if not za or not zb or za == zb:
+            return 0.0
+        ms = self.latency_ms.get(f"{za}:{zb}",
+                                 self.latency_ms.get(f"{zb}:{za}", 0))
+        return ms / 1000.0
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -107,6 +126,16 @@ def generate(seed: int = 0, max_nodes: int = 4) -> Manifest:
         m.nodes[f"full{i:02d}"] = ManifestNode(
             mode="full", key_type=m.key_type,
             start_at=rng.choice([0, 3]))
+    # sometimes spread the net over two latency zones
+    if rng.random() < 0.3:
+        zones = ["zone-a", "zone-b"]
+        for i, nm in enumerate(m.nodes.values()):
+            nm.zone = zones[i % 2]
+        m.latency_ms["zone-a:zone-b"] = rng.choice([50, 100, 200])
+    # sometimes mimic app computation time
+    if rng.random() < 0.3:
+        m.finalize_block_delay_ms = rng.choice([20, 50])
+        m.check_tx_delay_ms = rng.choice([0, 5])
     return m
 
 
@@ -120,19 +149,36 @@ def _free_port() -> int:
     return port
 
 
-def setup(manifest: Manifest, outdir: str) -> dict[str, "object"]:
+@dataclass
+class RelaySpec:
+    """One latency-emulation relay: listens on `port`, forwards to
+    the target with a one-way delay (reference: tc/netem in
+    latency_emulation.go, externalized as a TCP relay)."""
+    port: int
+    target_host: str
+    target_port: int
+    delay_s: float
+
+
+def setup(manifest: Manifest, outdir: str
+          ) -> tuple[dict[str, "object"], list[RelaySpec]]:
     """Write per-node homes (keys, genesis, config overrides with
     pre-allocated ports and persistent-peer wiring).  Returns
-    node name -> Config."""
+    (node name -> Config, latency relays to run).  With zone
+    latencies configured, a node's persistent-peers entries point at
+    per-link relays; PEX is disabled in that case so gossiped real
+    addresses don't bypass the emulated links."""
     from ..config import Config
     from ..p2p.key import NodeKey
     from ..privval import FilePV
     from ..types.genesis import GenesisDoc, GenesisValidator
     from ..types.timestamp import Timestamp
 
+    use_latency = bool(manifest.latency_ms)
     cfgs: dict[str, Config] = {}
     pvs: dict[str, object] = {}
-    peer_addrs: dict[str, str] = {}
+    node_ids: dict[str, str] = {}
+    p2p_ports: dict[str, int] = {}
     for name, nm in manifest.nodes.items():
         home = os.path.join(outdir, name)
         cfg = Config()
@@ -142,7 +188,7 @@ def setup(manifest: Manifest, outdir: str) -> dict[str, "object"]:
         p2p_port, rpc_port = _free_port(), _free_port()
         cfg.p2p.laddr = f"tcp://127.0.0.1:{p2p_port}"
         cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
-        cfg.p2p.pex = not manifest.disable_pex
+        cfg.p2p.pex = not manifest.disable_pex and not use_latency
         cfg.p2p.allow_duplicate_ip = True
         cfg.consensus.timeout_commit = 0.05
         cfg.blocksync.enable = True
@@ -153,7 +199,8 @@ def setup(manifest: Manifest, outdir: str) -> dict[str, "object"]:
             cfg.base.path(cfg.base.priv_validator_state_file),
             key_type=nm.key_type)
         nk = NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
-        peer_addrs[name] = f"{nk.id}@127.0.0.1:{p2p_port}"
+        node_ids[name] = nk.id
+        p2p_ports[name] = p2p_port
         cfgs[name] = cfg
         pvs[name] = pv
     doc = GenesisDoc(
@@ -166,11 +213,119 @@ def setup(manifest: Manifest, outdir: str) -> dict[str, "object"]:
             for name, nm in manifest.nodes.items()
             if nm.mode == "validator"],
     )
+    relays: list[RelaySpec] = []
     for name, cfg in cfgs.items():
         doc.save_as(cfg.base.path(cfg.base.genesis_file))
-        others = [a for n, a in peer_addrs.items() if n != name]
-        cfg.p2p.persistent_peers = ",".join(others)
-    return cfgs
+        peers = []
+        for other, other_port in p2p_ports.items():
+            # dial only "later" nodes: one direction per pair, so
+            # slow links can't race both ends into mutually-rejected
+            # duplicate connections (the reverse direction is covered
+            # by the other node's inbound accept)
+            if other <= name:
+                continue
+            delay = manifest.link_delay_s(
+                manifest.nodes[name].zone, manifest.nodes[other].zone)
+            port = other_port
+            if delay > 0:
+                port = _free_port()
+                relays.append(RelaySpec(
+                    port=port, target_host="127.0.0.1",
+                    target_port=other_port, delay_s=delay))
+            peers.append(f"{node_ids[other]}@127.0.0.1:{port}")
+        cfg.p2p.persistent_peers = ",".join(peers)
+    return cfgs, relays
+
+
+class Relay:
+    """A running latency relay: the listening server plus its live
+    connection handlers (so close() actually tears everything down)."""
+
+    def __init__(self):
+        self.server = None
+        self.tasks: set = set()
+
+    def close(self) -> None:
+        if self.server is not None:
+            self.server.close()
+        for t in list(self.tasks):
+            t.cancel()
+
+    async def wait_closed(self) -> None:
+        if self.server is not None:
+            await self.server.wait_closed()
+
+
+async def start_relay(spec: RelaySpec) -> Relay:
+    """Run one latency relay.  Bytes are delivered delay_s after they
+    arrive WITHOUT throttling bandwidth (a per-direction delivery
+    queue, like netem's constant delay)."""
+    relay = Relay()
+
+    async def handle(reader, writer):
+        try:
+            tr, tw = await asyncio.open_connection(
+                spec.target_host, spec.target_port)
+        except OSError:
+            writer.close()
+            return
+
+        async def pump(src, dst):
+            loop = asyncio.get_running_loop()
+            queue: asyncio.Queue = asyncio.Queue()
+
+            async def deliver():
+                while True:
+                    at, data = await queue.get()
+                    if data is None:
+                        break
+                    now = loop.time()
+                    if at > now:
+                        await asyncio.sleep(at - now)
+                    try:
+                        dst.write(data)
+                        await dst.drain()
+                    except (ConnectionError, OSError):
+                        break
+
+            task = loop.create_task(deliver())
+            try:
+                while True:
+                    data = await src.read(65536)
+                    if not data:
+                        break
+                    queue.put_nowait(
+                        (loop.time() + spec.delay_s, data))
+            except (ConnectionError, OSError):
+                pass
+            finally:
+                queue.put_nowait((0, None))
+                await task
+                try:
+                    dst.close()
+                except OSError:
+                    pass
+
+        await asyncio.gather(pump(reader, tw), pump(tr, writer))
+
+    async def tracked_handle(reader, writer):
+        task = asyncio.current_task()
+        relay.tasks.add(task)
+        try:
+            await handle(reader, writer)
+        except asyncio.CancelledError:
+            for w in (writer,):
+                try:
+                    w.close()
+                except OSError:
+                    pass
+            raise
+        finally:
+            relay.tasks.discard(task)
+
+    relay.server = await asyncio.start_server(tracked_handle,
+                                              "127.0.0.1", spec.port)
+    return relay
 
 
 # -- runner (reference: runner/{start,perturb,wait}.go) ----------------------
@@ -196,15 +351,32 @@ async def run_manifest(manifest: Manifest, outdir: str,
     from ..rpc.client import HTTPClient
     from . import loadtime
 
-    cfgs = setup(manifest, outdir)
+    cfgs, relay_specs = setup(manifest, outdir)
     nodes: dict[str, Node] = {}
     report = RunReport(target_height=target_height)
     load_task: Optional[asyncio.Task] = None
+    relay_servers = [await start_relay(r) for r in relay_specs]
+
+    def _apply_delays(node: Node) -> None:
+        delays = {
+            "prepare_proposal":
+                manifest.prepare_proposal_delay_ms / 1000.0,
+            "process_proposal":
+                manifest.process_proposal_delay_ms / 1000.0,
+            "check_tx": manifest.check_tx_delay_ms / 1000.0,
+            "finalize_block":
+                manifest.finalize_block_delay_ms / 1000.0,
+        }
+        if any(delays.values()) and \
+                hasattr(node.app, "abci_delays"):
+            node.app.abci_delays = delays
+
     try:
         # start_at=0 nodes boot now; late joiners wait for the height
         for name, cfg in cfgs.items():
             if manifest.nodes[name].start_at == 0:
                 nodes[name] = Node(cfg)
+                _apply_delays(nodes[name])
                 await nodes[name].start()
         if not nodes:
             raise ValueError(
@@ -240,6 +412,7 @@ async def run_manifest(manifest: Manifest, outdir: str,
         for name, cfg in cfgs.items():
             if name not in nodes:
                 nodes[name] = Node(cfg)
+                _apply_delays(nodes[name])
                 await nodes[name].start()
 
         # perturbations (reference: perturb.go — one node at a time)
@@ -253,6 +426,7 @@ async def run_manifest(manifest: Manifest, outdir: str,
                 await nodes[name].stop()
                 await asyncio.sleep(0.2 if p != "pause" else 1.0)
                 nodes[name] = Node(cfgs[name])
+                _apply_delays(nodes[name])
                 await nodes[name].start()
 
         await wait_height(target_height, timeout_s / 2)
@@ -267,6 +441,10 @@ async def run_manifest(manifest: Manifest, outdir: str,
                 await n.stop()
             except Exception:
                 pass
+        for srv in relay_servers:
+            srv.close()
+        for srv in relay_servers:
+            await srv.wait_closed()
 
     # invariants on the durable stores: identical block ids and app
     # hashes at every common height (reference: tests/block_test.go,

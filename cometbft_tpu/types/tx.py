@@ -21,7 +21,24 @@ def tx_key(tx: bytes) -> bytes:
 
 
 def txs_hash(txs: Sequence[bytes]) -> bytes:
-    return merkle.hash_from_byte_slices([tx_hash(tx) for tx in txs])
+    return merkle.hash_from_byte_slices(hash_each(txs))
+
+
+def hash_each(txs: Sequence[bytes]) -> list[bytes]:
+    """Per-tx sha256 digests, batched through the C++ fast path for
+    larger blocks (reference: Txs.Hash's per-tx TxID loop)."""
+    if len(txs) >= 8:
+        from ..crypto._native_loader import load
+        native = load(allow_build=False)
+        if native is not None:
+            try:
+                cat = native.sha256_many(list(txs))
+            except TypeError:
+                pass
+            else:
+                return [cat[i * 32:(i + 1) * 32]
+                        for i in range(len(txs))]
+    return [tx_hash(tx) for tx in txs]
 
 
 def txs_proof(txs: Sequence[bytes], index: int):

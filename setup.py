@@ -6,12 +6,12 @@ The engine also self-builds it on first use via
 cometbft_tpu/crypto/_native_loader.py; this setup.py is the standard
 packaging entry point.
 """
-from setuptools import Extension, setup
+from setuptools import Extension, find_packages, setup
 
 setup(
     name="cometbft-tpu",
     version="1.0.0",
-    packages=["cometbft_tpu"],
+    packages=find_packages(include=["cometbft_tpu*"]),
     ext_modules=[Extension(
         "cometbft_tpu._native",
         sources=["native/_native.cpp"],

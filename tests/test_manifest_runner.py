@@ -70,3 +70,56 @@ class TestManifest:
                     rep.heights
                 assert rep.mismatches == [], rep.mismatches
         asyncio.run(run())
+
+
+class TestLatencyAndDelays:
+    def test_two_zone_latency_slows_blocks_but_net_commits(self):
+        """Two zones with 120 ms one-way latency: the net still
+        commits, and block intervals reflect the emulated WAN
+        (reference: latency_emulation.go zones)."""
+        from cometbft_tpu.tools.manifest import (
+            Manifest, ManifestNode, run_manifest,
+        )
+
+        def build(latency_ms):
+            m = Manifest(chain_id="zones-net", load_tx_rate=10,
+                         load_tx_size=128)
+            for i in range(3):
+                m.nodes[f"validator{i:02d}"] = ManifestNode(
+                    mode="validator",
+                    zone="zone-a" if i < 2 else "zone-b")
+                m.validators[f"validator{i:02d}"] = 100
+            if latency_ms:
+                m.latency_ms["zone-a:zone-b"] = latency_ms
+            return m
+
+        async def run():
+            import time
+
+            with tempfile.TemporaryDirectory() as d:
+                t0 = time.monotonic()
+                rep = await run_manifest(build(120), d,
+                                         target_height=5,
+                                         timeout_s=120.0)
+                slow = time.monotonic() - t0
+                assert all(h >= 5 for h in rep.heights.values())
+                assert rep.mismatches == []
+            # votes from the zone-b validator cross the 120 ms links,
+            # so each height needs at least one WAN round trip
+            assert slow > 2.0, f"latency had no effect ({slow:.1f}s)"
+        asyncio.run(run())
+
+    def test_abci_delay_knobs_reach_the_app(self):
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.abci import types as abci
+
+        async def run():
+            import time
+
+            app = KVStoreApplication()
+            app.abci_delays = {"check_tx": 0.05}
+            t0 = time.monotonic()
+            await app.check_tx(abci.CheckTxRequest(tx=b"a=b",
+                                                   type=0))
+            assert time.monotonic() - t0 >= 0.05
+        asyncio.run(run())
