@@ -173,6 +173,9 @@ class ClientCreator:
                             sync=self._transport != "builtin_unsync")
         if self._transport in ("socket", "unix", "tcp"):
             return SocketAppConns(self._addr)
+        if self._transport == "grpc":
+            from .grpc import GRPCAppConns
+            return GRPCAppConns(self._addr)
         raise ABCIClientError(
             f"transport {self._transport!r} not supported")
 

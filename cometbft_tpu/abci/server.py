@@ -160,9 +160,15 @@ def main(argv=None) -> int:
                     "socket_server.go)")
     ap.add_argument("--address", default="unix:///tmp/abci.sock")
     ap.add_argument("--app", default="kvstore")
+    ap.add_argument("--transport", default="socket",
+                    choices=["socket", "grpc"])
     args = ap.parse_args(argv)
     app = _build_app(args.app)
-    srv = SocketServer(args.address, app)
+    if args.transport == "grpc":
+        from .grpc import GRPCServer
+        srv = GRPCServer(args.address, app)
+    else:
+        srv = SocketServer(args.address, app)
     try:
         asyncio.run(srv.serve_forever())
     except KeyboardInterrupt:

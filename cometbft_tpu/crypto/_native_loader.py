@@ -101,6 +101,24 @@ def load(allow_build: bool = True):
     return _mod
 
 
+def batched_hashes(fn_name: str, items,
+                   min_items: int = 8) -> Optional[list]:
+    """Run one of the module's batch digest functions (sha256_many /
+    leaf_hashes) and split the concatenated 32-byte output — or None
+    when the batch is small, the module isn't built yet (never builds
+    here: hot paths), or the items aren't plain bytes."""
+    if len(items) < min_items:
+        return None
+    mod = load(allow_build=False)
+    if mod is None:
+        return None
+    try:
+        cat = getattr(mod, fn_name)(list(items))
+    except TypeError:
+        return None
+    return [cat[i * 32:(i + 1) * 32] for i in range(len(items))]
+
+
 def prebuild_async() -> None:
     """Kick the g++ build on a daemon thread (node startup calls this
     so the first big merkle hash never blocks the event loop)."""

@@ -10,7 +10,7 @@ A JAX-vectorized tree hash for large leaf counts lives in ops/merkle_jax.py.
 from __future__ import annotations
 
 from dataclasses import dataclass, field
-from typing import Optional, Sequence
+from typing import Sequence
 
 from .tmhash import sum as _sha256
 
@@ -119,17 +119,8 @@ def proofs_from_byte_slices(items: Sequence[bytes]) -> tuple[bytes, list[Proof]]
     """Root + one inclusion proof per item (reference: proof.go:40).
     Leaf hashing is batched through the C++ fast path when available
     (part-set splitting runs this on every proposal block)."""
-    hashes: Optional[list[bytes]] = None
-    if len(items) >= 8:
-        from ._native_loader import load
-        native = load(allow_build=False)
-        if native is not None:
-            try:
-                cat = native.leaf_hashes(list(items))
-                hashes = [cat[i * 32:(i + 1) * 32]
-                          for i in range(len(items))]
-            except TypeError:
-                pass
+    from ._native_loader import batched_hashes
+    hashes = batched_hashes("leaf_hashes", items)
     if hashes is None:
         hashes = [leaf_hash(it) for it in items]
     trails, root_node = _trails_from_leaf_hashes(hashes)

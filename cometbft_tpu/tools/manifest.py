@@ -254,6 +254,11 @@ class Relay:
     async def wait_closed(self) -> None:
         if self.server is not None:
             await self.server.wait_closed()
+        # handler/pump tasks were cancelled by close(): wait them out
+        # so loop teardown never sees pending relay tasks
+        if self.tasks:
+            await asyncio.gather(*list(self.tasks),
+                                 return_exceptions=True)
 
 
 async def start_relay(spec: RelaySpec) -> Relay:
@@ -338,6 +343,9 @@ class RunReport:
     load_accepted: int = 0
     perturbed: list[str] = field(default_factory=list)
     mismatches: list[str] = field(default_factory=list)
+    # seconds from first boot until every node reached target_height
+    # (excludes load-drain/teardown; the benchmark-comparable number)
+    reached_target_s: float = 0.0
 
 
 async def run_manifest(manifest: Manifest, outdir: str,
@@ -355,7 +363,7 @@ async def run_manifest(manifest: Manifest, outdir: str,
     nodes: dict[str, Node] = {}
     report = RunReport(target_height=target_height)
     load_task: Optional[asyncio.Task] = None
-    relay_servers = [await start_relay(r) for r in relay_specs]
+    relay_servers: list[Relay] = []
 
     def _apply_delays(node: Node) -> None:
         delays = {
@@ -372,6 +380,9 @@ async def run_manifest(manifest: Manifest, outdir: str,
             node.app.abci_delays = delays
 
     try:
+        boot_t0 = asyncio.get_event_loop().time()
+        for r in relay_specs:
+            relay_servers.append(await start_relay(r))
         # start_at=0 nodes boot now; late joiners wait for the height
         for name, cfg in cfgs.items():
             if manifest.nodes[name].start_at == 0:
@@ -430,6 +441,8 @@ async def run_manifest(manifest: Manifest, outdir: str,
                 await nodes[name].start()
 
         await wait_height(target_height, timeout_s / 2)
+        report.reached_target_s = \
+            asyncio.get_event_loop().time() - boot_t0
     finally:
         if load_task is not None:
             await load_task

@@ -27,18 +27,10 @@ def txs_hash(txs: Sequence[bytes]) -> bytes:
 def hash_each(txs: Sequence[bytes]) -> list[bytes]:
     """Per-tx sha256 digests, batched through the C++ fast path for
     larger blocks (reference: Txs.Hash's per-tx TxID loop)."""
-    if len(txs) >= 8:
-        from ..crypto._native_loader import load
-        native = load(allow_build=False)
-        if native is not None:
-            try:
-                cat = native.sha256_many(list(txs))
-            except TypeError:
-                pass
-            else:
-                return [cat[i * 32:(i + 1) * 32]
-                        for i in range(len(txs))]
-    return [tx_hash(tx) for tx in txs]
+    from ..crypto._native_loader import batched_hashes
+    hashes = batched_hashes("sha256_many", txs)
+    return hashes if hashes is not None else \
+        [tx_hash(tx) for tx in txs]
 
 
 def txs_proof(txs: Sequence[bytes], index: int):

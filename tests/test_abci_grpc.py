@@ -1,0 +1,135 @@
+"""ABCI over gRPC (reference: proto/cometbft/abci/v2/service.proto,
+abci/client/grpc_client.go, abci/server/grpc_server.go)."""
+import asyncio
+import os
+import subprocess
+import sys
+import tempfile
+
+from cometbft_tpu.abci import types as abci
+from cometbft_tpu.abci.grpc import GRPCClient, GRPCServer
+from cometbft_tpu.abci.kvstore import KVStoreApplication
+
+
+class TestGRPCClientServer:
+    def test_echo_info_checktx_commit(self):
+        async def run():
+            app = KVStoreApplication()
+            srv = GRPCServer("127.0.0.1:0", app)
+            await srv.start()
+            cli = GRPCClient(f"127.0.0.1:{srv.port}")
+            await cli.connect()
+            try:
+                assert (await cli.echo("hello")).message == "hello"
+                info = await cli.info(abci.InfoRequest())
+                assert info.last_block_height == 0
+                res = await cli.check_tx(abci.CheckTxRequest(
+                    tx=b"k=v", type=abci.CHECK_TX_TYPE_CHECK))
+                assert res.code == 0
+                bad = await cli.check_tx(abci.CheckTxRequest(
+                    tx=b"notatx", type=abci.CHECK_TX_TYPE_CHECK))
+                assert bad.code != 0
+                await cli.flush()
+            finally:
+                await cli.close()
+                await srv.stop()
+        asyncio.run(run())
+
+    def test_concurrent_calls_one_channel(self):
+        """The gRPC client is connection-concurrent — many in-flight
+        calls share one channel (reference: grpc_client.go)."""
+        async def run():
+            app = KVStoreApplication()
+            srv = GRPCServer("127.0.0.1:0", app)
+            await srv.start()
+            cli = GRPCClient(f"127.0.0.1:{srv.port}")
+            await cli.connect()
+            try:
+                results = await asyncio.gather(*(
+                    cli.check_tx(abci.CheckTxRequest(
+                        tx=f"k{i}=v{i}".encode(),
+                        type=abci.CHECK_TX_TYPE_CHECK))
+                    for i in range(50)))
+                assert all(r.code == 0 for r in results)
+            finally:
+                await cli.close()
+                await srv.stop()
+        asyncio.run(run())
+
+
+class TestNodeWithGRPCApp:
+    def test_node_over_external_grpc_kvstore(self):
+        """Full node drives a kvstore in a separate process over gRPC
+        (reference: e2e 'grpc' ABCI protocol mode)."""
+        from cometbft_tpu.config import Config
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.privval import FilePV
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc, GenesisValidator,
+        )
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                import socket as pysocket
+                s = pysocket.socket()
+                s.bind(("127.0.0.1", 0))
+                port = s.getsockname()[1]
+                s.close()
+                proc = subprocess.Popen(
+                    [sys.executable, "-m", "cometbft_tpu.abci.server",
+                     "--address", f"127.0.0.1:{port}",
+                     "--app", "kvstore", "--transport", "grpc"],
+                    stdout=subprocess.DEVNULL,
+                    stderr=subprocess.DEVNULL,
+                    env={**os.environ, "JAX_PLATFORMS": ""})
+                try:
+                    home = os.path.join(d, "node")
+                    cfg = Config()
+                    cfg.base.home = home
+                    cfg.base.abci = "grpc"
+                    cfg.base.proxy_app = f"127.0.0.1:{port}"
+                    cfg.p2p.laddr = "tcp://127.0.0.1:0"
+                    cfg.rpc.laddr = ""
+                    cfg.consensus.timeout_commit = 0.05
+                    os.makedirs(os.path.join(home, "config"),
+                                exist_ok=True)
+                    os.makedirs(os.path.join(home, "data"),
+                                exist_ok=True)
+                    pv = FilePV.generate(
+                        cfg.base.path(cfg.base.priv_validator_key_file),
+                        cfg.base.path(
+                            cfg.base.priv_validator_state_file))
+                    NodeKey.load_or_gen(
+                        cfg.base.path(cfg.base.node_key_file))
+                    GenesisDoc(
+                        chain_id="grpc-abci-chain",
+                        genesis_time=Timestamp.now(),
+                        validators=[GenesisValidator(
+                            address=b"", pub_key=pv.get_pub_key(),
+                            power=10)],
+                    ).save_as(cfg.base.path(cfg.base.genesis_file))
+                    node = Node(cfg)
+                    await node.start()
+                    for _ in range(200):
+                        if node.height >= 2:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert node.height >= 2, "no blocks produced"
+                    await node.mempool.check_tx(b"grpc=abci")
+                    value = b""
+                    for _ in range(200):
+                        res = await node.app_conns.query.query(
+                            abci.QueryRequest(path="/store",
+                                              data=b"grpc"))
+                        value = res.value
+                        if value:
+                            break
+                        await asyncio.sleep(0.05)
+                    assert value == b"abci"
+                    await node.stop()
+                finally:
+                    proc.terminate()
+                    proc.wait(timeout=10)
+        asyncio.run(run())
