@@ -27,8 +27,11 @@ import numpy as np
 
 N = 10_000
 MSG_LEN = 110                      # ~vote sign-bytes size
+# budget one TPU attempt at 10 min: the pooled backend can hang in
+# claim indefinitely, and the CPU fallback still needs headroom inside
+# the driver's overall bench window
 TPU_ATTEMPT_TIMEOUT_S = int(os.environ.get("COMETBFT_TPU_BENCH_TIMEOUT",
-                                           "1100"))
+                                           "600"))
 CPU_ATTEMPT_TIMEOUT_S = 1200
 
 
@@ -112,10 +115,21 @@ def child(platform: str) -> int:
         os.environ["COMETBFT_TPU_KERNEL"] = "pallas"
     elif platform == "tpu-xla":
         os.environ["COMETBFT_TPU_KERNEL"] = "xla"
-    import jax
+    import threading
 
     t0 = time.perf_counter()
+    ticker_stop = threading.Event()
+
+    def _tick():
+        while not ticker_stop.wait(30.0):
+            log(f"[bench] still waiting for TPU backend "
+                f"({time.perf_counter() - t0:.0f}s)")
+    threading.Thread(target=_tick, daemon=True).start()
+
+    import jax
+
     devs = jax.devices()
+    ticker_stop.set()
     log(f"[bench] backend up in {time.perf_counter() - t0:.1f}s: {devs}")
 
     items = make_workload(N)

@@ -66,6 +66,12 @@ def _grpc_addr(addr: str) -> str:
     return addr
 
 
+# ABCI payloads (blocks, snapshot chunks) routinely exceed gRPC's
+# default 4 MiB cap; the reference client dials with unbounded sizes
+GRPC_OPTIONS = [("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1)]
+
+
 class GRPCServer:
     """Serve an Application as the reference's ABCIService
     (abci/server/grpc_server.go)."""
@@ -101,7 +107,7 @@ class GRPCServer:
             def service(self, details):
                 return handlers.get(details.method)
 
-        self._server = grpc.aio.server()
+        self._server = grpc.aio.server(options=GRPC_OPTIONS)
         self._server.add_generic_rpc_handlers((_H(),))
         self.port = self._server.add_insecure_port(
             _grpc_addr(self.address))
@@ -119,29 +125,39 @@ class GRPCServer:
             await self.start()
         await self._server.wait_for_termination()
 
+    @property
+    def _dispatch_table(self):
+        # built once per server (request hot path)
+        table = getattr(self, "_table", None)
+        if table is None:
+            app = self.app
+            table = {
+                "InfoRequest": app.info,
+                "InitChainRequest": app.init_chain,
+                "QueryRequest": app.query,
+                "CheckTxRequest": app.check_tx,
+                "CommitRequest": app.commit,
+                "ListSnapshotsRequest": app.list_snapshots,
+                "OfferSnapshotRequest": app.offer_snapshot,
+                "LoadSnapshotChunkRequest": app.load_snapshot_chunk,
+                "ApplySnapshotChunkRequest": app.apply_snapshot_chunk,
+                "PrepareProposalRequest": app.prepare_proposal,
+                "ProcessProposalRequest": app.process_proposal,
+                "ExtendVoteRequest": app.extend_vote,
+                "VerifyVoteExtensionRequest":
+                    app.verify_vote_extension,
+                "FinalizeBlockRequest": app.finalize_block,
+            }
+            self._table = table
+        return table
+
     async def _dispatch(self, req):
-        app = self.app
         t = type(req).__name__
         if t == "EchoRequest":
-            return await app.echo(req)
+            return await self.app.echo(req)
         if t == "FlushRequest":
             return abci.FlushResponse()
-        table = {
-            "InfoRequest": app.info, "InitChainRequest": app.init_chain,
-            "QueryRequest": app.query, "CheckTxRequest": app.check_tx,
-            "ListSnapshotsRequest": app.list_snapshots,
-            "OfferSnapshotRequest": app.offer_snapshot,
-            "LoadSnapshotChunkRequest": app.load_snapshot_chunk,
-            "ApplySnapshotChunkRequest": app.apply_snapshot_chunk,
-            "PrepareProposalRequest": app.prepare_proposal,
-            "ProcessProposalRequest": app.process_proposal,
-            "ExtendVoteRequest": app.extend_vote,
-            "VerifyVoteExtensionRequest": app.verify_vote_extension,
-            "FinalizeBlockRequest": app.finalize_block,
-        }
-        if t == "CommitRequest":
-            return await app.commit(req)
-        fn = table.get(t)
+        fn = self._dispatch_table.get(t)
         if fn is None:
             raise ValueError(f"unknown request {t}")
         return await fn(req)
@@ -154,11 +170,26 @@ class GRPCClient:
     def __init__(self, address: str):
         self.address = address
         self._channel: Optional[grpc.aio.Channel] = None
+        self._calls: dict = {}
 
     async def connect(self, retries: int = 80,
                       delay_s: float = 0.05) -> None:
+        if self._channel is not None:
+            await self.close()
         self._channel = grpc.aio.insecure_channel(
-            _grpc_addr(self.address))
+            _grpc_addr(self.address), options=GRPC_OPTIONS)
+        # one multicallable per method, built once (CheckTx is the
+        # per-tx hot path)
+        self._calls = {
+            method: self._channel.unary_unary(
+                f"/{SERVICE}/{method}",
+                request_serializer=(
+                    lambda m, d=req_desc: encode(d, m)),
+                response_deserializer=(
+                    lambda b, d=resp_desc: decode(d, b)))
+            for method, (key, req_desc, resp_desc)
+            in _METHODS.items()
+        }
         # wait for the server (reference: dialerFunc retry loop)
         import asyncio
         for i in range(retries):
@@ -167,6 +198,7 @@ class GRPCClient:
                 return
             except grpc.aio.AioRpcError:
                 if i == retries - 1:
+                    await self.close()
                     raise
                 await asyncio.sleep(delay_s)
 
@@ -174,16 +206,13 @@ class GRPCClient:
         if self._channel is not None:
             await self._channel.close()
             self._channel = None
+            self._calls = {}
 
     async def _call(self, method: str, req) -> object:
-        key, req_desc, resp_desc = _METHODS[method]
+        key = _METHODS[method][0]
         env = codec.request_to_proto(req)
         bare = next(iter(env.values())) if env else {}
-        fn = self._channel.unary_unary(
-            f"/{SERVICE}/{method}",
-            request_serializer=lambda m: encode(req_desc, m),
-            response_deserializer=lambda b: decode(resp_desc, b))
-        resp_dict = await fn(bare)
+        resp_dict = await self._calls[method](bare)
         return codec.response_from_proto({key: resp_dict})
 
     # -- the 15-method surface + echo/flush -----------------------------

@@ -16,7 +16,10 @@ from . import pb
 
 class _BaseClient:
     def __init__(self, addr: str):
-        self._channel = grpc.aio.insecure_channel(_grpc_addr(addr))
+        self._channel = grpc.aio.insecure_channel(
+            _grpc_addr(addr), options=[
+                ("grpc.max_send_message_length", -1),
+                ("grpc.max_receive_message_length", -1)])
 
     async def close(self) -> None:
         await self._channel.close()
