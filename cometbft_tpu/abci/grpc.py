@@ -83,6 +83,22 @@ class GRPCServer:
         self.logger = logger or new_logger("abci-grpc-server")
         self._server: Optional[grpc.aio.Server] = None
         self.port: Optional[int] = None
+        self._table = {
+            "InfoRequest": app.info,
+            "InitChainRequest": app.init_chain,
+            "QueryRequest": app.query,
+            "CheckTxRequest": app.check_tx,
+            "CommitRequest": app.commit,
+            "ListSnapshotsRequest": app.list_snapshots,
+            "OfferSnapshotRequest": app.offer_snapshot,
+            "LoadSnapshotChunkRequest": app.load_snapshot_chunk,
+            "ApplySnapshotChunkRequest": app.apply_snapshot_chunk,
+            "PrepareProposalRequest": app.prepare_proposal,
+            "ProcessProposalRequest": app.process_proposal,
+            "ExtendVoteRequest": app.extend_vote,
+            "VerifyVoteExtensionRequest": app.verify_vote_extension,
+            "FinalizeBlockRequest": app.finalize_block,
+        }
 
     async def start(self) -> None:
         handlers: dict[str, grpc.RpcMethodHandler] = {}
@@ -125,39 +141,13 @@ class GRPCServer:
             await self.start()
         await self._server.wait_for_termination()
 
-    @property
-    def _dispatch_table(self):
-        # built once per server (request hot path)
-        table = getattr(self, "_table", None)
-        if table is None:
-            app = self.app
-            table = {
-                "InfoRequest": app.info,
-                "InitChainRequest": app.init_chain,
-                "QueryRequest": app.query,
-                "CheckTxRequest": app.check_tx,
-                "CommitRequest": app.commit,
-                "ListSnapshotsRequest": app.list_snapshots,
-                "OfferSnapshotRequest": app.offer_snapshot,
-                "LoadSnapshotChunkRequest": app.load_snapshot_chunk,
-                "ApplySnapshotChunkRequest": app.apply_snapshot_chunk,
-                "PrepareProposalRequest": app.prepare_proposal,
-                "ProcessProposalRequest": app.process_proposal,
-                "ExtendVoteRequest": app.extend_vote,
-                "VerifyVoteExtensionRequest":
-                    app.verify_vote_extension,
-                "FinalizeBlockRequest": app.finalize_block,
-            }
-            self._table = table
-        return table
-
     async def _dispatch(self, req):
         t = type(req).__name__
         if t == "EchoRequest":
             return await self.app.echo(req)
         if t == "FlushRequest":
             return abci.FlushResponse()
-        fn = self._dispatch_table.get(t)
+        fn = self._table.get(t)
         if fn is None:
             raise ValueError(f"unknown request {t}")
         return await fn(req)

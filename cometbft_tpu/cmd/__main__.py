@@ -178,6 +178,35 @@ def cmd_config_migrate(args) -> int:
     return 0
 
 
+def cmd_priv_val_server(args) -> int:
+    """Standalone remote signer daemon: dial the node's privval
+    listener and serve signing requests from a FilePV (reference:
+    cmd/priv_val_server + privval/signer_server.go)."""
+    import asyncio
+
+    from ..privval import FilePV
+    from ..privval.signer import SignerServer
+
+    pv = FilePV.load_or_generate(args.priv_key_file, args.state_file)
+    print(f"remote signer: validator "
+          f"{pv.get_pub_key().address().hex().upper()[:12]} "
+          f"-> {args.addr} (chain {args.chain_id})")
+
+    async def main():
+        srv = SignerServer(args.addr, args.chain_id, pv,
+                           retries=10 ** 9)
+        await srv.start()
+        try:
+            await asyncio.Event().wait()
+        finally:
+            await srv.stop()
+    try:
+        asyncio.run(main())
+    except KeyboardInterrupt:
+        pass
+    return 0
+
+
 def cmd_generate_manifests(args) -> int:
     """Reference: test/e2e/generator — write N random manifests."""
     from ..tools.manifest import generate
@@ -563,6 +592,16 @@ def main(argv=None) -> int:
         "migrate", help="normalize the config file to this schema")
     cv.add_argument("--dry-run", action="store_true")
     cv.set_defaults(fn=cmd_config_migrate)
+
+    sp = sub.add_parser(
+        "priv-val-server",
+        help="standalone remote signer daemon (dials the node)")
+    sp.add_argument("--addr", required=True,
+                    help="node's priv_validator_laddr to dial")
+    sp.add_argument("--chain-id", required=True)
+    sp.add_argument("--priv-key-file", required=True)
+    sp.add_argument("--state-file", required=True)
+    sp.set_defaults(fn=cmd_priv_val_server)
 
     sp = sub.add_parser(
         "generate-manifests",

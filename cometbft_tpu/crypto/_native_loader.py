@@ -33,7 +33,8 @@ def _sources() -> list[str]:
     d = _source_dir()
     return [os.path.join(d, "_native.cpp"),
             os.path.join(d, "sha256.hpp"),
-            os.path.join(d, "sha256_ni.hpp")]
+            os.path.join(d, "sha256_ni.hpp"),
+            os.path.join(d, "sha512.hpp")]
 
 
 def _target_fresh() -> bool:

@@ -16,10 +16,9 @@ from . import pb
 
 class _BaseClient:
     def __init__(self, addr: str):
+        from ...abci.grpc import GRPC_OPTIONS
         self._channel = grpc.aio.insecure_channel(
-            _grpc_addr(addr), options=[
-                ("grpc.max_send_message_length", -1),
-                ("grpc.max_receive_message_length", -1)])
+            _grpc_addr(addr), options=GRPC_OPTIONS)
 
     async def close(self) -> None:
         await self._channel.close()

@@ -84,9 +84,8 @@ class GRPCServer:
     # -- lifecycle ---------------------------------------------------------
     async def start(self, laddr: str) -> int:
         # blocks can exceed gRPC's default 4 MiB message cap
-        self._server = grpc.aio.server(options=[
-            ("grpc.max_send_message_length", -1),
-            ("grpc.max_receive_message_length", -1)])
+        from ...abci.grpc import GRPC_OPTIONS
+        self._server = grpc.aio.server(options=GRPC_OPTIONS)
         self._server.add_generic_rpc_handlers((self._handlers,))
         self.port = self._server.add_insecure_port(_grpc_addr(laddr))
         await self._server.start()

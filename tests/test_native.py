@@ -90,3 +90,19 @@ def _py_root(items):
     k = merkle._split_point(n)
     return hashlib.sha256(b"\x01" + _py_root(items[:k]) +
                           _py_root(items[k:])).digest()
+
+
+class TestEd25519Prep:
+    def test_malformed_items_marked_bad_no_error_state(self):
+        """Non-tuple / wrong-length items become pre_bad lanes without
+        leaving a live CPython error set (SystemError regression)."""
+        native = _native()
+        if not hasattr(native, "ed25519_prep"):
+            pytest.skip("older native module")
+        out = native.ed25519_prep(
+            [None, 42, (b"x" * 32, b"m", b"s" * 64),
+             (b"short", b"m", b"s" * 64)],
+            8, b"b" * 32, b"i" * 32)
+        a_b, r_b, s_win, k_win, bad = out
+        assert bad[0] == 1 and bad[1] == 1 and bad[3] == 1
+        assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64
