@@ -352,20 +352,14 @@ def _verify_chunk(items) -> np.ndarray:
         s_ok = differs & (s_be[np.arange(len(gi)), first] <
                           L_be[first])
         pre_bad[gi[~s_ok]] = True
-        # k = SHA-512(R || A || msg) mod L — batched in C++ when
-        # available, else the python reference (`native` from above;
-        # guard per-function: a stale prebuilt module may lack it)
-        if native is not None and \
-                hasattr(native, "ed25519_kscalars") and \
-                len(hashed) >= 8:
-            k_cat = native.ed25519_kscalars(hashed)
-            k_g = np.frombuffer(k_cat, np.uint8).reshape(-1, 32)
-        else:
-            k_g = np.zeros((len(gi), 32), np.uint8)
-            for j, buf in enumerate(hashed):
-                k = ref.sha512_mod_l(buf[:32], buf[32:64], buf[64:])
-                k_g[j] = np.frombuffer(k.to_bytes(32, "little"),
-                                       np.uint8)
+        # k = SHA-512(R || A || msg) mod L via the python reference —
+        # this branch only runs when the native module is absent (a
+        # module with ed25519_prep was handled above)
+        k_g = np.zeros((len(gi), 32), np.uint8)
+        for j, buf in enumerate(hashed):
+            k = ref.sha512_mod_l(buf[:32], buf[32:64], buf[64:])
+            k_g[j] = np.frombuffer(k.to_bytes(32, "little"),
+                                   np.uint8)
         keep = np.asarray(s_ok)
         a_b[gi[keep]] = a_g[keep]
         r_b[gi[keep]] = r_g[keep]

@@ -67,6 +67,9 @@ class Manifest:
     process_proposal_delay_ms: int = 0
     check_tx_delay_ms: int = 0
     finalize_block_delay_ms: int = 0
+    # duplicate-vote evidences to inject mid-run over RPC
+    # (reference: manifest.go Evidence + runner/evidence.go)
+    evidence: int = 0
 
     def link_delay_s(self, za: str, zb: str) -> float:
         if not za or not zb or za == zb:
@@ -136,6 +139,9 @@ def generate(seed: int = 0, max_nodes: int = 4) -> Manifest:
     if rng.random() < 0.3:
         m.finalize_block_delay_ms = rng.choice([20, 50])
         m.check_tx_delay_ms = rng.choice([0, 5])
+    # sometimes inject byzantine evidence mid-run
+    if rng.random() < 0.25:
+        m.evidence = rng.choice([1, 2, 4])
     return m
 
 
@@ -333,6 +339,79 @@ async def start_relay(spec: RelaySpec) -> Relay:
     return relay
 
 
+async def inject_evidence(manifest: Manifest, cfgs: dict,
+                          endpoint: str, count: int) -> list[str]:
+    """Forge `count` duplicate-vote evidences signed by a real
+    validator's key and submit them over RPC (reference:
+    runner/evidence.go — generates conflicting precommits against a
+    recent height and broadcasts them).  Returns evidence hashes."""
+    import base64
+
+    from ..privval import FilePV
+    from ..rpc.client import HTTPClient
+    from ..types import canonical
+    from ..types.block_id import BlockID
+    from ..types.evidence import DuplicateVoteEvidence
+    from ..types.part_set import PartSetHeader
+    from ..types.vote import Vote
+    from ..wire import encode as wencode, pb as wpb
+
+    # the byzantine validator: first validator in the manifest
+    val_name = next(name for name, nm in manifest.nodes.items()
+                    if nm.mode == "validator")
+    cfg = cfgs[val_name]
+    pv = FilePV.load_or_generate(
+        cfg.base.path(cfg.base.priv_validator_key_file),
+        cfg.base.path(cfg.base.priv_validator_state_file))
+    addr = pv.get_pub_key().address()
+
+    cli = HTTPClient(endpoint, timeout=30.0)
+    st = await cli.status()
+    tip = int(st["sync_info"]["latest_block_height"])
+    total_power = sum(
+        manifest.validators.get(name, 100)
+        for name, nm in manifest.nodes.items()
+        if nm.mode == "validator")
+    val_power = manifest.validators.get(val_name, 100)
+    vals = await cli.validators(max(1, tip - 2))
+    val_index = next(i for i, v in enumerate(vals.validators)
+                     if v.address == addr)
+
+    hashes = []
+    for j in range(count):
+        # heights may clamp together on a young chain, so the forged
+        # block ids vary per evidence — identical evidence would be
+        # deduped by the pool and never reach the requested count
+        h = max(1, tip - 2 - j)
+        sh, _ = await cli.commit(h)          # exact header time
+        votes = []
+        for tag in (bytes([1 + 2 * j]), bytes([2 + 2 * j])):
+            # a < b block-id order
+            v = Vote(type=canonical.PRECOMMIT_TYPE, height=h, round=0,
+                     block_id=BlockID(
+                         hash=tag * 32,
+                         part_set_header=PartSetHeader(1, tag * 32)),
+                     timestamp=sh.header.time,
+                     validator_address=addr,
+                     validator_index=val_index)
+            # sign directly with the raw key: FilePV would (rightly)
+            # refuse the second, conflicting signature
+            v.signature = pv.priv_key.sign(
+                v.sign_bytes(manifest.chain_id))
+            votes.append(v)
+        ev = DuplicateVoteEvidence(
+            vote_a=votes[0], vote_b=votes[1],
+            total_voting_power=total_power,
+            validator_power=val_power,
+            timestamp=sh.header.time)
+        raw = wencode(wpb.EVIDENCE, ev.to_proto_wrapped())
+        res = await cli.call(
+            "broadcast_evidence",
+            evidence=base64.b64encode(raw).decode())
+        hashes.append(res["hash"])
+    return hashes
+
+
 # -- runner (reference: runner/{start,perturb,wait}.go) ----------------------
 
 @dataclass
@@ -343,6 +422,8 @@ class RunReport:
     load_accepted: int = 0
     perturbed: list[str] = field(default_factory=list)
     mismatches: list[str] = field(default_factory=list)
+    evidence_injected: list[str] = field(default_factory=list)
+    evidence_committed: int = 0
     # seconds from first boot until every node reached target_height
     # (excludes load-drain/teardown; the benchmark-comparable number)
     reached_target_s: float = 0.0
@@ -440,9 +521,33 @@ async def run_manifest(manifest: Manifest, outdir: str,
                 _apply_delays(nodes[name])
                 await nodes[name].start()
 
+        # evidence stage (reference: runner/evidence.go InjectEvidence)
+        if manifest.evidence > 0:
+            report.evidence_injected = await inject_evidence(
+                manifest, cfgs, endpoint, manifest.evidence)
+
         await wait_height(target_height, timeout_s / 2)
         report.reached_target_s = \
             asyncio.get_event_loop().time() - boot_t0
+
+        # wait for injected evidence to land in committed blocks
+        if report.evidence_injected:
+            deadline = asyncio.get_event_loop().time() + timeout_s / 4
+            want = len(report.evidence_injected)
+            ref_node = next(iter(nodes.values()))
+            seen = 0
+            scanned = manifest.initial_height - 1
+            while asyncio.get_event_loop().time() < deadline:
+                # incremental: only newly committed blocks each tick
+                while scanned < ref_node.height:
+                    scanned += 1
+                    blk = ref_node.block_store.load_block(scanned)
+                    if blk is not None:
+                        seen += len(blk.evidence)
+                report.evidence_committed = seen
+                if seen >= want:
+                    break
+                await asyncio.sleep(0.1)
     finally:
         if load_task is not None:
             await load_task

@@ -248,7 +248,6 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
     std::memset(sw_p, 0, size_t(64) * m);
     std::memset(kw_p, 0, size_t(64) * m);
 
-    std::vector<uint8_t> msgbuf;
     for (Py_ssize_t i = 0; i < n; i++) {
         PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
         PyObject* fit = PySequence_Fast(it, "item must be a tuple");

@@ -106,3 +106,33 @@ class TestEd25519Prep:
         a_b, r_b, s_win, k_win, bad = out
         assert bad[0] == 1 and bad[1] == 1 and bad[3] == 1
         assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64
+
+
+class TestSha512AndKScalars:
+    def test_sha512_many_parity(self):
+        native = _native()
+        if not hasattr(native, "sha512_many"):
+            pytest.skip("older native module")
+        items = [secrets.token_bytes(n)
+                 for n in (0, 1, 63, 64, 111, 112, 127, 128, 129,
+                           500)]
+        cat = native.sha512_many(items)
+        for i, d in enumerate(items):
+            assert cat[i * 64:(i + 1) * 64] == \
+                hashlib.sha512(d).digest(), f"len {len(d)}"
+
+    def test_kscalars_barrett_mod_l_parity(self):
+        """The C Barrett reduction must match python big-int mod L —
+        this backs ed25519_prep's k-scalar math."""
+        native = _native()
+        if not hasattr(native, "ed25519_kscalars"):
+            pytest.skip("older native module")
+        L = 2 ** 252 + 27742317777372353535851937790883648493
+        items = [secrets.token_bytes(32 + i % 150)
+                 for i in range(500)]
+        cat = native.ed25519_kscalars(items)
+        for i, d in enumerate(items):
+            want = int.from_bytes(hashlib.sha512(d).digest(),
+                                  "little") % L
+            got = int.from_bytes(cat[i * 32:(i + 1) * 32], "little")
+            assert got == want, f"trial {i}"
