@@ -353,8 +353,9 @@ def _verify_chunk(items) -> np.ndarray:
                           L_be[first])
         pre_bad[gi[~s_ok]] = True
         # k = SHA-512(R || A || msg) mod L via the python reference —
-        # this branch only runs when the native module is absent (a
-        # module with ed25519_prep was handled above)
+        # this branch runs when the native module is absent or lacks
+        # ed25519_prep (both native entry points ship together, so a
+        # partial module cannot occur through our own loader)
         k_g = np.zeros((len(gi), 32), np.uint8)
         for j, buf in enumerate(hashed):
             k = ref.sha512_mod_l(buf[:32], buf[32:64], buf[64:])

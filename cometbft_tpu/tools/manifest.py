@@ -105,7 +105,9 @@ class Manifest:
 def generate(seed: int = 0, max_nodes: int = 4) -> Manifest:
     """Randomly sample the testnet config space (reference:
     test/e2e/generator/generate.go)."""
-    rng = random.Random(seed)
+    # scramble the seed: consecutive small seeds otherwise share
+    # their first Mersenne draws and sample near-identical configs
+    rng = random.Random((seed * 2654435761 + 97) % 2 ** 32)
     n_vals = rng.randint(2, max(2, max_nodes - 1))
     n_full = rng.randint(0, max(0, max_nodes - n_vals))
     m = Manifest(
@@ -219,6 +221,11 @@ def setup(manifest: Manifest, outdir: str
             for name, nm in manifest.nodes.items()
             if nm.mode == "validator"],
     )
+    # genesis must permit the net's key type or the first validator
+    # UPDATE (e.g. equivocation punishment) halts consensus
+    # (reference: runner/setup.go:169 sets PubKeyTypes = [KeyType])
+    doc.consensus_params.validator.pub_key_types = \
+        [manifest.key_type]
     relays: list[RelaySpec] = []
     for name, cfg in cfgs.items():
         doc.save_as(cfg.base.path(cfg.base.genesis_file))
@@ -385,12 +392,14 @@ async def inject_evidence(manifest: Manifest, cfgs: dict,
         h = max(1, tip - 2 - j)
         sh, _ = await cli.commit(h)          # exact header time
         votes = []
-        for tag in (bytes([1 + 2 * j]), bytes([2 + 2 * j])):
-            # a < b block-id order
+        # a < b block-id order via the leading byte; the j suffix
+        # keeps evidences distinct at any count without byte overflow
+        for lead in (b"\x01", b"\x02"):
+            bid = lead + j.to_bytes(31, "big")
             v = Vote(type=canonical.PRECOMMIT_TYPE, height=h, round=0,
                      block_id=BlockID(
-                         hash=tag * 32,
-                         part_set_header=PartSetHeader(1, tag * 32)),
+                         hash=bid,
+                         part_set_header=PartSetHeader(1, bid)),
                      timestamp=sh.header.time,
                      validator_address=addr,
                      validator_index=val_index)

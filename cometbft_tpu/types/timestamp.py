@@ -60,7 +60,11 @@ class Timestamp(NamedTuple):
 
     def rfc3339(self) -> str:
         dt = datetime.fromtimestamp(self.seconds, tz=timezone.utc)
-        base = dt.strftime("%Y-%m-%dT%H:%M:%S")
+        # NOT strftime("%Y..."): glibc renders year 1 as "1", which no
+        # RFC-3339 parser (including ours) accepts — the zero time
+        # 0001-01-01T00:00:00Z appears in every absent commit sig
+        base = (f"{dt.year:04d}-{dt.month:02d}-{dt.day:02d}"
+                f"T{dt.hour:02d}:{dt.minute:02d}:{dt.second:02d}")
         if self.nanos:
             frac = f"{self.nanos:09d}".rstrip("0")
             return f"{base}.{frac}Z"

@@ -275,3 +275,17 @@ class TestBitArray:
         a = BitArray.from_indices(130, [0, 64, 129])
         b = BitArray.from_proto(a.to_proto())
         assert a == b
+
+
+class TestZeroTimestampRendering:
+    def test_zero_time_round_trips_rfc3339(self):
+        """The zero time (0001-01-01T00:00:00Z — every absent commit
+        sig carries it) must render zero-padded and re-parse; glibc
+        strftime renders year 1 as '1', which broke commit JSON
+        round-trips."""
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        z = Timestamp.zero()
+        s = z.rfc3339()
+        assert s == "0001-01-01T00:00:00Z"
+        assert Timestamp.from_rfc3339(s) == z
