@@ -43,7 +43,10 @@ class Logger:
         self._logger.warning(self._fmt(msg, kv))
 
     def error(self, msg: str, **kv: Any) -> None:
-        self._logger.error(self._fmt(msg, kv))
+        # exc_info is a directive for the underlying logger (log the
+        # active traceback), not a structured field
+        exc_info = kv.pop("exc_info", None)
+        self._logger.error(self._fmt(msg, kv), exc_info=exc_info)
 
 
 def _render(v: Any) -> str:

@@ -172,10 +172,18 @@ class RPCServer:
             return _err_response(rpc_id, -32602, "Invalid params",
                                  str(e))
         except Exception as e:
+            # correlate the client-visible error with the server log
+            # line via a trace id (reference: internal/rpctrace — "ask
+            # the operator about error <uuid>" without leaking
+            # internals to the caller)
+            import uuid
+            trace = uuid.uuid4().hex[:16]
             self.logger.error("RPC method failed", method=name,
-                              err=str(e))
-            return _err_response(rpc_id, -32603, "Internal error",
-                                 str(e))
+                              err=str(e), trace=trace,
+                              exc_info=True)
+            return _err_response(
+                rpc_id, -32603, "Internal error",
+                f"error trace {trace} (see server log)")
         return {"jsonrpc": "2.0", "id": rpc_id, "result": result}
 
 

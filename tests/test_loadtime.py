@@ -90,3 +90,24 @@ class TestLoadAgainstLiveNode:
                 finally:
                     await node.stop()
         asyncio.run(run())
+
+
+class TestBaselineBenchmarks:
+    def test_configs_run_at_tiny_sizes(self):
+        """The BASELINE benchmark configs (#2-#5) execute and emit
+        sane timings (tools/benchmarks.py)."""
+        from cometbft_tpu.crypto import batch as crypto_batch
+        from cometbft_tpu.tools import benchmarks as b
+
+        crypto_batch.set_backend("cpu")
+        try:
+            r2 = b.config2_batch_verify(sizes=(16,))
+            assert r2["results_ms"]["16"] > 0
+            r3 = b.config3_light_client(n_vals=8, hops=2)
+            assert r3["value_ms"] > 0
+            r4 = b.config4_replay_tally(n_vals=8, heights=2)
+            assert r4["tally_ms_p50"] > 0
+            r5 = b.config5_mixed_stress(n_vals=12, n_bls=4)
+            assert r5["mixed_commit_verify_ms"] > 0
+        finally:
+            crypto_batch.set_backend("auto")
