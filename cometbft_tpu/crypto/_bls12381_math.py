@@ -28,6 +28,50 @@ from __future__ import annotations
 
 import hashlib
 
+
+# --- native fast path -------------------------------------------------------
+# The C++ port (native/bls12381.hpp) mirrors this module's formulas
+# exactly and is differentially tested against it; the hot verify-side
+# entry points below delegate when the module is built.  Point wire
+# format: raw affine big-endian coordinates, b"" = infinity.
+
+def _native():
+    from ._native_loader import load
+    mod = load(allow_build=False)
+    if mod is not None and hasattr(mod, "bls_pairings_product_is_one"):
+        return mod
+    return None
+
+
+def _g1_raw(pt) -> bytes:
+    if pt is None:
+        return b""
+    return pt[0].to_bytes(48, "big") + pt[1].to_bytes(48, "big")
+
+
+def _g1_unraw(b: bytes):
+    if b == b"":
+        return None
+    return (int.from_bytes(b[:48], "big"),
+            int.from_bytes(b[48:], "big"))
+
+
+def _g2_raw(pt) -> bytes:
+    if pt is None:
+        return b""
+    (x0, x1), (y0, y1) = pt
+    return (x0.to_bytes(48, "big") + x1.to_bytes(48, "big") +
+            y0.to_bytes(48, "big") + y1.to_bytes(48, "big"))
+
+
+def _g2_unraw(b: bytes):
+    if b == b"":
+        return None
+    return ((int.from_bytes(b[:48], "big"),
+             int.from_bytes(b[48:96], "big")),
+            (int.from_bytes(b[96:144], "big"),
+             int.from_bytes(b[144:], "big")))
+
 # --- base field -------------------------------------------------------------
 
 P = 0x1A0111EA397FE69A4B1BA7B6434BACD764774B84F38512BF6730D2A0F6B0F6241EABFFFEB153FFFFB9FEFFFFFFFFAAAB
@@ -317,6 +361,13 @@ def pt_add(ops, p1, p2):
 def pt_mul(ops, pt, k: int):
     if k < 0:
         return pt_mul(ops, pt_neg(ops, pt), -k)
+    if pt is not None and k:
+        native = _native()
+        if native is not None and ops in (G1_OPS, G2_OPS):
+            kb = k.to_bytes((k.bit_length() + 7) // 8, "big")
+            if ops is G1_OPS:
+                return _g1_unraw(native.bls_g1_mul(_g1_raw(pt), kb))
+            return _g2_unraw(native.bls_g2_mul(_g2_raw(pt), kb))
     out = None
     while k:
         if k & 1:
@@ -343,10 +394,22 @@ G2_GEN = (
 # --- subgroup / membership --------------------------------------------------
 
 def g1_in_subgroup(pt) -> bool:
+    native = _native()
+    if native is not None:
+        try:
+            return native.bls_g1_in_subgroup(_g1_raw(pt))
+        except ValueError:
+            return False        # coordinate >= p: not a valid point
     return pt_on_curve(G1_OPS, pt) and pt_mul(G1_OPS, pt, R_ORDER) is None
 
 
 def g2_in_subgroup(pt) -> bool:
+    native = _native()
+    if native is not None:
+        try:
+            return native.bls_g2_in_subgroup(_g2_raw(pt))
+        except ValueError:
+            return False
     return pt_on_curve(G2_OPS, pt) and pt_mul(G2_OPS, pt, R_ORDER) is None
 
 
@@ -424,6 +487,10 @@ def final_exponentiation(f):
 def pairings_product_is_one(pairs) -> bool:
     """prod e(P_i, Q_i) == 1, with P_i in G1 (affine Fq), Q_i in G2 (affine
     Fq2). One shared final exponentiation."""
+    native = _native()
+    if native is not None:
+        return native.bls_pairings_product_is_one(
+            [(_g1_raw(p), _g2_raw(q)) for p, q in pairs])
     f = F12_ONE
     for p1, q2 in pairs:
         if p1 is None or q2 is None:
@@ -634,6 +701,9 @@ def _map_to_curve_g2(u):
 
 
 def hash_to_g2(msg: bytes, dst: bytes):
+    native = _native()
+    if native is not None:
+        return _g2_unraw(native.bls_hash_to_g2(msg, dst))
     u0, u1 = hash_to_field_fq2(msg, dst, 2)
     q = pt_add(G2_OPS, _map_to_curve_g2(u0), _map_to_curve_g2(u1))
     return pt_mul(G2_OPS, q, H2)            # clear cofactor

@@ -34,7 +34,8 @@ def _sources() -> list[str]:
     return [os.path.join(d, "_native.cpp"),
             os.path.join(d, "sha256.hpp"),
             os.path.join(d, "sha256_ni.hpp"),
-            os.path.join(d, "sha512.hpp")]
+            os.path.join(d, "sha512.hpp"),
+            os.path.join(d, "bls12381.hpp")]
 
 
 def _target_fresh() -> bool:

@@ -224,7 +224,10 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="BASELINE benchmark configs #2-#5")
     ap.add_argument("--config", type=int, default=0,
-                    help="run a single config (2-5); 0 = all")
+                    choices=[0, 2, 3, 4, 5],
+                    help="run a single config (2-5); 0 = all. "
+                         "Config #1 (live testnet) is tools/"
+                         "manifest.py + `cometbft_tpu.cmd load`.")
     ap.add_argument("--full", action="store_true",
                     help="BASELINE sizes (1k light valset, 10k batch)")
     args = ap.parse_args(argv)

@@ -18,6 +18,7 @@
 
 #include "sha256.hpp"
 #include "sha512.hpp"
+#include "bls12381.hpp"
 
 namespace {
 
@@ -318,6 +319,184 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
     return out;
 }
 
+// --- BLS12-381 (see native/bls12381.hpp) -----------------------------------
+// Point wire format between python and C: raw affine coordinates,
+// big-endian —  G1: 96B x||y;  G2: 192B x0||x1||y0||y1;  b"" = infinity.
+
+bool parse_g1(PyObject* obj, bls::G1* out) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return false;
+    const uint8_t* b = reinterpret_cast<uint8_t*>(buf);
+    if (len == 0) {
+        out->inf = true;
+        return true;
+    }
+    if (len != 96) {
+        PyErr_SetString(PyExc_ValueError, "bad G1 length");
+        return false;
+    }
+    out->inf = false;
+    if (!bls::fp_from_be48(b, &out->x) ||
+        !bls::fp_from_be48(b + 48, &out->y)) {
+        PyErr_SetString(PyExc_ValueError, "G1 coordinate >= p");
+        return false;
+    }
+    return true;
+}
+
+bool parse_g2(PyObject* obj, bls::G2* out) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(obj, &buf, &len) < 0) return false;
+    const uint8_t* b = reinterpret_cast<uint8_t*>(buf);
+    if (len == 0) {
+        out->inf = true;
+        return true;
+    }
+    if (len != 192) {
+        PyErr_SetString(PyExc_ValueError, "bad G2 length");
+        return false;
+    }
+    out->inf = false;
+    if (!bls::fp_from_be48(b, &out->x.c0) ||
+        !bls::fp_from_be48(b + 48, &out->x.c1) ||
+        !bls::fp_from_be48(b + 96, &out->y.c0) ||
+        !bls::fp_from_be48(b + 144, &out->y.c1)) {
+        PyErr_SetString(PyExc_ValueError, "G2 coordinate >= p");
+        return false;
+    }
+    return true;
+}
+
+PyObject* g1_bytes(const bls::G1& p) {
+    if (p.inf) return PyBytes_FromStringAndSize("", 0);
+    uint8_t out[96];
+    bls::fp_to_be48(p.x, out);
+    bls::fp_to_be48(p.y, out + 48);
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<char*>(out), 96);
+}
+
+PyObject* g2_bytes(const bls::G2& p) {
+    if (p.inf) return PyBytes_FromStringAndSize("", 0);
+    uint8_t out[192];
+    bls::fp_to_be48(p.x.c0, out);
+    bls::fp_to_be48(p.x.c1, out + 48);
+    bls::fp_to_be48(p.y.c0, out + 96);
+    bls::fp_to_be48(p.y.c1, out + 144);
+    return PyBytes_FromStringAndSize(
+        reinterpret_cast<char*>(out), 192);
+}
+
+PyObject* bls_pairings_product_is_one(PyObject*, PyObject* arg) {
+    PyObject* fast = PySequence_Fast(arg, "expected a sequence");
+    if (!fast) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    std::vector<bls::Pair> pairs;
+    pairs.reserve(size_t(n));
+    for (Py_ssize_t i = 0; i < n; i++) {
+        PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
+        PyObject* fit = PySequence_Fast(it, "pair must be a tuple");
+        if (!fit || PySequence_Fast_GET_SIZE(fit) != 2) {
+            Py_XDECREF(fit);
+            Py_DECREF(fast);
+            if (!PyErr_Occurred())
+                PyErr_SetString(PyExc_ValueError, "pair must have 2 items");
+            return nullptr;
+        }
+        bls::Pair pr;
+        if (!parse_g1(PySequence_Fast_GET_ITEM(fit, 0), &pr.p) ||
+            !parse_g2(PySequence_Fast_GET_ITEM(fit, 1), &pr.q)) {
+            Py_DECREF(fit);
+            Py_DECREF(fast);
+            return nullptr;
+        }
+        pairs.push_back(pr);
+        Py_DECREF(fit);
+    }
+    Py_DECREF(fast);
+    bool ok;
+    Py_BEGIN_ALLOW_THREADS
+    ok = bls::pairings_product_is_one(pairs);
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(ok);
+}
+
+PyObject* bls_g1_in_subgroup(PyObject*, PyObject* arg) {
+    bls::G1 p;
+    if (!parse_g1(arg, &p)) return nullptr;
+    bool ok;
+    Py_BEGIN_ALLOW_THREADS
+    ok = bls::g1_in_subgroup(p);
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(ok);
+}
+
+PyObject* bls_g2_in_subgroup(PyObject*, PyObject* arg) {
+    bls::G2 p;
+    if (!parse_g2(arg, &p)) return nullptr;
+    bool ok;
+    Py_BEGIN_ALLOW_THREADS
+    ok = bls::g2_in_subgroup(p);
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(ok);
+}
+
+PyObject* bls_hash_to_g2(PyObject*, PyObject* args) {
+    const char* msg;
+    Py_ssize_t msg_len;
+    const char* dst;
+    Py_ssize_t dst_len;
+    if (!PyArg_ParseTuple(args, "y#y#", &msg, &msg_len, &dst,
+                          &dst_len))
+        return nullptr;
+    if (dst_len > 255) {
+        PyErr_SetString(PyExc_ValueError, "DST too long");
+        return nullptr;
+    }
+    bls::G2 r;
+    Py_BEGIN_ALLOW_THREADS
+    r = bls::hash_to_g2(reinterpret_cast<const uint8_t*>(msg),
+                        size_t(msg_len),
+                        reinterpret_cast<const uint8_t*>(dst),
+                        size_t(dst_len));
+    Py_END_ALLOW_THREADS
+    return g2_bytes(r);
+}
+
+PyObject* bls_g1_mul(PyObject*, PyObject* args) {
+    PyObject* pt_obj;
+    const char* k;
+    Py_ssize_t klen;
+    if (!PyArg_ParseTuple(args, "Oy#", &pt_obj, &k, &klen))
+        return nullptr;
+    bls::G1 p;
+    if (!parse_g1(pt_obj, &p)) return nullptr;
+    bls::G1 r;
+    Py_BEGIN_ALLOW_THREADS
+    r = p.inf ? p : bls::G1_mul_be_fast(
+        p, reinterpret_cast<const uint8_t*>(k), size_t(klen));
+    Py_END_ALLOW_THREADS
+    return g1_bytes(r);
+}
+
+PyObject* bls_g2_mul(PyObject*, PyObject* args) {
+    PyObject* pt_obj;
+    const char* k;
+    Py_ssize_t klen;
+    if (!PyArg_ParseTuple(args, "Oy#", &pt_obj, &k, &klen))
+        return nullptr;
+    bls::G2 p;
+    if (!parse_g2(pt_obj, &p)) return nullptr;
+    bls::G2 r;
+    Py_BEGIN_ALLOW_THREADS
+    r = p.inf ? p : bls::G2_mul_be_fast(
+        p, reinterpret_cast<const uint8_t*>(k), size_t(klen));
+    Py_END_ALLOW_THREADS
+    return g2_bytes(r);
+}
+
 PyObject* sha256_one(PyObject*, PyObject* arg) {
     char* buf;
     Py_ssize_t len;
@@ -343,6 +522,18 @@ PyMethodDef kMethods[] = {
     {"ed25519_prep", ed25519_prep, METH_VARARGS,
      "full batch-verify host prep: (items, m, B, identity) -> "
      "(a_b, r_b, s_win, k_win, pre_bad)"},
+    {"bls_pairings_product_is_one", bls_pairings_product_is_one,
+     METH_O, "prod e(P_i, Q_i) == 1 over raw affine pairs"},
+    {"bls_g1_in_subgroup", bls_g1_in_subgroup, METH_O,
+     "curve + r-order check for a raw affine G1 point"},
+    {"bls_g2_in_subgroup", bls_g2_in_subgroup, METH_O,
+     "curve + r-order check for a raw affine G2 point"},
+    {"bls_hash_to_g2", bls_hash_to_g2, METH_VARARGS,
+     "hash_to_g2(msg, dst) -> raw affine G2"},
+    {"bls_g1_mul", bls_g1_mul, METH_VARARGS,
+     "scalar multiple of a raw affine G1 point (k big-endian)"},
+    {"bls_g2_mul", bls_g2_mul, METH_VARARGS,
+     "scalar multiple of a raw affine G2 point (k big-endian)"},
     {"sha256", sha256_one, METH_O, "SHA-256 of one bytes object"},
     {nullptr, nullptr, 0, nullptr},
 };

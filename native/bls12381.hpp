@@ -1,0 +1,869 @@
+// BLS12-381 pairing arithmetic in C++ — the native fast path for the
+// engine's verify-side BLS (reference parity note: the reference's one
+// native dependency is the blst C library; this is the analogous
+// native component, built against OUR pure-python golden model in
+// cometbft_tpu/crypto/_bls12381_math.py).
+//
+// The structure mirrors the python module one-to-one — same tower
+// (Fq2 = Fq[u]/(u^2+1), Fq6 = Fq2[v]/(v^3-(1+u)), Fq12 = Fq6[w]/
+// (w^2-v)), same affine point formulas, same optimal-ate Miller loop
+// in E(Fq12), same naive final exponentiation, same custom
+// hash-to-curve (expand_message_xmd + try-and-increment; see the
+// python module docstring) — so every function can be differentially
+// tested against the golden model.  Fq uses 6x64 Montgomery
+// arithmetic (CIOS) for speed; everything above it is formula-
+// identical.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "sha256.hpp"
+
+namespace bls {
+
+// --- Fq: 6x64-limb Montgomery ----------------------------------------------
+
+struct Fp {
+    uint64_t v[6];
+};
+
+static const uint64_t P_LIMBS[6] = {
+    0xb9feffffffffaaabULL, 0x1eabfffeb153ffffULL,
+    0x6730d2a0f6b0f624ULL, 0x64774b84f38512bfULL,
+    0x4b1ba7b6434bacd7ULL, 0x1a0111ea397fe69aULL};
+static const uint64_t N0 = 0x89f3fffcfffcfffdULL;
+static const uint64_t R1_LIMBS[6] = {
+    0x760900000002fffdULL, 0xebf4000bc40c0002ULL,
+    0x5f48985753c758baULL, 0x77ce585370525745ULL,
+    0x5c071a97a256ec6dULL, 0x15f65ec3fa80e493ULL};
+static const uint64_t R2_LIMBS[6] = {
+    0xf4df1f341c341746ULL, 0x0a76e6a609d104f1ULL,
+    0x8de5476c4c95b6d5ULL, 0x67eb88a9939d83c0ULL,
+    0x9a793e85b519952dULL, 0x11988fe592cae3aaULL};
+
+inline Fp fp_zero() { Fp r{}; return r; }
+inline Fp fp_one() {
+    Fp r;
+    std::memcpy(r.v, R1_LIMBS, sizeof r.v);
+    return r;
+}
+
+inline bool fp_is_zero(const Fp& a) {
+    uint64_t acc = 0;
+    for (int i = 0; i < 6; i++) acc |= a.v[i];
+    return acc == 0;
+}
+
+inline bool fp_eq(const Fp& a, const Fp& b) {
+    uint64_t acc = 0;
+    for (int i = 0; i < 6; i++) acc |= a.v[i] ^ b.v[i];
+    return acc == 0;
+}
+
+inline int fp_cmp_raw(const uint64_t a[6], const uint64_t b[6]) {
+    for (int i = 5; i >= 0; i--) {
+        if (a[i] < b[i]) return -1;
+        if (a[i] > b[i]) return 1;
+    }
+    return 0;
+}
+
+inline void raw_sub_p(uint64_t a[6]) {
+    unsigned __int128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        unsigned __int128 d =
+            (unsigned __int128)a[i] - P_LIMBS[i] - (uint64_t)borrow;
+        a[i] = uint64_t(d);
+        borrow = (d >> 64) ? 1 : 0;
+    }
+}
+
+inline Fp fp_add(const Fp& a, const Fp& b) {
+    Fp r;
+    unsigned __int128 carry = 0;
+    for (int i = 0; i < 6; i++) {
+        unsigned __int128 s =
+            (unsigned __int128)a.v[i] + b.v[i] + (uint64_t)carry;
+        r.v[i] = uint64_t(s);
+        carry = s >> 64;
+    }
+    if (carry || fp_cmp_raw(r.v, P_LIMBS) >= 0) raw_sub_p(r.v);
+    return r;
+}
+
+inline Fp fp_sub(const Fp& a, const Fp& b) {
+    Fp r;
+    unsigned __int128 borrow = 0;
+    for (int i = 0; i < 6; i++) {
+        unsigned __int128 d =
+            (unsigned __int128)a.v[i] - b.v[i] - (uint64_t)borrow;
+        r.v[i] = uint64_t(d);
+        borrow = (d >> 64) ? 1 : 0;
+    }
+    if (borrow) {
+        unsigned __int128 carry = 0;
+        for (int i = 0; i < 6; i++) {
+            unsigned __int128 s =
+                (unsigned __int128)r.v[i] + P_LIMBS[i] +
+                (uint64_t)carry;
+            r.v[i] = uint64_t(s);
+            carry = s >> 64;
+        }
+    }
+    return r;
+}
+
+inline Fp fp_neg(const Fp& a) {
+    if (fp_is_zero(a)) return a;
+    Fp p;
+    std::memcpy(p.v, P_LIMBS, sizeof p.v);
+    return fp_sub(p, a);
+}
+
+// CIOS Montgomery multiplication
+inline Fp fp_mul(const Fp& a, const Fp& b) {
+    uint64_t t[8] = {0};
+    for (int i = 0; i < 6; i++) {
+        unsigned __int128 carry = 0;
+        for (int j = 0; j < 6; j++) {
+            unsigned __int128 cur =
+                (unsigned __int128)a.v[i] * b.v[j] + t[j] +
+                (uint64_t)carry;
+            t[j] = uint64_t(cur);
+            carry = cur >> 64;
+        }
+        unsigned __int128 s =
+            (unsigned __int128)t[6] + (uint64_t)carry;
+        t[6] = uint64_t(s);
+        t[7] = uint64_t(s >> 64);
+
+        uint64_t m = t[0] * N0;
+        carry = 0;
+        {
+            unsigned __int128 cur =
+                (unsigned __int128)m * P_LIMBS[0] + t[0];
+            carry = cur >> 64;
+        }
+        for (int j = 1; j < 6; j++) {
+            unsigned __int128 cur =
+                (unsigned __int128)m * P_LIMBS[j] + t[j] +
+                (uint64_t)carry;
+            t[j - 1] = uint64_t(cur);
+            carry = cur >> 64;
+        }
+        s = (unsigned __int128)t[6] + (uint64_t)carry;
+        t[5] = uint64_t(s);
+        t[6] = t[7] + uint64_t(s >> 64);
+        t[7] = 0;
+    }
+    Fp r;
+    std::memcpy(r.v, t, sizeof r.v);
+    if (t[6] || fp_cmp_raw(r.v, P_LIMBS) >= 0) raw_sub_p(r.v);
+    return r;
+}
+
+inline Fp fp_sqr(const Fp& a) { return fp_mul(a, a); }
+
+inline Fp fp_muli(const Fp& a, int k) {
+    Fp out = a;
+    for (int i = 1; i < k; i++) out = fp_add(out, a);
+    return out;
+}
+
+// generic pow over a big-endian exponent byte string
+inline Fp fp_pow_be(const Fp& a, const uint8_t* e, size_t elen) {
+    Fp out = fp_one();
+    bool started = false;
+    for (size_t i = 0; i < elen; i++) {
+        for (int b = 7; b >= 0; b--) {
+            if (started) out = fp_sqr(out);
+            if ((e[i] >> b) & 1) {
+                if (started) out = fp_mul(out, a);
+                else { out = a; started = true; }
+            }
+        }
+    }
+    return started ? out : fp_one();
+}
+
+static const uint8_t PM2_BE[48] = {
+    0x1a,0x01,0x11,0xea,0x39,0x7f,0xe6,0x9a,0x4b,0x1b,0xa7,0xb6,
+    0x43,0x4b,0xac,0xd7,0x64,0x77,0x4b,0x84,0xf3,0x85,0x12,0xbf,
+    0x67,0x30,0xd2,0xa0,0xf6,0xb0,0xf6,0x24,0x1e,0xab,0xff,0xfe,
+    0xb1,0x53,0xff,0xff,0xb9,0xfe,0xff,0xff,0xff,0xff,0xaa,0xa9};
+static const uint8_t PP14_BE[48] = {
+    0x06,0x80,0x44,0x7a,0x8e,0x5f,0xf9,0xa6,0x92,0xc6,0xe9,0xed,
+    0x90,0xd2,0xeb,0x35,0xd9,0x1d,0xd2,0xe1,0x3c,0xe1,0x44,0xaf,
+    0xd9,0xcc,0x34,0xa8,0x3d,0xac,0x3d,0x89,0x07,0xaa,0xff,0xff,
+    0xac,0x54,0xff,0xff,0xee,0x7f,0xbf,0xff,0xff,0xff,0xea,0xab};
+static const uint8_t PHALF_BE[48] = {
+    0x0d,0x00,0x88,0xf5,0x1c,0xbf,0xf3,0x4d,0x25,0x8d,0xd3,0xdb,
+    0x21,0xa5,0xd6,0x6b,0xb2,0x3b,0xa5,0xc2,0x79,0xc2,0x89,0x5f,
+    0xb3,0x98,0x69,0x50,0x7b,0x58,0x7b,0x12,0x0f,0x55,0xff,0xff,
+    0x58,0xa9,0xff,0xff,0xdc,0xff,0x7f,0xff,0xff,0xff,0xd5,0x55};
+
+inline Fp fp_inv(const Fp& a) { return fp_pow_be(a, PM2_BE, 48); }
+
+// from/to big-endian 48-byte standard form
+inline bool fp_from_be48(const uint8_t* b, Fp* out) {
+    uint64_t raw[6];
+    for (int i = 0; i < 6; i++) {
+        uint64_t v = 0;
+        for (int j = 0; j < 8; j++)
+            v = (v << 8) | b[(5 - i) * 8 + j];
+        raw[i] = v;
+    }
+    if (fp_cmp_raw(raw, P_LIMBS) >= 0) return false;
+    Fp t, r2;
+    std::memcpy(t.v, raw, sizeof t.v);
+    std::memcpy(r2.v, R2_LIMBS, sizeof r2.v);
+    *out = fp_mul(t, r2);      // to Montgomery
+    return true;
+}
+
+inline void fp_to_be48(const Fp& a, uint8_t* out) {
+    // from Montgomery: multiply by 1
+    Fp one{};
+    one.v[0] = 1;
+    Fp std_form = fp_mul(a, one);
+    for (int i = 0; i < 6; i++)
+        for (int j = 0; j < 8; j++)
+            out[(5 - i) * 8 + j] =
+                uint8_t(std_form.v[i] >> (56 - 8 * j));
+}
+
+inline Fp fp_from_u64(uint64_t x) {
+    Fp t{}, r2;
+    t.v[0] = x;
+    std::memcpy(r2.v, R2_LIMBS, sizeof r2.v);
+    return fp_mul(t, r2);
+}
+
+// standard-form (non-Montgomery) compare against (P-1)/2 for the
+// "lexicographically larger y" flag
+inline bool fp_is_larger(const Fp& a) {
+    uint8_t be[48];
+    fp_to_be48(a, be);
+    return std::memcmp(be, PHALF_BE, 48) > 0;
+}
+
+inline bool fp_is_odd(const Fp& a) {
+    uint8_t be[48];
+    fp_to_be48(a, be);
+    return be[47] & 1;
+}
+
+// sqrt via (p+1)/4 (p % 4 == 3); false if non-square
+inline bool fp_sqrt(const Fp& a, Fp* out) {
+    Fp r = fp_pow_be(a, PP14_BE, 48);
+    if (!fp_eq(fp_sqr(r), a)) return false;
+    *out = r;
+    return true;
+}
+
+// --- Fq2 --------------------------------------------------------------------
+
+struct Fp2 {
+    Fp c0, c1;
+};
+
+inline Fp2 f2_zero() { return {fp_zero(), fp_zero()}; }
+inline Fp2 f2_one() { return {fp_one(), fp_zero()}; }
+inline bool f2_is_zero(const Fp2& a) {
+    return fp_is_zero(a.c0) && fp_is_zero(a.c1);
+}
+inline bool f2_eq(const Fp2& a, const Fp2& b) {
+    return fp_eq(a.c0, b.c0) && fp_eq(a.c1, b.c1);
+}
+inline Fp2 f2_add(const Fp2& a, const Fp2& b) {
+    return {fp_add(a.c0, b.c0), fp_add(a.c1, b.c1)};
+}
+inline Fp2 f2_sub(const Fp2& a, const Fp2& b) {
+    return {fp_sub(a.c0, b.c0), fp_sub(a.c1, b.c1)};
+}
+inline Fp2 f2_neg(const Fp2& a) {
+    return {fp_neg(a.c0), fp_neg(a.c1)};
+}
+inline Fp2 f2_mul(const Fp2& a, const Fp2& b) {
+    Fp t0 = fp_mul(a.c0, b.c0);
+    Fp t1 = fp_mul(a.c1, b.c1);
+    Fp s = fp_mul(fp_add(a.c0, a.c1), fp_add(b.c0, b.c1));
+    return {fp_sub(t0, t1), fp_sub(fp_sub(s, t0), t1)};
+}
+inline Fp2 f2_sqr(const Fp2& a) {
+    Fp s = fp_mul(fp_add(a.c0, a.c1), fp_sub(a.c0, a.c1));
+    Fp d = fp_mul(a.c0, a.c1);
+    return {s, fp_add(d, d)};
+}
+inline Fp2 f2_muli(const Fp2& a, int k) {
+    return {fp_muli(a.c0, k), fp_muli(a.c1, k)};
+}
+inline Fp2 f2_inv(const Fp2& a) {
+    Fp d = fp_inv(fp_add(fp_sqr(a.c0), fp_sqr(a.c1)));
+    return {fp_mul(a.c0, d), fp_neg(fp_mul(a.c1, d))};
+}
+inline Fp2 f2_conj(const Fp2& a) { return {a.c0, fp_neg(a.c1)}; }
+inline Fp2 f2_mul_xi(const Fp2& a) {
+    // * (1 + u)
+    return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
+}
+
+// sqrt in Fq2, mirroring the python norm-trick implementation
+inline bool f2_sqrt(const Fp2& a, Fp2* out) {
+    if (fp_is_zero(a.c1)) {
+        Fp r;
+        if (fp_sqrt(a.c0, &r)) {
+            *out = {r, fp_zero()};
+            return true;
+        }
+        if (fp_sqrt(fp_neg(a.c0), &r)) {
+            *out = {fp_zero(), r};
+            return true;
+        }
+        return false;
+    }
+    Fp alpha;
+    if (!fp_sqrt(fp_add(fp_sqr(a.c0), fp_sqr(a.c1)), &alpha))
+        return false;
+    Fp inv2 = fp_inv(fp_from_u64(2));
+    Fp delta = fp_mul(fp_add(a.c0, alpha), inv2);
+    Fp x0;
+    if (!fp_sqrt(delta, &x0)) {
+        delta = fp_mul(fp_sub(a.c0, alpha), inv2);
+        if (!fp_sqrt(delta, &x0)) return false;
+    }
+    Fp x1 = fp_mul(a.c1, fp_inv(fp_add(x0, x0)));
+    Fp2 cand = {x0, x1};
+    if (!f2_eq(f2_sqr(cand), a)) return false;
+    *out = cand;
+    return true;
+}
+
+// --- Fq6, Fq12 --------------------------------------------------------------
+
+struct Fp6 {
+    Fp2 a0, a1, a2;
+};
+struct Fp12 {
+    Fp6 b0, b1;
+};
+
+inline Fp6 f6_zero() { return {f2_zero(), f2_zero(), f2_zero()}; }
+inline Fp6 f6_one() { return {f2_one(), f2_zero(), f2_zero()}; }
+inline bool f6_eq(const Fp6& a, const Fp6& b) {
+    return f2_eq(a.a0, b.a0) && f2_eq(a.a1, b.a1) &&
+           f2_eq(a.a2, b.a2);
+}
+inline Fp6 f6_add(const Fp6& a, const Fp6& b) {
+    return {f2_add(a.a0, b.a0), f2_add(a.a1, b.a1),
+            f2_add(a.a2, b.a2)};
+}
+inline Fp6 f6_sub(const Fp6& a, const Fp6& b) {
+    return {f2_sub(a.a0, b.a0), f2_sub(a.a1, b.a1),
+            f2_sub(a.a2, b.a2)};
+}
+inline Fp6 f6_neg(const Fp6& a) {
+    return {f2_neg(a.a0), f2_neg(a.a1), f2_neg(a.a2)};
+}
+inline Fp6 f6_mul(const Fp6& a, const Fp6& b) {
+    Fp2 t0 = f2_mul(a.a0, b.a0);
+    Fp2 t1 = f2_mul(a.a1, b.a1);
+    Fp2 t2 = f2_mul(a.a2, b.a2);
+    Fp2 c0 = f2_add(t0, f2_mul_xi(f2_sub(
+        f2_mul(f2_add(a.a1, a.a2), f2_add(b.a1, b.a2)),
+        f2_add(t1, t2))));
+    Fp2 c1 = f2_add(f2_sub(
+        f2_mul(f2_add(a.a0, a.a1), f2_add(b.a0, b.a1)),
+        f2_add(t0, t1)), f2_mul_xi(t2));
+    Fp2 c2 = f2_add(f2_sub(
+        f2_mul(f2_add(a.a0, a.a2), f2_add(b.a0, b.a2)),
+        f2_add(t0, t2)), t1);
+    return {c0, c1, c2};
+}
+inline Fp6 f6_sqr(const Fp6& a) { return f6_mul(a, a); }
+inline Fp6 f6_mul_v(const Fp6& a) {
+    return {f2_mul_xi(a.a2), a.a0, a.a1};
+}
+inline Fp6 f6_inv(const Fp6& a) {
+    Fp2 c0 = f2_sub(f2_sqr(a.a0), f2_mul_xi(f2_mul(a.a1, a.a2)));
+    Fp2 c1 = f2_sub(f2_mul_xi(f2_sqr(a.a2)), f2_mul(a.a0, a.a1));
+    Fp2 c2 = f2_sub(f2_sqr(a.a1), f2_mul(a.a0, a.a2));
+    Fp2 t = f2_inv(f2_add(
+        f2_mul(a.a0, c0),
+        f2_mul_xi(f2_add(f2_mul(a.a2, c1), f2_mul(a.a1, c2)))));
+    return {f2_mul(c0, t), f2_mul(c1, t), f2_mul(c2, t)};
+}
+
+inline Fp12 f12_zero() { return {f6_zero(), f6_zero()}; }
+inline Fp12 f12_one() { return {f6_one(), f6_zero()}; }
+inline bool f12_eq(const Fp12& a, const Fp12& b) {
+    return f6_eq(a.b0, b.b0) && f6_eq(a.b1, b.b1);
+}
+inline Fp12 f12_add(const Fp12& a, const Fp12& b) {
+    return {f6_add(a.b0, b.b0), f6_add(a.b1, b.b1)};
+}
+inline Fp12 f12_sub(const Fp12& a, const Fp12& b) {
+    return {f6_sub(a.b0, b.b0), f6_sub(a.b1, b.b1)};
+}
+inline Fp12 f12_neg(const Fp12& a) {
+    return {f6_neg(a.b0), f6_neg(a.b1)};
+}
+inline Fp12 f12_mul(const Fp12& a, const Fp12& b) {
+    Fp6 t0 = f6_mul(a.b0, b.b0);
+    Fp6 t1 = f6_mul(a.b1, b.b1);
+    Fp6 c0 = f6_add(t0, f6_mul_v(t1));
+    Fp6 c1 = f6_sub(f6_mul(f6_add(a.b0, a.b1), f6_add(b.b0, b.b1)),
+                    f6_add(t0, t1));
+    return {c0, c1};
+}
+inline Fp12 f12_sqr(const Fp12& a) { return f12_mul(a, a); }
+inline Fp12 f12_inv(const Fp12& a) {
+    Fp6 t = f6_inv(f6_sub(f6_sqr(a.b0), f6_mul_v(f6_sqr(a.b1))));
+    return {f6_mul(a.b0, t), f6_neg(f6_mul(a.b1, t))};
+}
+inline Fp12 f12_conj(const Fp12& a) { return {a.b0, f6_neg(a.b1)}; }
+
+inline Fp12 f12_pow_be(const Fp12& a, const uint8_t* e, size_t elen) {
+    Fp12 out = f12_one();
+    bool started = false;
+    for (size_t i = 0; i < elen; i++) {
+        for (int b = 7; b >= 0; b--) {
+            if (started) out = f12_sqr(out);
+            if ((e[i] >> b) & 1) {
+                if (started) out = f12_mul(out, a);
+                else { out = a; started = true; }
+            }
+        }
+    }
+    return started ? out : f12_one();
+}
+
+// --- affine points ----------------------------------------------------------
+
+struct G1 {
+    Fp x, y;
+    bool inf;
+};
+struct G2 {
+    Fp2 x, y;
+    bool inf;
+};
+struct G12 {
+    Fp12 x, y;
+    bool inf;
+};
+
+// one affine implementation per field, mirroring the python formulas
+
+#define DEFINE_PT_OPS(PT, F, fadd, fsub, fmul, fsqr, fneg, finv,      \
+                      fiszero, feq, fmuli)                            \
+    inline PT PT##_neg(const PT& p) {                                 \
+        if (p.inf) return p;                                          \
+        return {p.x, fneg(p.y), false};                               \
+    }                                                                 \
+    inline PT PT##_double(const PT& p) {                              \
+        if (p.inf) return p;                                          \
+        if (fiszero(p.y)) return {p.x, p.y, true};                    \
+        F m = fmul(fmuli(fsqr(p.x), 3),                               \
+                   finv(fmuli(p.y, 2)));                              \
+        F nx = fsub(fsqr(m), fmuli(p.x, 2));                          \
+        F ny = fsub(fmul(m, fsub(p.x, nx)), p.y);                     \
+        return {nx, ny, false};                                       \
+    }                                                                 \
+    inline PT PT##_add(const PT& a, const PT& b) {                    \
+        if (a.inf) return b;                                          \
+        if (b.inf) return a;                                          \
+        if (feq(a.x, b.x)) {                                          \
+            if (feq(a.y, b.y)) return PT##_double(a);                 \
+            return {a.x, a.y, true};                                  \
+        }                                                             \
+        F m = fmul(fsub(b.y, a.y), finv(fsub(b.x, a.x)));             \
+        F nx = fsub(fsub(fsqr(m), a.x), b.x);                         \
+        F ny = fsub(fmul(m, fsub(a.x, nx)), a.y);                     \
+        return {nx, ny, false};                                       \
+    }                                                                 \
+
+inline Fp fp_muli_(const Fp& a, int k) { return fp_muli(a, k); }
+DEFINE_PT_OPS(G1, Fp, fp_add, fp_sub, fp_mul, fp_sqr, fp_neg, fp_inv,
+              fp_is_zero, fp_eq, fp_muli_)
+DEFINE_PT_OPS(G2, Fp2, f2_add, f2_sub, f2_mul, f2_sqr, f2_neg,
+              f2_inv, f2_is_zero, f2_eq, f2_muli)
+
+// Jacobian scalar multiplication (one inversion at the end instead of
+// one per step): (X, Y, Z) with x = X/Z^2, y = Y/Z^3.  Used for the
+// long multiplications (subgroup checks, cofactor clearing, signing);
+// the result is normalized back to affine, so outputs are
+// byte-identical to the affine ladder and the python golden model.
+#define DEFINE_JAC_MUL(PT, F, fadd, fsub, fmul, fsqr, fneg, finv,     \
+                       fiszero, feq, fone)                            \
+    struct PT##Jac { F X, Y, Z; };                                    \
+    inline PT##Jac PT##_jac_double(const PT##Jac& p) {                \
+        if (fiszero(p.Z) || fiszero(p.Y)) return {p.X, p.Y,           \
+                                                  F{} /*zero*/};      \
+        F A = fsqr(p.X);                                              \
+        F B = fsqr(p.Y);                                              \
+        F C = fsqr(B);                                                \
+        F D0 = fsub(fsqr(fadd(p.X, B)), fadd(A, C));                  \
+        F D = fadd(D0, D0);                                           \
+        F E = fadd(fadd(A, A), A);                                    \
+        F X3 = fsub(fsqr(E), fadd(D, D));                             \
+        F C8 = fadd(C, C);                                            \
+        C8 = fadd(C8, C8);                                            \
+        C8 = fadd(C8, C8);                                            \
+        F Y3 = fsub(fmul(E, fsub(D, X3)), C8);                        \
+        F Z3 = fmul(fadd(p.Y, p.Y), p.Z);                             \
+        return {X3, Y3, Z3};                                          \
+    }                                                                 \
+    inline PT##Jac PT##_jac_add_affine(const PT##Jac& p,              \
+                                       const PT& q) {                 \
+        if (fiszero(p.Z)) {                                           \
+            /* p = inf: lift q */                                     \
+            return {q.x, q.y, fone()};                                \
+        }                                                             \
+        F Z2 = fsqr(p.Z);                                             \
+        F U2 = fmul(q.x, Z2);                                         \
+        F S2 = fmul(fmul(q.y, Z2), p.Z);                              \
+        if (feq(p.X, U2)) {                                           \
+            if (feq(p.Y, S2)) return PT##_jac_double(p);              \
+            return {p.X, p.Y, F{}};        /* p + (-p) = inf */       \
+        }                                                             \
+        F H = fsub(U2, p.X);                                          \
+        F HH = fsqr(H);                                               \
+        F I = fadd(HH, HH);                                           \
+        I = fadd(I, I);                                               \
+        F J = fmul(H, I);                                             \
+        F rr = fsub(S2, p.Y);                                         \
+        rr = fadd(rr, rr);                                            \
+        F V = fmul(p.X, I);                                           \
+        F X3 = fsub(fsub(fsqr(rr), J), fadd(V, V));                   \
+        F Y2J = fmul(p.Y, J);                                         \
+        F Y3 = fsub(fmul(rr, fsub(V, X3)), fadd(Y2J, Y2J));           \
+        F Z3 = fmul(fadd(p.Z, p.Z), H);                               \
+        return {X3, Y3, Z3};                                          \
+    }                                                                 \
+    inline PT PT##_jac_to_affine(const PT##Jac& p) {                  \
+        if (fiszero(p.Z)) return {F{}, F{}, true};                    \
+        F zi = finv(p.Z);                                             \
+        F zi2 = fsqr(zi);                                             \
+        return {fmul(p.X, zi2), fmul(fmul(p.Y, zi2), zi), false};     \
+    }                                                                 \
+    inline PT PT##_mul_be_fast(const PT& p, const uint8_t* k,         \
+                               size_t klen) {                         \
+        if (p.inf) return p;                                          \
+        PT##Jac acc = {F{}, F{}, F{}};      /* infinity (Z = 0) */    \
+        bool started = false;                                         \
+        for (size_t i = 0; i < klen; i++) {                           \
+            for (int b = 7; b >= 0; b--) {                            \
+                if (started) acc = PT##_jac_double(acc);              \
+                if ((k[i] >> b) & 1) {                                \
+                    acc = PT##_jac_add_affine(acc, p);                \
+                    started = true;                                   \
+                }                                                     \
+            }                                                         \
+        }                                                             \
+        return PT##_jac_to_affine(acc);                               \
+    }
+
+DEFINE_JAC_MUL(G1, Fp, fp_add, fp_sub, fp_mul, fp_sqr, fp_neg,
+               fp_inv, fp_is_zero, fp_eq, fp_one)
+DEFINE_JAC_MUL(G2, Fp2, f2_add, f2_sub, f2_mul, f2_sqr, f2_neg,
+               f2_inv, f2_is_zero, f2_eq, f2_one)
+inline bool f12_is_zero(const Fp12& a) { return f12_eq(a, f12_zero()); }
+inline Fp12 f12_muli(const Fp12& a, int k) {
+    Fp12 out = a;
+    for (int i = 1; i < k; i++) out = f12_add(out, a);
+    return out;
+}
+DEFINE_PT_OPS(G12, Fp12, f12_add, f12_sub, f12_mul, f12_sqr,
+              f12_neg, f12_inv, f12_is_zero, f12_eq, f12_muli)
+
+// curve equations
+inline bool g1_on_curve(const G1& p) {
+    if (p.inf) return true;
+    Fp b4 = fp_from_u64(4);
+    return fp_eq(fp_sqr(p.y),
+                 fp_add(fp_mul(fp_sqr(p.x), p.x), b4));
+}
+inline Fp2 g2_b() {
+    // 4 * (1 + u)
+    Fp f4 = fp_from_u64(4);
+    return {f4, f4};
+}
+inline bool g2_on_curve(const G2& p) {
+    if (p.inf) return true;
+    return f2_eq(f2_sqr(p.y),
+                 f2_add(f2_mul(f2_sqr(p.x), p.x), g2_b()));
+}
+
+static const uint8_t R_BE[32] = {
+    0x73,0xed,0xa7,0x53,0x29,0x9d,0x7d,0x48,0x33,0x39,0xd8,0x08,
+    0x09,0xa1,0xd8,0x05,0x53,0xbd,0xa4,0x02,0xff,0xfe,0x5b,0xfe,
+    0xff,0xff,0xff,0xff,0x00,0x00,0x00,0x01};
+
+inline bool g1_in_subgroup(const G1& p) {
+    if (!g1_on_curve(p)) return false;
+    if (p.inf) return true;
+    return G1_mul_be_fast(p, R_BE, 32).inf;
+}
+inline bool g2_in_subgroup(const G2& p) {
+    if (!g2_on_curve(p)) return false;
+    if (p.inf) return true;
+    return G2_mul_be_fast(p, R_BE, 32).inf;
+}
+
+// --- pairing ----------------------------------------------------------------
+
+inline Fp12 f12_from_f2(const Fp2& c) {
+    Fp12 r = f12_zero();
+    r.b0.a0 = c;
+    return r;
+}
+
+struct Consts {
+    Fp12 w2_inv, w3_inv;
+};
+
+inline const Consts& consts() {
+    static Consts c = [] {
+        Consts k;
+        Fp12 w = f12_zero();
+        w.b1.a0 = f2_one();             // the generator w
+        Fp12 w2 = f12_mul(w, w);
+        Fp12 w3 = f12_mul(w2, w);
+        k.w2_inv = f12_inv(w2);
+        k.w3_inv = f12_inv(w3);
+        return k;
+    }();
+    return c;
+}
+
+inline G12 untwist(const G2& p) {
+    if (p.inf) return {f12_zero(), f12_zero(), true};
+    return {f12_mul(f12_from_f2(p.x), consts().w2_inv),
+            f12_mul(f12_from_f2(p.y), consts().w3_inv), false};
+}
+
+inline G12 g1_to_fq12(const G1& p) {
+    if (p.inf) return {f12_zero(), f12_zero(), true};
+    Fp12 x = f12_zero(), y = f12_zero();
+    x.b0.a0 = {p.x, fp_zero()};
+    y.b0.a0 = {p.y, fp_zero()};
+    return {x, y, false};
+}
+
+inline Fp12 line(const G12& p1, const G12& p2, const G12& t) {
+    Fp12 m;
+    if (!f12_eq(p1.x, p2.x)) {
+        m = f12_mul(f12_sub(p2.y, p1.y),
+                    f12_inv(f12_sub(p2.x, p1.x)));
+    } else if (f12_eq(p1.y, p2.y)) {
+        Fp12 three = f12_zero();
+        three.b0.a0 = {fp_from_u64(3), fp_zero()};
+        m = f12_mul(f12_mul(f12_sqr(p1.x), three),
+                    f12_inv(f12_add(p1.y, p1.y)));
+    } else {
+        return f12_sub(t.x, p1.x);
+    }
+    return f12_sub(f12_mul(m, f12_sub(t.x, p1.x)),
+                   f12_sub(t.y, p1.y));
+}
+
+// |x| = 0xD201000000010000; loop over bits below the leading one
+static const uint64_t ATE_LOOP = 0xD201000000010000ULL;
+
+// fused line-evaluation + point-step: the tangent/chord slope is
+// computed once and reused for both the line value and the next R —
+// identical math to line()+G12_double/G12_add with half the (very
+// expensive) Fq12 inversions
+inline Fp12 line_dbl_step(G12* r, const G12& p) {
+    Fp12 three = f12_zero();
+    three.b0.a0 = {fp_from_u64(3), fp_zero()};
+    Fp12 m = f12_mul(f12_mul(f12_sqr(r->x), three),
+                     f12_inv(f12_add(r->y, r->y)));
+    Fp12 l = f12_sub(f12_mul(m, f12_sub(p.x, r->x)),
+                     f12_sub(p.y, r->y));
+    Fp12 nx = f12_sub(f12_sqr(m), f12_add(r->x, r->x));
+    Fp12 ny = f12_sub(f12_mul(m, f12_sub(r->x, nx)), r->y);
+    r->x = nx;
+    r->y = ny;
+    return l;
+}
+
+inline Fp12 line_add_step(G12* r, const G12& q, const G12& p) {
+    if (f12_eq(r->x, q.x)) {
+        // same x: tangent (equal) or vertical (opposite) — fall back
+        // to the unfused forms for these never-hit-in-practice cases
+        Fp12 l = line(*r, q, p);
+        *r = G12_add(*r, q);
+        return l;
+    }
+    Fp12 m = f12_mul(f12_sub(q.y, r->y),
+                     f12_inv(f12_sub(q.x, r->x)));
+    Fp12 l = f12_sub(f12_mul(m, f12_sub(p.x, r->x)),
+                     f12_sub(p.y, r->y));
+    Fp12 nx = f12_sub(f12_sub(f12_sqr(m), r->x), q.x);
+    Fp12 ny = f12_sub(f12_mul(m, f12_sub(r->x, nx)), r->y);
+    r->x = nx;
+    r->y = ny;
+    return l;
+}
+
+inline Fp12 miller_loop(const G12& q, const G12& p) {
+    if (q.inf || p.inf) return f12_one();
+    G12 r = q;
+    Fp12 f = f12_one();
+    int top = 63;
+    while (!((ATE_LOOP >> top) & 1)) top--;
+    for (int i = top - 1; i >= 0; i--) {
+        f = f12_mul(f12_sqr(f), line_dbl_step(&r, p));
+        if ((ATE_LOOP >> i) & 1)
+            f = f12_mul(f, line_add_step(&r, q, p));
+    }
+    return f12_conj(f);        // x < 0 adjustment
+}
+
+// (p^6 + 1) / r, big-endian (the python module's folded exponent)
+static const uint8_t FINAL_E_BE[254] = {
+    0x28,0xb3,0x14,0x87,0x75,0x03,0x7b,0x6f,0x23,0x5c,0x55,0xca,
+    0x75,0x66,0xdb,0xf8,0x5a,0xe6,0x64,0xcf,0x5b,0xb3,0x65,0x79,
+    0xae,0xa8,0x3c,0x48,0xc1,0xda,0xe0,0xec,0x90,0x31,0x17,0x9b,
+    0xde,0xcc,0xad,0x73,0x75,0xa3,0x76,0x3b,0xdf,0x7c,0xcf,0x56,
+    0xfb,0x15,0x73,0xbe,0xaa,0x8c,0x54,0x8c,0xe0,0x80,0x9b,0xc5,
+    0xf6,0x1a,0xfb,0x46,0xe1,0x97,0xbd,0x2f,0xa4,0x89,0x9f,0x0c,
+    0x50,0x12,0x6c,0x80,0x2e,0xec,0x85,0xa2,0xe7,0x07,0xf0,0x84,
+    0x18,0x55,0x47,0x44,0x49,0x7f,0x8b,0x2f,0x29,0x22,0x96,0x78,
+    0x78,0xfe,0xbc,0xb9,0x5d,0x1f,0x13,0x04,0x27,0x5e,0xf4,0x99,
+    0xdf,0xfb,0x12,0xd6,0xa8,0x74,0xd2,0x1b,0x73,0xda,0x2b,0x82,
+    0x2f,0x51,0x4a,0x9c,0x4f,0x6f,0xee,0x6a,0x95,0xdb,0x11,0xe6,
+    0x3f,0x56,0x5e,0x88,0x6c,0x94,0xc4,0xf8,0x23,0x84,0xc3,0xb5,
+    0xe2,0xf5,0x57,0xc0,0xb1,0x5f,0x27,0xd7,0xbd,0x90,0x93,0x50,
+    0x21,0xc3,0xf0,0x07,0xc0,0x1e,0x7e,0xbe,0x3a,0xfc,0x81,0x61,
+    0x01,0xdd,0xd0,0x76,0x11,0x7d,0x1d,0x61,0x5d,0x49,0xe2,0x76,
+    0x4d,0x7b,0xc3,0xb5,0xef,0x4b,0x18,0x8a,0x20,0xb0,0x38,0xee,
+    0x1c,0xd4,0x77,0x8e,0x0d,0xe7,0x33,0x82,0x59,0xc2,0x2a,0x12,
+    0xbd,0x40,0x22,0x47,0x41,0xb3,0x6f,0xec,0x77,0x60,0x2d,0x72,
+    0x71,0x56,0x38,0x90,0xf1,0x33,0x3a,0x09,0xc4,0x49,0x79,0x03,
+    0xf7,0x6e,0x9c,0xf0,0xf7,0x0a,0x61,0xc7,0x91,0xe2,0x09,0xa5,
+    0x25,0x6d,0xe0,0x38,0x1a,0x16,0x87,0x39,0xe1,0xcd,0xc0,0x70,
+    0x5d,0x6a};
+
+inline Fp12 final_exponentiation(const Fp12& f) {
+    // easy part f^(p^6-1) = conj(f) * f^-1, then the folded pow
+    Fp12 g = f12_mul(f12_conj(f), f12_inv(f));
+    return f12_pow_be(g, FINAL_E_BE, sizeof FINAL_E_BE);
+}
+
+struct Pair {
+    G1 p;
+    G2 q;
+};
+
+inline bool pairings_product_is_one(const std::vector<Pair>& pairs) {
+    Fp12 f = f12_one();
+    for (const Pair& pr : pairs) {
+        if (pr.p.inf || pr.q.inf) continue;
+        f = f12_mul(f, miller_loop(untwist(pr.q), g1_to_fq12(pr.p)));
+    }
+    return f12_eq(final_exponentiation(f), f12_one());
+}
+
+// --- hash to G2 (mirrors the python module's custom map) --------------------
+
+inline void sha256_digest(const uint8_t* d, size_t n, uint8_t out[32]) {
+    sha256::hash(d, n, out);
+}
+
+inline void expand_message_xmd(const uint8_t* msg, size_t msg_len,
+                               const uint8_t* dst, size_t dst_len,
+                               size_t out_len, uint8_t* out) {
+    // RFC 9380 §5.3.1 with SHA-256 (lengths validated by the caller)
+    size_t ell = (out_len + 31) / 32;
+    std::vector<uint8_t> buf;
+    buf.assign(64, 0);                         // z_pad
+    buf.insert(buf.end(), msg, msg + msg_len);
+    buf.push_back(uint8_t(out_len >> 8));
+    buf.push_back(uint8_t(out_len));
+    buf.push_back(0);
+    buf.insert(buf.end(), dst, dst + dst_len);
+    buf.push_back(uint8_t(dst_len));
+    uint8_t b0[32];
+    sha256_digest(buf.data(), buf.size(), b0);
+
+    std::vector<uint8_t> round;
+    round.assign(b0, b0 + 32);
+    round.push_back(1);
+    round.insert(round.end(), dst, dst + dst_len);
+    round.push_back(uint8_t(dst_len));
+    uint8_t prev[32];
+    sha256_digest(round.data(), round.size(), prev);
+    size_t written = 0;
+    for (size_t i = 1; i <= ell && written < out_len; i++) {
+        size_t take = out_len - written < 32 ? out_len - written : 32;
+        std::memcpy(out + written, prev, take);
+        written += take;
+        if (i == ell) break;
+        round.clear();
+        for (int j = 0; j < 32; j++)
+            round.push_back(b0[j] ^ prev[j]);
+        round.push_back(uint8_t(i + 1));
+        round.insert(round.end(), dst, dst + dst_len);
+        round.push_back(uint8_t(dst_len));
+        sha256_digest(round.data(), round.size(), prev);
+    }
+}
+
+// 64-byte big-endian -> Fp (mod p), for hash_to_field
+inline Fp fp_from_be64_mod(const uint8_t* b) {
+    // incremental: r = r*256 + byte (in standard form via Montgomery)
+    Fp r = fp_zero();
+    Fp c256 = fp_from_u64(256);
+    for (int i = 0; i < 64; i++) {
+        r = fp_add(fp_mul(r, c256), fp_from_u64(b[i]));
+    }
+    return r;
+}
+
+inline int sgn0_fq2(const Fp2& a) {
+    bool s0 = fp_is_odd(a.c0);
+    bool z0 = fp_is_zero(a.c0);
+    return s0 || (z0 && fp_is_odd(a.c1));
+}
+
+static const uint8_t H2_BE[64] = {
+    0x05,0xd5,0x43,0xa9,0x54,0x14,0xe7,0xf1,0x09,0x1d,0x50,0x79,
+    0x28,0x76,0xa2,0x02,0xcd,0x91,0xde,0x45,0x47,0x08,0x5a,0xba,
+    0xa6,0x8a,0x20,0x5b,0x2e,0x5a,0x7d,0xdf,0xa6,0x28,0xf1,0xcb,
+    0x4d,0x9e,0x82,0xef,0x21,0x53,0x7e,0x29,0x3a,0x66,0x91,0xae,
+    0x16,0x16,0xec,0x6e,0x78,0x6f,0x0c,0x70,0xcf,0x1c,0x38,0xe3,
+    0x1c,0x72,0x38,0xe5};
+
+inline G2 map_to_curve_g2(const Fp2& u) {
+    // deterministic try-and-increment: x = (u.c0 + ctr, u.c1)
+    Fp2 x = u;
+    Fp one = fp_one();
+    for (int ctr = 0; ctr < 256; ctr++) {
+        Fp2 rhs = f2_add(f2_mul(f2_sqr(x), x), g2_b());
+        Fp2 y;
+        if (f2_sqrt(rhs, &y)) {
+            if (sgn0_fq2(y) != sgn0_fq2(u)) y = f2_neg(y);
+            return {x, y, false};
+        }
+        x.c0 = fp_add(x.c0, one);
+    }
+    return {f2_zero(), f2_zero(), true};      // unreachable in practice
+}
+
+inline G2 hash_to_g2(const uint8_t* msg, size_t msg_len,
+                     const uint8_t* dst, size_t dst_len) {
+    uint8_t data[256];
+    expand_message_xmd(msg, msg_len, dst, dst_len, 256, data);
+    Fp2 u0 = {fp_from_be64_mod(data), fp_from_be64_mod(data + 64)};
+    Fp2 u1 = {fp_from_be64_mod(data + 128),
+              fp_from_be64_mod(data + 192)};
+    G2 q = G2_add(map_to_curve_g2(u0), map_to_curve_g2(u1));
+    return G2_mul_be_fast(q, H2_BE, sizeof H2_BE);
+}
+
+}  // namespace bls

@@ -136,3 +136,81 @@ class TestSha512AndKScalars:
                                   "little") % L
             got = int.from_bytes(cat[i * 32:(i + 1) * 32], "little")
             assert got == want, f"trial {i}"
+
+
+class TestNativeBLS:
+    """The C++ BLS12-381 port is differentially tested against the
+    pure-python golden model (cometbft_tpu/crypto/_bls12381_math.py);
+    point wire format: raw affine big-endian coords, b'' = infinity."""
+
+    def _mod(self):
+        native = _native()
+        if not hasattr(native, "bls_pairings_product_is_one"):
+            pytest.skip("older native module")
+        return native
+
+    def test_scalar_mult_and_subgroup_parity(self):
+        import random
+
+        from cometbft_tpu.crypto import _bls12381_math as M
+
+        native = self._mod()
+        rng = random.Random(5)
+        orig = M._native
+        try:
+            for _ in range(4):
+                k = rng.getrandbits(180)
+                kb = k.to_bytes(23, "big")
+                M._native = lambda: None      # python reference
+                want1 = M.pt_mul(M.G1_OPS, M.G1_GEN, k)
+                want2 = M.pt_mul(M.G2_OPS, M.G2_GEN, k)
+                got1 = M._g1_unraw(native.bls_g1_mul(
+                    M._g1_raw(M.G1_GEN), kb))
+                got2 = M._g2_unraw(native.bls_g2_mul(
+                    M._g2_raw(M.G2_GEN), kb))
+                assert got1 == want1 and got2 == want2
+        finally:
+            M._native = orig
+        assert native.bls_g1_in_subgroup(M._g1_raw(M.G1_GEN))
+        assert native.bls_g2_in_subgroup(M._g2_raw(M.G2_GEN))
+        bad = (M.G1_GEN[0], (M.G1_GEN[1] + 1) % M.P)
+        assert not native.bls_g1_in_subgroup(M._g1_raw(bad))
+
+    def test_hash_to_g2_parity(self):
+        from cometbft_tpu.crypto import _bls12381_math as M
+
+        native = self._mod()
+        orig = M._native
+        try:
+            for msg in (b"", b"abc", b"x" * 130):
+                M._native = lambda: None
+                want = M.hash_to_g2(msg, b"PARITY-DST")
+                got = M._g2_unraw(
+                    native.bls_hash_to_g2(msg, b"PARITY-DST"))
+                assert got == want, msg
+        finally:
+            M._native = orig
+
+    def test_pairing_bilinearity(self):
+        import random
+
+        from cometbft_tpu.crypto import _bls12381_math as M
+
+        native = self._mod()
+        P1, Q2 = M.G1_GEN, M.G2_GEN
+        negP = M.pt_neg(M.G1_OPS, P1)
+        pp = native.bls_pairings_product_is_one
+        assert pp([(M._g1_raw(P1), M._g2_raw(Q2)),
+                   (M._g1_raw(negP), M._g2_raw(Q2))])
+        assert not pp([(M._g1_raw(P1), M._g2_raw(Q2))])
+        rng = random.Random(9)
+        x, y = rng.getrandbits(90), rng.getrandbits(90)
+        xP = M.pt_mul(M.G1_OPS, P1, x)
+        yQ = M.pt_mul(M.G2_OPS, Q2, y)
+        xyP = M.pt_mul(M.G1_OPS, P1, x * y)
+        # e(xP, yQ) * e(-xyP, Q) == 1
+        assert pp([(M._g1_raw(xP), M._g2_raw(yQ)),
+                   (M._g1_raw(M.pt_neg(M.G1_OPS, xyP)),
+                    M._g2_raw(Q2))])
+        # infinity pairs are skipped, matching the python model
+        assert pp([(b"", M._g2_raw(Q2)), (M._g1_raw(P1), b"")])
