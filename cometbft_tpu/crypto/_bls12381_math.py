@@ -365,9 +365,13 @@ def pt_mul(ops, pt, k: int):
         native = _native()
         if native is not None and ops in (G1_OPS, G2_OPS):
             kb = k.to_bytes((k.bit_length() + 7) // 8, "big")
-            if ops is G1_OPS:
-                return _g1_unraw(native.bls_g1_mul(_g1_raw(pt), kb))
-            return _g2_unraw(native.bls_g2_mul(_g2_raw(pt), kb))
+            try:
+                if ops is G1_OPS:
+                    return _g1_unraw(
+                        native.bls_g1_mul(_g1_raw(pt), kb))
+                return _g2_unraw(native.bls_g2_mul(_g2_raw(pt), kb))
+            except (ValueError, OverflowError):
+                pass    # out-of-domain coords: python path handles
     out = None
     while k:
         if k & 1:
@@ -398,8 +402,8 @@ def g1_in_subgroup(pt) -> bool:
     if native is not None:
         try:
             return native.bls_g1_in_subgroup(_g1_raw(pt))
-        except ValueError:
-            return False        # coordinate >= p: not a valid point
+        except (ValueError, OverflowError):
+            pass    # non-reduced coords: the python path's domain
     return pt_on_curve(G1_OPS, pt) and pt_mul(G1_OPS, pt, R_ORDER) is None
 
 
@@ -408,8 +412,8 @@ def g2_in_subgroup(pt) -> bool:
     if native is not None:
         try:
             return native.bls_g2_in_subgroup(_g2_raw(pt))
-        except ValueError:
-            return False
+        except (ValueError, OverflowError):
+            pass
     return pt_on_curve(G2_OPS, pt) and pt_mul(G2_OPS, pt, R_ORDER) is None
 
 

@@ -198,12 +198,6 @@ static const uint8_t PP14_BE[48] = {
     0x90,0xd2,0xeb,0x35,0xd9,0x1d,0xd2,0xe1,0x3c,0xe1,0x44,0xaf,
     0xd9,0xcc,0x34,0xa8,0x3d,0xac,0x3d,0x89,0x07,0xaa,0xff,0xff,
     0xac,0x54,0xff,0xff,0xee,0x7f,0xbf,0xff,0xff,0xff,0xea,0xab};
-static const uint8_t PHALF_BE[48] = {
-    0x0d,0x00,0x88,0xf5,0x1c,0xbf,0xf3,0x4d,0x25,0x8d,0xd3,0xdb,
-    0x21,0xa5,0xd6,0x6b,0xb2,0x3b,0xa5,0xc2,0x79,0xc2,0x89,0x5f,
-    0xb3,0x98,0x69,0x50,0x7b,0x58,0x7b,0x12,0x0f,0x55,0xff,0xff,
-    0x58,0xa9,0xff,0xff,0xdc,0xff,0x7f,0xff,0xff,0xff,0xd5,0x55};
-
 inline Fp fp_inv(const Fp& a) { return fp_pow_be(a, PM2_BE, 48); }
 
 // from/to big-endian 48-byte standard form
@@ -239,14 +233,6 @@ inline Fp fp_from_u64(uint64_t x) {
     t.v[0] = x;
     std::memcpy(r2.v, R2_LIMBS, sizeof r2.v);
     return fp_mul(t, r2);
-}
-
-// standard-form (non-Montgomery) compare against (P-1)/2 for the
-// "lexicographically larger y" flag
-inline bool fp_is_larger(const Fp& a) {
-    uint8_t be[48];
-    fp_to_be48(a, be);
-    return std::memcmp(be, PHALF_BE, 48) > 0;
 }
 
 inline bool fp_is_odd(const Fp& a) {
@@ -304,7 +290,6 @@ inline Fp2 f2_inv(const Fp2& a) {
     Fp d = fp_inv(fp_add(fp_sqr(a.c0), fp_sqr(a.c1)));
     return {fp_mul(a.c0, d), fp_neg(fp_mul(a.c1, d))};
 }
-inline Fp2 f2_conj(const Fp2& a) { return {a.c0, fp_neg(a.c1)}; }
 inline Fp2 f2_mul_xi(const Fp2& a) {
     // * (1 + u)
     return {fp_sub(a.c0, a.c1), fp_add(a.c0, a.c1)};
@@ -327,7 +312,7 @@ inline bool f2_sqrt(const Fp2& a, Fp2* out) {
     Fp alpha;
     if (!fp_sqrt(fp_add(fp_sqr(a.c0), fp_sqr(a.c1)), &alpha))
         return false;
-    Fp inv2 = fp_inv(fp_from_u64(2));
+    static const Fp inv2 = fp_inv(fp_from_u64(2));
     Fp delta = fp_mul(fp_add(a.c0, alpha), inv2);
     Fp x0;
     if (!fp_sqrt(delta, &x0)) {
