@@ -235,18 +235,28 @@ class KVStoreApplication(abci.Application):
         self._val_updates = []
         self._staged_txs = []
 
-        # punish equivocators by one power unit (reference: kvstore.go:318)
+        # punish equivocators by one power unit per offence
+        # (reference: kvstore.go:318), ONE update per address — a
+        # block can carry several evidences against one validator, and
+        # duplicate entries in validator_updates are a consensus-
+        # failure per the ABCI contract
+        punish: dict[bytes, int] = {}
         for ev in req.misbehavior:
             if ev.type == abci.MISBEHAVIOR_TYPE_DUPLICATE_VOTE:
-                entry = self._val_addr_to_pubkey.get(ev.validator.address)
-                if entry is not None:
-                    key_type, pub = entry
-                    self._val_updates.append(abci.ValidatorUpdate(
-                        power=ev.validator.power - 1,
-                        pub_key_type=key_type, pub_key_bytes=pub))
-                    self.logger.info(
-                        "Decreased val power by 1 for equivocation",
-                        val=ev.validator.address.hex())
+                addr = ev.validator.address
+                punish[addr] = min(
+                    punish.get(addr, ev.validator.power) - 1,
+                    ev.validator.power - 1)
+        for addr, new_power in punish.items():
+            entry = self._val_addr_to_pubkey.get(addr)
+            if entry is not None:
+                key_type, pub = entry
+                self._val_updates.append(abci.ValidatorUpdate(
+                    power=max(new_power, 0),
+                    pub_key_type=key_type, pub_key_bytes=pub))
+                self.logger.info(
+                    "Decreased val power for equivocation",
+                    val=addr.hex(), new_power=max(new_power, 0))
 
         tx_results = []
         for tx in req.txs:
@@ -277,9 +287,17 @@ class KVStoreApplication(abci.Application):
             self._size += 1
 
         self._height = req.height
+        # one update per pubkey across ALL sources (punishments and
+        # validator txs may both touch the same validator in one
+        # block; duplicate entries are a consensus failure) — the
+        # LAST write wins, so an explicit val-tx overrides the
+        # evidence punishment, matching append order
+        by_key: dict[bytes, abci.ValidatorUpdate] = {}
+        for u in self._val_updates:
+            by_key[u.pub_key_bytes] = u
         resp = abci.FinalizeBlockResponse(
             tx_results=tx_results,
-            validator_updates=list(self._val_updates),
+            validator_updates=list(by_key.values()),
             app_hash=self._app_hash(),
             next_block_delay_ns=self.next_block_delay_ns,
         )

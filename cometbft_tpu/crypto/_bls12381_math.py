@@ -35,12 +35,23 @@ import hashlib
 # entry points below delegate when the module is built.  Point wire
 # format: raw affine big-endian coordinates, b"" = infinity.
 
+_NATIVE_CHECKED: dict = {}
+
+
 def _native():
     from ._native_loader import load
     mod = load(allow_build=False)
-    if mod is not None and hasattr(mod, "bls_pairings_product_is_one"):
-        return mod
-    return None
+    if mod is None or not hasattr(mod, "bls_pairings_product_is_one"):
+        return None
+    # run the module's algebra self-check once per build before any
+    # verdict is produced; a bad build (miscompilation, platform
+    # quirk) falls back to the python golden model instead of
+    # silently returning wrong pairing verdicts
+    ok = _NATIVE_CHECKED.get(id(mod))
+    if ok is None:
+        ok = bool(getattr(mod, "bls_selftest", lambda: False)())
+        _NATIVE_CHECKED[id(mod)] = ok
+    return mod if ok else None
 
 
 def _g1_raw(pt) -> bytes:

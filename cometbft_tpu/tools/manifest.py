@@ -363,29 +363,42 @@ async def inject_evidence(manifest: Manifest, cfgs: dict,
     from ..types.vote import Vote
     from ..wire import encode as wencode, pb as wpb
 
-    # the byzantine validator: first validator in the manifest
-    val_name = next(name for name, nm in manifest.nodes.items()
-                    if nm.mode == "validator")
-    cfg = cfgs[val_name]
-    pv = FilePV.load_or_generate(
-        cfg.base.path(cfg.base.priv_validator_key_file),
-        cfg.base.path(cfg.base.priv_validator_state_file))
-    addr = pv.get_pub_key().address()
+    # byzantine validators: rotate across the manifest's validators
+    # (reference: evidence.go targets different validators per
+    # evidence, and a block carrying several offences by ONE
+    # validator exercises a different app path than several offenders)
+    val_names = [name for name, nm in manifest.nodes.items()
+                 if nm.mode == "validator"]
+    pvs = {}
+    for name in val_names:
+        cfg = cfgs[name]
+        pvs[name] = FilePV.load_or_generate(
+            cfg.base.path(cfg.base.priv_validator_key_file),
+            cfg.base.path(cfg.base.priv_validator_state_file))
 
     cli = HTTPClient(endpoint, timeout=30.0)
     st = await cli.status()
     tip = int(st["sync_info"]["latest_block_height"])
-    total_power = sum(
-        manifest.validators.get(name, 100)
-        for name, nm in manifest.nodes.items()
-        if nm.mode == "validator")
-    val_power = manifest.validators.get(val_name, 100)
+    total_power = sum(manifest.validators.get(name, 100)
+                      for name in val_names)
     vals = await cli.validators(max(1, tip - 2))
-    val_index = next(i for i, v in enumerate(vals.validators)
-                     if v.address == addr)
+    index_by_addr = {v.address: i
+                     for i, v in enumerate(vals.validators)}
+    per_val = {}
+    for name in val_names:
+        addr = pvs[name].get_pub_key().address()
+        if addr not in index_by_addr:
+            raise ValueError(
+                f"validator {name} (addr {addr.hex()[:12]}) not in "
+                f"the set at height {max(1, tip - 2)}")
+        per_val[name] = (addr, index_by_addr[addr],
+                         manifest.validators.get(name, 100))
 
     hashes = []
     for j in range(count):
+        val_name = val_names[j % len(val_names)]
+        pv = pvs[val_name]
+        addr, val_index, val_power = per_val[val_name]
         # heights may clamp together on a young chain, so the forged
         # block ids vary per evidence — identical evidence would be
         # deduped by the pool and never reach the requested count

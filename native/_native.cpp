@@ -423,6 +423,14 @@ PyObject* bls_pairings_product_is_one(PyObject*, PyObject* arg) {
     return PyBool_FromLong(ok);
 }
 
+PyObject* bls_selftest(PyObject*, PyObject*) {
+    bool ok;
+    Py_BEGIN_ALLOW_THREADS
+    ok = bls::selftest();
+    Py_END_ALLOW_THREADS
+    return PyBool_FromLong(ok);
+}
+
 PyObject* bls_g1_in_subgroup(PyObject*, PyObject* arg) {
     bls::G1 p;
     if (!parse_g1(arg, &p)) return nullptr;
@@ -524,6 +532,8 @@ PyMethodDef kMethods[] = {
      "(a_b, r_b, s_win, k_win, pre_bad)"},
     {"bls_pairings_product_is_one", bls_pairings_product_is_one,
      METH_O, "prod e(P_i, Q_i) == 1 over raw affine pairs"},
+    {"bls_selftest", bls_selftest, METH_NOARGS,
+     "Frobenius + fast-final-exponentiation consistency check"},
     {"bls_g1_in_subgroup", bls_g1_in_subgroup, METH_O,
      "curve + r-order check for a raw affine G1 point"},
     {"bls_g2_in_subgroup", bls_g2_in_subgroup, METH_O,

@@ -734,10 +734,128 @@ static const uint8_t FINAL_E_BE[254] = {
     0x25,0x6d,0xe0,0x38,0x1a,0x16,0x87,0x39,0xe1,0xcd,0xc0,0x70,
     0x5d,0x6a};
 
-inline Fp12 final_exponentiation(const Fp12& f) {
+inline Fp12 final_exponentiation_naive(const Fp12& f) {
     // easy part f^(p^6-1) = conj(f) * f^-1, then the folded pow
     Fp12 g = f12_mul(f12_conj(f), f12_inv(f));
     return f12_pow_be(g, FINAL_E_BE, sizeof FINAL_E_BE);
+}
+
+// --- Frobenius + fast final exponentiation ---------------------------------
+
+// generic Fq2 pow over a big-endian exponent
+inline Fp2 f2_pow_be(const Fp2& a, const uint8_t* e, size_t elen) {
+    Fp2 out = f2_one();
+    bool started = false;
+    for (size_t i = 0; i < elen; i++) {
+        for (int b = 7; b >= 0; b--) {
+            if (started) out = f2_sqr(out);
+            if ((e[i] >> b) & 1) {
+                if (started) out = f2_mul(out, a);
+                else { out = a; started = true; }
+            }
+        }
+    }
+    return started ? out : f2_one();
+}
+
+// (p - 1) / 6, big-endian — the Frobenius gamma exponent
+static const uint8_t PM16_BE[48] = {
+    0x04,0x55,0x82,0xfc,0x5e,0xea,0xa6,0x6f,0x0c,0x84,0x9b,0xf3,
+    0xb5,0xe1,0xf2,0x23,0xe6,0x13,0xe1,0xeb,0x7d,0xeb,0x83,0x1f,
+    0xe6,0x88,0x23,0x1a,0xd3,0xc8,0x29,0x06,0x05,0x1c,0xaa,0xaa,
+    0x72,0xe3,0x55,0x55,0x49,0xaa,0x7f,0xff,0xff,0xff,0xf1,0xc7};
+
+struct FrobConsts {
+    Fp2 gamma[6];      // gamma[i] = xi^(i*(p-1)/6); gamma[0] = 1
+};
+
+inline const FrobConsts& frob_consts() {
+    static FrobConsts k = [] {
+        FrobConsts c;
+        Fp2 xi = {fp_one(), fp_one()};            // 1 + u
+        c.gamma[0] = f2_one();
+        c.gamma[1] = f2_pow_be(xi, PM16_BE, 48);
+        for (int i = 2; i < 6; i++)
+            c.gamma[i] = f2_mul(c.gamma[i - 1], c.gamma[1]);
+        return c;
+    }();
+    return k;
+}
+
+// f^p: conjugate each Fq2 coefficient, multiply the w^i coefficient
+// by gamma[i].  Coefficient i of w^i:  [b0.a0, b1.a0, b0.a1, b1.a1,
+// b0.a2, b1.a2]  (w^2 = v).
+inline Fp12 f12_frobenius(const Fp12& f) {
+    const FrobConsts& k = frob_consts();
+    auto cm = [&](const Fp2& c, int i) {
+        return f2_mul(Fp2{c.c0, fp_neg(c.c1)}, k.gamma[i]);
+    };
+    Fp12 r;
+    r.b0.a0 = cm(f.b0.a0, 0);
+    r.b1.a0 = cm(f.b1.a0, 1);
+    r.b0.a1 = cm(f.b0.a1, 2);
+    r.b1.a1 = cm(f.b1.a1, 3);
+    r.b0.a2 = cm(f.b0.a2, 4);
+    r.b1.a2 = cm(f.b1.a2, 5);
+    return r;
+}
+
+// m^u with u = |x| = 0xD201000000010000 (64-bit square-and-multiply)
+inline Fp12 f12_pow_u(const Fp12& m) {
+    Fp12 out = m;                     // leading bit
+    for (int i = 62; i >= 0; i--) {
+        out = f12_sqr(out);
+        if ((ATE_LOOP >> i) & 1) out = f12_mul(out, m);
+    }
+    return out;
+}
+
+inline Fp12 final_exponentiation(const Fp12& f) {
+    // easy part: g = f^((p^6-1)(p^2+1)) — in the cyclotomic subgroup,
+    // where inverse == conjugate
+    Fp12 g = f12_mul(f12_conj(f), f12_inv(f));          // ^(p^6-1)
+    g = f12_mul(f12_frobenius(f12_frobenius(g)), g);    // ^(p^2+1)
+    // hard part cubed (Hayashida-style decomposition; exact identity
+    // verified offline:  3*((p^4-p^2+1)/r) =
+    //   (x-1)^2 (x+p) (x^2+p^2-1) + 3,  x = -u):
+    // the result is naive^3, and since gcd(3, r) = 1 the ==1 verdict
+    // is unchanged (the module's only consumer).
+    Fp12 t1 = f12_conj(f12_mul(f12_pow_u(g), g));       // g^(x-1)
+    Fp12 t2 = f12_conj(f12_mul(f12_pow_u(t1), t1));     // ^(x-1)
+    Fp12 t3 = f12_mul(f12_conj(f12_pow_u(t2)),          // ^(x+p)
+                      f12_frobenius(t2));
+    Fp12 t4 = f12_mul(
+        f12_mul(f12_pow_u(f12_pow_u(t3)),               // ^(x^2)
+                f12_frobenius(f12_frobenius(t3))),      // ^(p^2)
+        f12_conj(t3));                                  // ^(-1)
+    Fp12 g3 = f12_mul(f12_sqr(g), g);
+    return f12_mul(t4, g3);
+}
+
+// startup self-check: Frobenius vs a plain ^p pow, and the fast final
+// exponentiation (naive^3) vs the naive one, on a derived element —
+// any algebra slip fails loudly before a verdict is ever produced
+inline bool selftest() {
+    // a "random" fp12 from small constants
+    Fp12 f = f12_zero();
+    uint64_t seed = 0x9e3779b97f4a7c15ULL;
+    Fp2* coeffs[6] = {&f.b0.a0, &f.b1.a0, &f.b0.a1,
+                      &f.b1.a1, &f.b0.a2, &f.b1.a2};
+    for (int i = 0; i < 6; i++) {
+        seed = seed * 6364136223846793005ULL + 1442695040888963407ULL;
+        coeffs[i]->c0 = fp_from_u64(seed >> 8);
+        seed = seed * 6364136223846793005ULL + 1442695040888963407ULL;
+        coeffs[i]->c1 = fp_from_u64(seed >> 8);
+    }
+    // P big-endian = PM2 + 2
+    uint8_t p_be[48];
+    std::memcpy(p_be, PM2_BE, 48);
+    p_be[47] = uint8_t(p_be[47] + 2);
+    if (!f12_eq(f12_frobenius(f), f12_pow_be(f, p_be, 48)))
+        return false;
+    Fp12 naive = final_exponentiation_naive(f);
+    Fp12 naive3 = f12_mul(f12_sqr(naive), naive);
+    return f12_eq(final_exponentiation(f), naive3);
 }
 
 struct Pair {

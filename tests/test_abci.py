@@ -177,3 +177,41 @@ class TestBaseApplication:
             pp = await app.process_proposal(abci.ProcessProposalRequest())
             assert pp.is_accepted()
         run(go())
+
+
+class TestEquivocationPunishmentDedup:
+    def test_two_offences_one_validator_single_update(self):
+        """Two duplicate-vote evidences against ONE validator in one
+        block must produce a single validator update (duplicate
+        entries in validator_updates are a consensus failure) with
+        the power reduced per offence."""
+        import asyncio
+
+        from cometbft_tpu.abci import types as abci
+        from cometbft_tpu.abci.kvstore import KVStoreApplication
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.types.timestamp import Timestamp
+
+        async def run():
+            app = KVStoreApplication()
+            pub = ed25519.gen_priv_key().pub_key()
+            addr = pub.address()
+            await app.init_chain(abci.InitChainRequest(
+                time=Timestamp.now(), chain_id="dedup",
+                validators=[abci.ValidatorUpdate(
+                    power=10, pub_key_type="ed25519",
+                    pub_key_bytes=pub.bytes())],
+                app_state_bytes=b"", initial_height=1))
+            mb = [abci.Misbehavior(
+                type=abci.MISBEHAVIOR_TYPE_DUPLICATE_VOTE,
+                validator=abci.ABCIValidator(address=addr, power=10),
+                height=1, time=Timestamp.now(),
+                total_voting_power=10) for _ in range(2)]
+            resp = await app.finalize_block(abci.FinalizeBlockRequest(
+                txs=[], misbehavior=mb, height=2,
+                time=Timestamp.now()))
+            updates = [u for u in resp.validator_updates
+                       if u.pub_key_bytes == pub.bytes()]
+            assert len(updates) == 1, "duplicate validator updates"
+            assert updates[0].power == 8     # one unit per offence
+        asyncio.run(run())

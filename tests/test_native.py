@@ -214,3 +214,15 @@ class TestNativeBLS:
                     M._g2_raw(Q2))])
         # infinity pairs are skipped, matching the python model
         assert pp([(b"", M._g2_raw(Q2)), (M._g1_raw(P1), b"")])
+
+
+class TestBLSFinalExp:
+    def test_frobenius_and_fast_final_exp_selftest(self):
+        """The C++ module's built-in algebra check: Frobenius equals a
+        plain ^p pow, and the decomposed final exponentiation equals
+        the naive one cubed (the ==1 verdict is unchanged since
+        gcd(3, r) = 1)."""
+        native = _native()
+        if not hasattr(native, "bls_selftest"):
+            pytest.skip("older native module")
+        assert native.bls_selftest()
