@@ -139,7 +139,7 @@ async def _load(nodes, stop_event):
         await asyncio.sleep(0.02)
 
 
-async def _wait_height(nodes, h, timeout=45.0):
+async def _wait_height(nodes, h, timeout=90.0):
     async def waiter():
         while not all(n.height >= h for n in nodes):
             await asyncio.sleep(0.02)
