@@ -504,8 +504,11 @@ def pairings_product_is_one(pairs) -> bool:
     Fq2). One shared final exponentiation."""
     native = _native()
     if native is not None:
-        return native.bls_pairings_product_is_one(
-            [(_g1_raw(p), _g2_raw(q)) for p, q in pairs])
+        try:
+            return native.bls_pairings_product_is_one(
+                [(_g1_raw(p), _g2_raw(q)) for p, q in pairs])
+        except (ValueError, OverflowError):
+            pass    # out-of-domain coords: python path handles
     f = F12_ONE
     for p1, q2 in pairs:
         if p1 is None or q2 is None:

@@ -19,10 +19,27 @@ _TX_RESULT = b"tx/"
 _TX_EVENT = b"te/"
 _BLOCK_EVENT = b"be/"
 _BLOCK_HEIGHT_REG = b"bh/"      # height -> hex key list (for pruning)
+_TX_HEIGHT_REG = b"th/"         # height+hash -> hex key list
 
 
 def _hex(k: bytes) -> bytes:
     return k.hex().encode()
+
+
+# per-height registries share one wire format: hex-encoded keys
+# joined by NUL (the raw keys themselves contain NUL separators)
+
+def _reg_encode(keys: list[bytes]) -> bytes:
+    return b"\x00".join(_hex(k) for k in keys)
+
+
+def _reg_delete(batch, reg: bytes) -> int:
+    n = 0
+    for hexkey in reg.split(b"\x00"):
+        if hexkey:
+            batch.delete(bytes.fromhex(hexkey.decode()))
+            n += 1
+    return n
 _BLOCK_HEIGHT_KEY = "block.height"
 _TX_HEIGHT_KEY = "tx.height"
 _TX_HASH_KEY = "tx.hash"
@@ -53,13 +70,25 @@ class TxIndexer:
         batch = self._db.new_batch()
         batch.set(_TX_RESULT + h, raw)
         # implicit tx.height/tx.hash attributes + app events
+        keys = []
         for composite, value in _iter_event_attrs(
                 tx_result.result.events):
-            batch.set(_event_key(_TX_EVENT, composite, value,
-                                 tx_result.height, h), h)
+            keys.append(_event_key(_TX_EVENT, composite, value,
+                                   tx_result.height, h))
+        for k in keys:
+            batch.set(k, h)
         batch.set(_event_key(_TX_EVENT, _TX_HEIGHT_KEY,
                              str(tx_result.height), tx_result.height,
                              h), h)
+        # per-(height,hash) registry of app-event keys so pruning can
+        # delete them even when the same tx hash is re-committed at a
+        # later height (the stored record then carries the later
+        # height, and these keys could not be recomputed from it);
+        # event-less txs need no entry — prune's recompute path
+        # correctly deletes nothing for them
+        if keys:
+            batch.set(_TX_HEIGHT_REG + struct.pack(
+                ">q", tx_result.height) + h, _reg_encode(keys))
         batch.write()
 
     def prune(self, from_height: int, to_height: int) -> int:
@@ -77,6 +106,15 @@ class TxIndexer:
             hk = _event_key(_TX_EVENT, _TX_HEIGHT_KEY, str(h), h, b"")
             for k, tx_hash_ in list(self._db.iterator(
                     hk, hk + b"\xff" * 40)):
+                # this height's app-event keys come from the registry
+                # — the stored record may carry a LATER height (same
+                # tx hash re-committed), so they can't be recomputed
+                reg_key = (_TX_HEIGHT_REG + struct.pack(">q", h) +
+                           tx_hash_)
+                reg = self._db.get(reg_key)
+                if reg is not None:
+                    _reg_delete(batch, reg)
+                    batch.delete(reg_key)
                 raw = self._db.get(_TX_RESULT + tx_hash_)
                 # only delete the stored record if it belongs to THIS
                 # height — the same tx hash re-committed later
@@ -85,13 +123,17 @@ class TxIndexer:
                 if raw is not None:
                     d = decode(abci_pb.TX_RESULT, raw)
                     if d.get("height", 0) == h:
-                        res = _exec_result_from_proto(
-                            d.get("result") or {})
-                        for composite, value in _iter_event_attrs(
-                                res.events):
-                            batch.delete(_event_key(
-                                _TX_EVENT, composite, value, h,
-                                tx_hash_))
+                        if reg is None:
+                            # pre-registry record: recompute from the
+                            # stored result (correct for this case —
+                            # record height matches)
+                            res = _exec_result_from_proto(
+                                d.get("result") or {})
+                            for composite, value in _iter_event_attrs(
+                                    res.events):
+                                batch.delete(_event_key(
+                                    _TX_EVENT, composite, value, h,
+                                    tx_hash_))
                         batch.delete(_TX_RESULT + tx_hash_)
                         pruned += 1
                 batch.delete(k)
@@ -148,8 +190,7 @@ class BlockIndexer:
         # per-height registry of emitted keys so pruning touches only
         # the pruned heights (keys can't be recomputed from height
         # alone — the events aren't stored here)
-        batch.set(_BLOCK_HEIGHT_REG + tie,
-                  b"\x00".join(_hex(k) for k in keys))
+        batch.set(_BLOCK_HEIGHT_REG + tie, _reg_encode(keys))
         batch.write()
 
     def prune(self, from_height: int, to_height: int) -> int:
@@ -171,10 +212,7 @@ class BlockIndexer:
                 # leaking its entries past the watermark
                 need_scan = True
                 continue
-            for hexkey in reg.split(b"\x00"):
-                if hexkey:
-                    batch.delete(bytes.fromhex(hexkey.decode()))
-                    pruned += 1
+            pruned += _reg_delete(batch, reg)
             batch.delete(reg_key)
         if need_scan:
             for k, _ in list(self._db.iterator(

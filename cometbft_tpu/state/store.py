@@ -199,10 +199,14 @@ class Store:
         if from_height <= 0 or to_height <= from_height:
             return 0
         batch = self._db.new_batch()
-        for h in range(from_height, to_height):
-            batch.delete(_abci_responses_key(h))
+        pruned = 0
+        for k, _ in list(self._db.iterator(
+                _abci_responses_key(from_height),
+                _abci_responses_key(to_height))):
+            batch.delete(k)
+            pruned += 1
         batch.write()
-        return to_height - from_height
+        return pruned
 
     # ------------------------------------------------------------------
     def prune_states(self, from_height: int, to_height: int,

@@ -198,7 +198,7 @@ def setup(manifest: Manifest, outdir: str
         cfg.rpc.laddr = f"tcp://127.0.0.1:{rpc_port}"
         cfg.p2p.pex = not manifest.disable_pex and not use_latency
         cfg.p2p.allow_duplicate_ip = True
-        cfg.consensus.timeout_commit = 0.05
+        cfg.consensus.timeout_commit_ns = 50_000_000
         cfg.blocksync.enable = True
         os.makedirs(os.path.join(home, "config"), exist_ok=True)
         os.makedirs(os.path.join(home, "data"), exist_ok=True)

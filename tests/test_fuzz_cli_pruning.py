@@ -273,3 +273,60 @@ class TestSearchNarrowing:
                                         index=True)])])))
         assert len(idx.search(Query("x.n = '7'"))) == 1
         assert len(idx.search(Query("x.n = '7.0'"))) == 1
+
+
+class TestTxIndexPruneNoLeak:
+    def _tx(self, height, tx, value):
+        from cometbft_tpu.abci import types as abci
+        return abci.TxResult(
+            height=height, index=0, tx=tx,
+            result=abci.ExecTxResult(code=0, events=[
+                abci.Event(type="transfer", attributes=[
+                    abci.EventAttribute(key="amount", value=value,
+                                        index=True)])]))
+
+    def test_recommitted_hash_leaves_no_event_keys(self):
+        """Pruning a height whose tx hash was re-committed later must
+        still delete that height's app-event keys (the retained record
+        carries the later height, so they can't be recomputed from it)
+        — reference: state/txindex/kv Prune semantics."""
+        from cometbft_tpu.db.db import MemDB
+        from cometbft_tpu.indexer import TxIndexer
+        from cometbft_tpu.libs.pubsub import Query
+
+        import struct
+        from cometbft_tpu.types.tx import tx_hash
+
+        db = MemDB()
+        idx = TxIndexer(db)
+        # same tx bytes -> same hash, committed at h=1 then again h=5
+        idx.index(self._tx(1, b"dup", "111"))
+        idx.index(self._tx(5, b"dup", "555"))
+        assert idx.prune(1, 2) == 0   # record retained (height 5)
+        # the later commit is intact
+        assert idx.get(tx_hash(b"dup")) is not None
+        assert len(idx.search(Query("transfer.amount = 555"))) == 1
+        # h=1's event keys are gone — no orphans left in the te/ space
+        assert idx.search(Query("transfer.amount = 111")) == []
+        leftovers = [k for k, _ in db.iterator(b"te/", b"te/\xff")
+                     if b"111" in k]
+        assert leftovers == []
+        # and the registry entry for h=1 is deleted too
+        assert db.get(b"th/" + struct.pack(">q", 1) +
+                      tx_hash(b"dup")) is None
+
+    def test_plain_prune_counts_and_cleans(self):
+        from cometbft_tpu.db.db import MemDB
+        from cometbft_tpu.indexer import TxIndexer
+        from cometbft_tpu.libs.pubsub import Query
+
+        db = MemDB()
+        idx = TxIndexer(db)
+        for h in (1, 2, 3):
+            idx.index(self._tx(h, b"tx%d" % h, str(h * 100)))
+        assert idx.prune(1, 3) == 2
+        assert idx.search(Query("transfer.amount = 100")) == []
+        assert len(idx.search(Query("transfer.amount = 300"))) == 1
+        # no registry or event keys below the watermark remain
+        assert [k for k, _ in db.iterator(b"th/", b"th/\xff")
+                if k[3:11] < b"\x00" * 7 + b"\x03"] == []
