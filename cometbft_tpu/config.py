@@ -49,6 +49,9 @@ class RPCConfig:
     timeout_broadcast_tx_commit_ns: int = 10 * _S
     max_body_bytes: int = 1_000_000
     max_header_bytes: int = 1 << 20
+    unsafe: bool = False      # enables dial_seeds/dial_peers/
+                              # unsafe_flush_mempool (reference:
+                              # config.go RPCConfig.Unsafe)
 
 
 @dataclass

@@ -26,6 +26,11 @@ def _hex(k: bytes) -> bytes:
     return k.hex().encode()
 
 
+_BLOCK_HEIGHT_KEY = "block.height"
+_TX_HEIGHT_KEY = "tx.height"
+_TX_HASH_KEY = "tx.hash"
+
+
 # per-height registries share one wire format: hex-encoded keys
 # joined by NUL (the raw keys themselves contain NUL separators)
 
@@ -40,9 +45,6 @@ def _reg_delete(batch, reg: bytes) -> int:
             batch.delete(bytes.fromhex(hexkey.decode()))
             n += 1
     return n
-_BLOCK_HEIGHT_KEY = "block.height"
-_TX_HEIGHT_KEY = "tx.height"
-_TX_HASH_KEY = "tx.hash"
 
 
 def _event_key(prefix: bytes, composite: str, value: str,

@@ -173,6 +173,9 @@ class Node:
             moniker=config.base.moniker,
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate)
+        self.switch.private_ids = {
+            s.strip() for s in
+            config.p2p.private_peer_ids.split(",") if s.strip()}
 
         self._rpc_server = None
         self._started = False

@@ -253,8 +253,10 @@ class PexReactor(Reactor):
                       msg_bytes: bytes) -> None:
         d = decode(PEX_MESSAGE, msg_bytes)
         if "pex_request" in d:
+            private = (self.switch.private_ids
+                       if self.switch is not None else set())
             addrs = self.book.pick_addresses(
-                _MAX_ADDRS_PER_MSG, exclude={peer.id})
+                _MAX_ADDRS_PER_MSG, exclude={peer.id} | private)
             peer.send(PEX_CHANNEL, encode(PEX_MESSAGE, {"pex_addrs": {
                 "addrs": [{"id": a.node_id, "ip": a.ip,
                            "port": a.port} for a in addrs]}}))

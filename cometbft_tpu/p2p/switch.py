@@ -143,6 +143,9 @@ class Switch:
         self._server: Optional[asyncio.base_events.Server] = None
         self._persistent_addrs: list[str] = []
         self._dial_tasks: list[asyncio.Task] = []
+        # peer ids whose addresses must never be gossiped via PEX
+        # (reference: sw.AddPrivatePeerIDs / p2p.private_peer_ids)
+        self.private_ids: set[str] = set()
 
     # ------------------------------------------------------------------
     def add_reactor(self, reactor: Reactor) -> None:

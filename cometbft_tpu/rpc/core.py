@@ -46,6 +46,8 @@ def routes(env: Environment) -> dict:
         "status": lambda: _status(env),
         "net_info": lambda: _net_info(env),
         "genesis": lambda: _genesis(env),
+        "genesis_chunked": lambda chunk="0":
+            _genesis_chunked(env, chunk),
         "abci_info": lambda: _abci_info(env),
         "abci_query": lambda path="", data="", height="0",
         prove=False: _abci_query(env, path, data, height, prove),
@@ -60,6 +62,12 @@ def routes(env: Environment) -> dict:
         "num_unconfirmed_txs": lambda: _num_unconfirmed_txs(env),
         "block": lambda height="0": _block(env, height),
         "block_by_hash": lambda hash="": _block_by_hash(env, hash),
+        "header": lambda height="0": _header(env, height),
+        "header_by_hash": lambda hash="":
+            _header_by_hash(env, hash),
+        "check_tx": lambda tx="": _check_tx(env, tx),
+        "unconfirmed_tx": lambda hash="":
+            _unconfirmed_tx(env, hash),
         "block_results": lambda height="0": _block_results(env, height),
         "commit": lambda height="0": _commit(env, height),
         "blockchain": lambda minHeight="0", maxHeight="0":
@@ -67,6 +75,8 @@ def routes(env: Environment) -> dict:
         "validators": lambda height="0", page="1", per_page="30":
             _validators(env, height, page, per_page),
         "consensus_state": lambda: _consensus_state(env),
+        "dump_consensus_state": lambda:
+            _dump_consensus_state(env),
         "consensus_params": lambda height="0":
             _consensus_params(env, height),
         "tx": lambda hash="", prove=False: _tx(env, hash),
@@ -83,6 +93,16 @@ def routes(env: Environment) -> dict:
             _pruning_set_retain(env, height),
         "pruning_get_block_retain_height": lambda:
             _pruning_get_retain(env),
+        # control API — served only with rpc.unsafe (reference:
+        # routes.go AddUnsafeRoutes); every handler re-checks the
+        # config so the gate can't be bypassed by table drift
+        "dial_seeds": lambda seeds="":
+            _unsafe_dial_seeds(env, seeds),
+        "dial_peers": lambda peers="", persistent=False,
+        unconditional=False, private=False:
+            _unsafe_dial_peers(env, peers, persistent, private),
+        "unsafe_flush_mempool": lambda:
+            _unsafe_flush_mempool(env),
     }
 
 
@@ -114,6 +134,30 @@ async def _net_info(env):
 async def _genesis(env):
     import json as _json
     return {"genesis": _json.loads(env.node.genesis_doc.to_json())}
+
+
+_GENESIS_CHUNK_SIZE = 16 * 1024 * 1024   # reference: 16 MB chunks
+
+
+async def _genesis_chunked(env, chunk):
+    """Reference: rpc/core/net.go GenesisChunked — the genesis JSON
+    split into 16 MB base64 chunks so large genesis docs fit in one
+    JSON-RPC response each.  Chunks are computed once per node (the
+    genesis doc is immutable) and cached on the environment."""
+    from .server import RPCError
+    chunks = getattr(env, "_genesis_chunks", None)
+    if chunks is None:
+        raw = env.node.genesis_doc.to_json().encode()
+        chunks = [raw[i:i + _GENESIS_CHUNK_SIZE]
+                  for i in range(0, len(raw),
+                                 _GENESIS_CHUNK_SIZE)] or [b""]
+        env._genesis_chunks = chunks
+    cid = int(chunk)
+    if cid < 0 or cid >= len(chunks):
+        raise RPCError(
+            -32603, f"chunk id {cid} out of range [0, {len(chunks)})")
+    return {"chunk": str(cid), "total": str(len(chunks)),
+            "data": base64.b64encode(chunks[cid]).decode()}
 
 
 async def _abci_info(env):
@@ -314,6 +358,53 @@ async def _block_by_hash(env, hash):
             "block": _block_json(block)}
 
 
+async def _header(env, height):
+    """Reference: rpc/core/blocks.go Header."""
+    h = _normalize_height(env, height)
+    meta = env.block_store.load_block_meta(h)
+    if meta is None:
+        from .server import RPCError
+        raise RPCError(-32603, f"header at height {h} not found")
+    return {"header": _header_json(meta.header)}
+
+
+async def _header_by_hash(env, hash):
+    """Reference: rpc/core/blocks.go HeaderByHash."""
+    raw = _decode_hex_or_str(hash)
+    meta = env.block_store.load_block_meta_by_hash(raw)
+    if meta is None:
+        from .server import RPCError
+        raise RPCError(-32603, "header not found")
+    return {"header": _header_json(meta.header)}
+
+
+async def _check_tx(env, tx):
+    """Run CheckTx against the app without adding the tx to the
+    mempool (reference: rpc/core/mempool.go CheckTx)."""
+    raw = _decode_tx(tx)
+    res = await env.node.app_conns.mempool.check_tx(
+        abci.CheckTxRequest(tx=raw, type=abci.CHECK_TX_TYPE_CHECK))
+    return {
+        "code": res.code,
+        "data": base64.b64encode(res.data).decode(),
+        "log": res.log, "info": res.info,
+        "gas_wanted": str(res.gas_wanted),
+        "gas_used": str(res.gas_used),
+        "events": _events_json(res.events),
+        "codespace": res.codespace,
+    }
+
+
+async def _unconfirmed_tx(env, hash):
+    """Reference: rpc/core/mempool.go UnconfirmedTx."""
+    raw = _decode_hex_or_str(hash)
+    tx = env.mempool.get_tx_by_hash(raw)
+    if tx is None:
+        from .server import RPCError
+        raise RPCError(-32603, "tx not found in mempool")
+    return {"tx": base64.b64encode(tx).decode()}
+
+
 async def _block_results(env, height):
     h = _normalize_height(env, height)
     resp = env.state_store.load_finalize_block_response(h)
@@ -412,6 +503,125 @@ async def _consensus_state(env):
             rs.valid_block.hash().hex().upper()
             if rs.valid_block else "",
     }}
+
+
+def _vote_set_summary(vs) -> dict:
+    if vs is None:
+        return {}
+    return {"bit_array": str(vs.bit_array()),
+            "voting_power": str(vs.sum)}
+
+
+async def _dump_consensus_state(env):
+    """Full round state + what we believe each peer's round state is
+    (reference: rpc/core/consensus.go DumpConsensusState)."""
+    rs = env.consensus.rs
+    round_state = {
+        "height": str(rs.height), "round": rs.round,
+        "step": rs.step_name(),
+        "start_time": rs.start_time.rfc3339(),
+        "commit_time": rs.commit_time.rfc3339(),
+        "validators": {
+            "validators": [
+                {"address": v.address.hex().upper(),
+                 "voting_power": str(v.voting_power),
+                 "proposer_priority": str(v.proposer_priority)}
+                for v in rs.validators.validators]
+            if rs.validators else [],
+            "proposer": {"address":
+                         rs.validators.get_proposer()
+                         .address.hex().upper()}
+            if rs.validators and rs.validators.validators else {},
+        },
+        "proposal_block_hash":
+            rs.proposal_block.hash().hex().upper()
+            if rs.proposal_block else "",
+        "locked_round": rs.locked_round,
+        "locked_block_hash":
+            rs.locked_block.hash().hex().upper()
+            if rs.locked_block else "",
+        "valid_round": rs.valid_round,
+        "valid_block_hash":
+            rs.valid_block.hash().hex().upper()
+            if rs.valid_block else "",
+        "commit_round": rs.commit_round,
+        "votes": [
+            {"round": r,
+             "prevotes": _vote_set_summary(
+                 rs.votes.prevotes(r)),
+             "precommits": _vote_set_summary(
+                 rs.votes.precommits(r))}
+            for r in (sorted(rs.votes._round_vote_sets)
+                      if rs.votes else [])],
+        "last_commit": _vote_set_summary(rs.last_commit),
+    }
+    peers = []
+    for p in env.node.switch.peers.values():
+        ps = p.data.get("consensus_peer_state")
+        if ps is None:
+            continue
+        prs = ps.prs
+        peers.append({
+            "node_address": p.remote_addr,
+            "peer_state": {"round_state": {
+                "height": str(prs.height), "round": prs.round,
+                "step": prs.step,
+                "proposal": prs.proposal,
+                "proposal_pol_round": prs.proposal_pol_round,
+                "prevotes": str(prs.prevotes or ""),
+                "precommits": str(prs.precommits or ""),
+                "last_commit_round": prs.last_commit_round,
+                "catchup_commit_round": prs.catchup_commit_round,
+            }},
+        })
+    return {"round_state": round_state, "peers": peers}
+
+
+def _require_unsafe(env) -> None:
+    if not env.node.config.rpc.unsafe:
+        from .server import RPCError
+        raise RPCError(
+            -32601, "unsafe RPC commands disabled "
+            "(enable with rpc.unsafe)")
+
+
+async def _unsafe_dial_seeds(env, seeds):
+    """Reference: rpc/core/net.go UnsafeDialSeeds."""
+    _require_unsafe(env)
+    addrs = [s for s in (seeds.split(",")
+                         if isinstance(seeds, str) else seeds) if s]
+    if not addrs:
+        from .server import RPCError
+        raise RPCError(-32602, "no seeds provided")
+    env.node.switch.dial_peers_async(addrs, persistent=False)
+    return {"log": "Dialing seeds in progress. "
+                   "See /net_info for details"}
+
+
+async def _unsafe_dial_peers(env, peers, persistent, private):
+    """Reference: rpc/core/net.go UnsafeDialPeers.  (unconditional
+    is accepted for wire compatibility but has no effect: the switch
+    enforces no inbound peer cap to bypass.)"""
+    _require_unsafe(env)
+    addrs = [s for s in (peers.split(",")
+                         if isinstance(peers, str) else peers) if s]
+    if not addrs:
+        from .server import RPCError
+        raise RPCError(-32602, "no peers provided")
+    if _parse_bool(private):
+        env.node.switch.private_ids.update(
+            a.split("@", 1)[0] for a in addrs if "@" in a)
+    env.node.switch.dial_peers_async(
+        addrs, persistent=_parse_bool(persistent))
+    return {"log": "Dialing peers in progress. "
+                   "See /net_info for details"}
+
+
+async def _unsafe_flush_mempool(env):
+    """Reference: rpc/core/mempool.go UnsafeFlushMempool."""
+    _require_unsafe(env)
+    env.mempool.flush()
+    return {}
 
 
 async def _consensus_params(env, height):

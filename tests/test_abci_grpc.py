@@ -92,7 +92,7 @@ class TestNodeWithGRPCApp:
                     cfg.base.proxy_app = f"127.0.0.1:{port}"
                     cfg.p2p.laddr = "tcp://127.0.0.1:0"
                     cfg.rpc.laddr = ""
-                    cfg.consensus.timeout_commit = 0.05
+                    cfg.consensus.timeout_commit_ns = 50_000_000
                     os.makedirs(os.path.join(home, "config"),
                                 exist_ok=True)
                     os.makedirs(os.path.join(home, "data"),

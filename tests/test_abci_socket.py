@@ -236,7 +236,7 @@ class TestNodeWithSocketApp:
                     cfg.base.proxy_app = f"unix://{sock}"
                     cfg.p2p.laddr = "tcp://127.0.0.1:0"
                     cfg.rpc.laddr = ""
-                    cfg.consensus.timeout_commit = 0.05
+                    cfg.consensus.timeout_commit_ns = 50_000_000
                     os.makedirs(os.path.join(home, "config"),
                                 exist_ok=True)
                     os.makedirs(os.path.join(home, "data"), exist_ok=True)

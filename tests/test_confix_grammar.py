@@ -149,7 +149,7 @@ class TestGrammarChecker:
                 cfg.base.abci_grammar_trace = True
                 cfg.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg.rpc.laddr = "tcp://127.0.0.1:0"
-                cfg.consensus.timeout_commit = 0.02
+                cfg.consensus.timeout_commit_ns = 20_000_000
                 os.makedirs(os.path.join(home, "config"),
                             exist_ok=True)
                 os.makedirs(os.path.join(home, "data"), exist_ok=True)

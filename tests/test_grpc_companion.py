@@ -27,7 +27,7 @@ def _make_node_cfg(d: str):
     cfg.grpc.laddr = "tcp://127.0.0.1:0"
     cfg.grpc.privileged_laddr = "tcp://127.0.0.1:0"
     cfg.grpc.pruning_service_enabled = True
-    cfg.consensus.timeout_commit = 0.02
+    cfg.consensus.timeout_commit_ns = 20_000_000
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
     os.makedirs(os.path.join(home, "data"), exist_ok=True)
     pv = FilePV.generate(

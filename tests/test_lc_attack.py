@@ -49,8 +49,8 @@ def _cpu_backend():
 
 def _test_config():
     cfg = Config()
-    cfg.consensus.timeout_commit = 0.01
-    cfg.consensus.timeout_propose = 0.4
+    cfg.consensus.timeout_commit_ns = 10_000_000
+    cfg.consensus.timeout_propose_ns = 400_000_000
     return cfg
 
 

@@ -111,7 +111,7 @@ class TestNodeMetricsEndpoint:
                 cfg.base.home = home
                 cfg.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg.rpc.laddr = "tcp://127.0.0.1:0"
-                cfg.consensus.timeout_commit = 0.05
+                cfg.consensus.timeout_commit_ns = 50_000_000
                 os.makedirs(os.path.join(home, "config"), exist_ok=True)
                 os.makedirs(os.path.join(home, "data"), exist_ok=True)
                 pv = FilePV.generate(
@@ -348,7 +348,7 @@ class TestCryptoExtras:
                 cfg.base.home = home
                 cfg.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg.rpc.laddr = ""
-                cfg.consensus.timeout_commit = 0.02
+                cfg.consensus.timeout_commit_ns = 20_000_000
                 os.makedirs(os.path.join(home, "config"), exist_ok=True)
                 os.makedirs(os.path.join(home, "data"), exist_ok=True)
                 pv = FilePV.generate(

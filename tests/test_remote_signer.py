@@ -151,7 +151,7 @@ class TestNodeWithRemoteSigner:
                 cfg.base.priv_validator_laddr = "tcp://127.0.0.1:26679"
                 cfg.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg.rpc.laddr = ""
-                cfg.consensus.timeout_commit = 0.05
+                cfg.consensus.timeout_commit_ns = 50_000_000
                 os.makedirs(os.path.join(home, "config"), exist_ok=True)
                 os.makedirs(os.path.join(home, "data"), exist_ok=True)
                 NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
@@ -212,7 +212,7 @@ class TestPrivValServerCLI:
                 cfg.base.home = home
                 cfg.p2p.laddr = "tcp://127.0.0.1:0"
                 cfg.rpc.laddr = ""
-                cfg.consensus.timeout_commit = 0.05
+                cfg.consensus.timeout_commit_ns = 50_000_000
                 import socket as pysock
                 s = pysock.socket()
                 s.bind(("127.0.0.1", 0))

@@ -23,7 +23,8 @@ def _make_node_cfg(d):
     cfg.base.home = home
     cfg.p2p.laddr = "tcp://127.0.0.1:0"
     cfg.rpc.laddr = "tcp://127.0.0.1:0"
-    cfg.consensus.timeout_commit = 0.02
+    cfg.consensus.timeout_commit_ns = 20_000_000
+    cfg.rpc.unsafe = True     # exercise the unsafe control routes too
     os.makedirs(os.path.join(home, "config"), exist_ok=True)
     os.makedirs(os.path.join(home, "data"), exist_ok=True)
     pv = FilePV.generate(
@@ -115,6 +116,17 @@ class TestRPCContract:
                     args = {
                         "abci_query": {"path": "/store",
                                        "data": b"spec".hex()},
+                        "genesis_chunked": {"chunk": "0"},
+                        "header": {"height": "2"},
+                        "check_tx": {"tx": base64.b64encode(
+                            b"probe=ct").decode()},
+                        "dial_seeds": {"seeds":
+                                       "00" * 20 +
+                                       "@127.0.0.1:1"},
+                        "dial_peers": {"peers":
+                                       "11" * 20 +
+                                       "@127.0.0.1:1",
+                                       "persistent": False},
                         "broadcast_tx_sync": {"tx": tx64},
                         "broadcast_tx_async": {"tx": base64.b64encode(
                             b"probe=2").decode()},
@@ -134,9 +146,11 @@ class TestRPCContract:
                         "pruning_set_block_retain_height":
                             {"height": "2"},
                     }
-                    # block_by_hash needs a real hash
+                    # block_by_hash / header_by_hash need a real hash
                     blk = await cli.call("block", height="2")
                     args["block_by_hash"] = {
+                        "hash": "0x" + blk["block_id"]["hash"]}
+                    args["header_by_hash"] = {
                         "hash": "0x" + blk["block_id"]["hash"]}
                     # broadcast_evidence: forge valid dup-vote
                     # evidence signed by the node's own validator key
@@ -145,8 +159,26 @@ class TestRPCContract:
 
                     checked = 0
                     for method in spec["methods"]:
-                        result = await cli.call(
-                            method, **args.get(method, {}))
+                        if method == "unconfirmed_tx":
+                            # park a tx: stub out reaping so the
+                            # proposer can't commit it mid-call (the
+                            # sole-validator node otherwise commits
+                            # within ~10 ms of the add)
+                            from cometbft_tpu.types.tx import tx_hash
+                            mp = node.mempool
+                            orig = mp.reap_max_bytes_max_gas
+                            mp.reap_max_bytes_max_gas = \
+                                lambda *a, **k: []
+                            try:
+                                await mp.check_tx(b"uc=tx")
+                                result = await cli.call(
+                                    method, hash="0x" +
+                                    tx_hash(b"uc=tx").hex())
+                            finally:
+                                mp.reap_max_bytes_max_gas = orig
+                        else:
+                            result = await cli.call(
+                                method, **args.get(method, {}))
                         _check(spec, method, result)
                         checked += 1
                     assert checked == len(spec["methods"])
@@ -167,3 +199,33 @@ class TestRPCContract:
         served = set(core.routes(_Env()))
         assert served == set(spec["methods"]), (
             sorted(served ^ set(spec["methods"])))
+
+    def test_unsafe_routes_gated(self):
+        """dial_seeds/dial_peers/unsafe_flush_mempool must be refused
+        unless rpc.unsafe is set (reference: AddUnsafeRoutes is only
+        called for unsafe configs)."""
+        import pytest
+
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.rpc.client import HTTPClient, RPCClientError
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                cfg = _make_node_cfg(d)
+                cfg.rpc.unsafe = False
+                node = Node(cfg)
+                await node.start()
+                try:
+                    cli = HTTPClient(
+                        f"http://{node._rpc_server.listen_addr}",
+                        timeout=30.0)
+                    for method, kw in [
+                            ("dial_seeds", {"seeds": "x@h:1"}),
+                            ("dial_peers", {"peers": "x@h:1"}),
+                            ("unsafe_flush_mempool", {})]:
+                        with pytest.raises(RPCClientError,
+                                           match="unsafe"):
+                            await cli.call(method, **kw)
+                finally:
+                    await node.stop()
+        asyncio.run(run())

@@ -232,3 +232,35 @@ class TestAddrBookBuckets:
         assert book2.size() == 30
         assert book2.key == book.key
         assert sum(1 for a in book2._addrs.values() if a.is_old) == 10
+
+
+class TestPrivatePeers:
+    def test_private_peer_ids_not_gossiped(self):
+        """Addresses of private peers are withheld from PEX responses
+        (reference: p2p.private_peer_ids / UnsafeDialPeers private)."""
+        async def go():
+            async def mk():
+                nk = NodeKey.generate()
+                sw = Switch(nk, "pexnet", listen_addr="127.0.0.1:0")
+                pex = PexReactor(AddrBook())
+                sw.add_reactor(pex)
+                await sw.start()
+                await pex.start()
+                return sw, pex
+            a, pex_a = await mk()
+            b, pex_b = await mk()
+            c, pex_c = await mk()
+            # B marks A private BEFORE learning its address
+            b.private_ids.add(a.node_key.id)
+            await a.dial_peer(b.listen_addr)
+            await asyncio.sleep(0.1)
+            await c.dial_peer(b.listen_addr)
+            # give PEX time to exchange; C must never learn about A
+            await asyncio.sleep(1.0)
+            assert a.node_key.id not in c.peers
+            assert all(ka.node_id != a.node_key.id
+                       for ka in pex_c.book.pick_addresses(100))
+            for sw, pex in ((a, pex_a), (b, pex_b), (c, pex_c)):
+                await pex.stop()
+                await sw.stop()
+        run(go())
