@@ -152,7 +152,10 @@ async def _genesis_chunked(env, chunk):
                   for i in range(0, len(raw),
                                  _GENESIS_CHUNK_SIZE)] or [b""]
         env._genesis_chunks = chunks
-    cid = int(chunk)
+    try:
+        cid = int(chunk)
+    except (TypeError, ValueError):
+        raise RPCError(-32602, f"invalid chunk id {chunk!r}")
     if cid < 0 or cid >= len(chunks):
         raise RPCError(
             -32603, f"chunk id {cid} out of range [0, {len(chunks)})")
@@ -609,8 +612,13 @@ async def _unsafe_dial_peers(env, peers, persistent, private):
         from .server import RPCError
         raise RPCError(-32602, "no peers provided")
     if _parse_bool(private):
+        if not all("@" in a for a in addrs):
+            from .server import RPCError
+            raise RPCError(
+                -32602, "private peers must be id@host:port "
+                "(privacy is keyed on the node id)")
         env.node.switch.private_ids.update(
-            a.split("@", 1)[0] for a in addrs if "@" in a)
+            a.split("@", 1)[0] for a in addrs)
     env.node.switch.dial_peers_async(
         addrs, persistent=_parse_bool(persistent))
     return {"log": "Dialing peers in progress. "

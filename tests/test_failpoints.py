@@ -8,6 +8,7 @@ the replay tests iterate all indices the same way.
 """
 import os
 import subprocess
+import pytest
 import sys
 import tempfile
 
@@ -35,6 +36,7 @@ def _run(home: str, target: int, fail_index: int = -1,
 
 
 class TestCrashConsistency:
+    @pytest.mark.slow
     def test_recovery_at_every_commit_boundary(self):
         """For each index i: crash a node mid-commit at boundary i (the
         crash is index i of height 2's commit because height 1 commits
@@ -49,6 +51,7 @@ class TestCrashConsistency:
                 rc = _run(home, target=5)
                 assert rc == 0, f"recovery after crash at {i} failed"
 
+    @pytest.mark.slow
     def test_crash_at_later_height_boundaries(self):
         """Crash during the 3rd height's commit (index 2 heights in) and
         recover — catches bugs that only appear once LastCommit exists."""

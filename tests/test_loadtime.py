@@ -24,11 +24,17 @@ def _mk_node_cfg(d):
         cfg.base.path(cfg.base.priv_validator_key_file),
         cfg.base.path(cfg.base.priv_validator_state_file))
     NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
-    GenesisDoc(
+    gen = GenesisDoc(
         chain_id="load-chain", genesis_time=Timestamp.now(),
         validators=[GenesisValidator(
             address=b"", pub_key=pv.get_pub_key(), power=10)],
-    ).save_as(cfg.base.path(cfg.base.genesis_file))
+    )
+    # PBTS: block time is the proposer's clock at proposal, so tx
+    # latency (block time - send time) is non-negative; without it
+    # BFT time lags by up to one commit interval (the reference QA
+    # baseline, CometBFT-QA-v1, also runs with PBTS)
+    gen.consensus_params.feature.pbts_enable_height = 1
+    gen.save_as(cfg.base.path(cfg.base.genesis_file))
     return cfg
 
 
@@ -68,6 +74,17 @@ class TestLoadAgainstLiveNode:
                 await node.start()
                 try:
                     ep = f"http://{node._rpc_server.listen_addr}"
+                    # block 1 carries the genesis time (reference:
+                    # state.go MakeBlock at initial height), so load
+                    # must start after it or its txs get negative
+                    # latencies
+                    for _ in range(200):
+                        if node.height >= 1:
+                            break
+                        await asyncio.sleep(0.02)
+                    else:
+                        raise AssertionError(
+                            "node never reached height 1")
                     res = await loadtime.generate(
                         [ep], rate=40, connections=2,
                         duration_s=2.0, size=200)

@@ -7,6 +7,8 @@ import tempfile
 from cometbft_tpu.crypto import batch as crypto_batch
 import pytest
 
+pytestmark = pytest.mark.slow
+
 
 @pytest.fixture(autouse=True)
 def _cpu_backend():

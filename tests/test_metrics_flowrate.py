@@ -3,6 +3,7 @@
 Reference: libs/metrics + per-package metrics.go; internal/flowrate and
 the MConnection rate caps (connection.go:27-44).
 """
+import pytest
 import asyncio
 import time
 
@@ -191,8 +192,7 @@ class TestPrunerAndWALRotation:
         pruned, base = pr.prune_once()
         assert (pruned, base) == (29, 30)
         # companion can't move backwards
-        import pytest as _pytest
-        with _pytest.raises(ValueError):
+        with pytest.raises(ValueError):
             pr.set_companion_retain_height(10)
         # app knob silently keeps its max
         pr.set_application_retain_height(20)
@@ -296,7 +296,6 @@ class TestCryptoExtras:
             b"eth msg", sig[:32] + (n - s).to_bytes(32, "big"))
 
     def test_armor_roundtrip_and_tamper(self):
-        import pytest as _pytest
 
         from cometbft_tpu.crypto.armor import (
             ArmorError, decode_armor, encode_armor,
@@ -314,9 +313,10 @@ class TestCryptoExtras:
             if ln and not ln.startswith(("-", "=")) and ":" not in ln:
                 lines[i] = ("B" if ln[0] != "B" else "C") + ln[1:]
                 break
-        with _pytest.raises(ArmorError):
+        with pytest.raises(ArmorError):
             decode_armor("\n".join(lines))
 
+    @pytest.mark.slow
     def test_bench_helpers(self):
         from cometbft_tpu.crypto import ed25519
         from cometbft_tpu.crypto.benchmarking import (

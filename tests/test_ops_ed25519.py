@@ -14,6 +14,8 @@ from cometbft_tpu.crypto import _ed25519_ref as ref
 from cometbft_tpu.ops import ed25519_jax as ej
 from cometbft_tpu.ops import field
 
+pytestmark = pytest.mark.kernel
+
 
 def _sig(msg=None):
     seed = secrets.token_bytes(32)

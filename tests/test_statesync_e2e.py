@@ -12,6 +12,8 @@ import tempfile
 
 import pytest
 
+pytestmark = pytest.mark.slow
+
 from cometbft_tpu.abci.kvstore import KVStoreApplication
 from cometbft_tpu.config import Config
 from cometbft_tpu.crypto import batch as crypto_batch
