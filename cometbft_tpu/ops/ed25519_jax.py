@@ -294,6 +294,17 @@ def _verify_chunk(items) -> np.ndarray:
     if _kernel_choice() == "pallas":
         from . import ed25519_pallas as ep
         m = max(m, ep.BLOCK)
+    a_b, r_b, s_win, k_win, pre_bad = prep_arrays(items, m)
+    return _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
+
+
+def prep_arrays(items, m: int):
+    """The full host-side prep for a batch of (pub, msg, sig) items,
+    padded to m lanes: length/canonical-S checks, k = SHA-512(R||A||msg)
+    mod L, 4-bit window split.  Returns (a_b [m,32]u8, r_b [m,32]u8,
+    s_win [64,m]i32, k_win [64,m]i32, pre_bad [m]bool) — the arrays
+    both kernels consume.  Uses the one-pass C prep when the native
+    module is built, else the vectorized numpy path."""
     from ..crypto._native_loader import load as _load_native
     native = _load_native(allow_build=False)
     if native is not None and hasattr(native, "ed25519_prep"):
@@ -310,7 +321,7 @@ def _verify_chunk(items) -> np.ndarray:
             np.frombuffer(kw_buf, np.uint8).reshape(m, 64).T
         ).astype(np.int32)
         pre_bad = np.frombuffer(bad_buf, np.uint8).astype(bool)
-        return _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
+        return a_b, r_b, s_win, k_win, pre_bad
 
     a_b = np.zeros((m, 32), np.uint8)
     r_b = np.zeros((m, 32), np.uint8)
@@ -366,19 +377,23 @@ def _verify_chunk(items) -> np.ndarray:
         r_b[gi[keep]] = r_g[keep]
         s_raw[gi[keep]] = s_g[keep]
         k_raw[gi[keep]] = k_g[keep]
-    return _dispatch(n, a_b, r_b, _windows_le(s_raw),
-                     _windows_le(k_raw), pre_bad)
+    return a_b, r_b, _windows_le(s_raw), _windows_le(k_raw), pre_bad
 
 
-def _dispatch(n: int, a_b, r_b, s_win, k_win,
-              pre_bad) -> np.ndarray:
-    """Run the selected kernel on prepped arrays."""
-    if _kernel_choice() == "pallas":
+def _dispatch(n: int, a_b, r_b, s_win, k_win, pre_bad, *,
+              kernel: str = "", interpret: bool = False,
+              block: int = 0) -> np.ndarray:
+    """Run the selected kernel on prepped arrays.  kernel/interpret/
+    block override the environment-driven choice (used by the
+    interpret-mode Pallas parity tests, which exercise this exact
+    path with a small block)."""
+    if (kernel or _kernel_choice()) == "pallas":
         from . import ed25519_pallas as ep
         ok = np.asarray(ep.verify_cols(
             jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),
             jnp.asarray(np.ascontiguousarray(r_b.T).astype(np.int32)),
-            jnp.asarray(s_win), jnp.asarray(k_win)))
+            jnp.asarray(s_win), jnp.asarray(k_win),
+            interpret=interpret, block=block or ep.BLOCK))
     else:
         ok = np.asarray(_jit_verify(
             jnp.asarray(a_b), jnp.asarray(r_b),

@@ -323,38 +323,46 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
     ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, B))
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False):
+@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False,
+                   block=BLOCK):
     """a_cols, r_cols: [32, n] int32; s_win, k_win: [64, n] int32.
-    Returns ok [n] bool."""
+    Returns ok [n] bool.  n must be a multiple of block (the
+    production path pads to BLOCK; tests run interpret mode with a
+    small block so the emulated kernel stays tractable)."""
     n = a_cols.shape[1]
-    grid = n // BLOCK
+    if n % block != 0:
+        raise ValueError(
+            f"lane count {n} must be a multiple of block {block} — "
+            "remainder lanes would never be written by the kernel")
+    grid = n // block
     out = pl.pallas_call(
         _kernel,
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((LIMBS, BLOCK), lambda i: (0, i),
+            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((LIMBS, BLOCK), lambda i: (0, i),
+            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_WINDOWS, BLOCK), lambda i: (0, i),
+            pl.BlockSpec((_WINDOWS, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((_WINDOWS, BLOCK), lambda i: (0, i),
+            pl.BlockSpec((_WINDOWS, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((_CONSTS_NP.shape[0], 1), lambda i: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((8, BLOCK), lambda i: (0, i),
+        out_specs=pl.BlockSpec((8, block), lambda i: (0, i),
                                memory_space=pltpu.VMEM),
         scratch_shapes=[
-            pltpu.VMEM((16, 4 * LIMBS, BLOCK), jnp.int32),
+            pltpu.VMEM((16, 4 * LIMBS, block), jnp.int32),
         ],
         interpret=interpret,
     )(a_cols, r_cols, s_win, k_win, jnp.asarray(_CONSTS_NP))
     return out[0] != 0
 
 
-def verify_cols(a_cols, r_cols, s_win, k_win, interpret=False):
+def verify_cols(a_cols, r_cols, s_win, k_win, interpret=False,
+                block=BLOCK):
     return _pallas_verify(a_cols, r_cols, s_win, k_win,
-                          interpret=interpret)
+                          interpret=interpret, block=block)
