@@ -380,14 +380,40 @@ def prep_arrays(items, m: int):
     return a_b, r_b, _windows_le(s_raw), _windows_le(k_raw), pre_bad
 
 
+def _device_count() -> int:
+    try:
+        return len(jax.devices())
+    except Exception:
+        return 1
+
+
+def _shard_min() -> int:
+    """Smallest padded batch that auto-shards over a multi-device
+    mesh.  Small batches stay single-device — the collective + copy
+    overhead dwarfs the kernel there."""
+    return int(os.environ.get("COMETBFT_TPU_SHARD_MIN", "1024"))
+
+
 def _dispatch(n: int, a_b, r_b, s_win, k_win, pre_bad, *,
               kernel: str = "", interpret: bool = False,
               block: int = 0) -> np.ndarray:
     """Run the selected kernel on prepped arrays.  kernel/interpret/
     block override the environment-driven choice (used by the
     interpret-mode Pallas parity tests, which exercise this exact
-    path with a small block)."""
-    if (kernel or _kernel_choice()) == "pallas":
+    path with a small block).
+
+    Multi-chip: when more than one JAX device is visible and the
+    padded batch is at least COMETBFT_TPU_SHARD_MIN lanes, the batch
+    shards data-parallel over the full device mesh
+    (parallel/mesh.py; SURVEY §2.11)."""
+    choice = kernel or _kernel_choice()
+    ndev = _device_count()
+    if ndev > 1 and n >= _shard_min():
+        from ..parallel import mesh as pmesh
+        ok = pmesh.verify_sharded(
+            a_b, r_b, s_win, k_win, ndev=ndev, kernel=choice,
+            interpret=interpret, block=block)
+    elif choice == "pallas":
         from . import ed25519_pallas as ep
         ok = np.asarray(ep.verify_cols(
             jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),

@@ -10,6 +10,8 @@ p2p traffic never touches ICI (SURVEY §5 "distributed communication backend").
 """
 from __future__ import annotations
 
+import functools
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -30,6 +32,69 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
                 f"{len(devs)} JAX devices are available")
         devs = devs[:n_devices]
     return Mesh(np.asarray(devs), (BATCH_AXIS,))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_verify_fn(ndev: int, kernel: str, interpret: bool,
+                       block: int):
+    """Jitted shard_map'ed batch verify over an ndev mesh; per-shard
+    body is the selected kernel.  Cached per configuration — the jit
+    itself caches per shape."""
+    mesh = make_mesh(ndev)
+    if kernel == "pallas":
+        from ..ops import ed25519_pallas as ep
+
+        def body(a, r, s, k):
+            return ep.verify_cols(
+                jnp.transpose(a).astype(jnp.int32),
+                jnp.transpose(r).astype(jnp.int32),
+                s, k, interpret=interpret,
+                block=block or ep.BLOCK)
+    else:
+        def body(a, r, s, k):
+            return _verify_kernel(a, r, s, k)
+
+    shard = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(BATCH_AXIS), P(BATCH_AXIS),
+                  P(None, BATCH_AXIS), P(None, BATCH_AXIS)),
+        out_specs=P(BATCH_AXIS),
+    )
+    return jax.jit(shard)
+
+
+def verify_sharded(a_b, r_b, s_win, k_win, *, ndev: int,
+                   kernel: str = "xla", interpret: bool = False,
+                   block: int = 0) -> np.ndarray:
+    """Data-parallel batch verify over all ndev devices (SURVEY §2.11:
+    pjit/shard_map row).  Pads the lane count so every shard is equal
+    (and, for pallas, a block multiple); padding lanes are garbage and
+    simply sliced off — the caller masks pre-bad lanes itself.
+    Returns the exact per-lane ok mask for the original m lanes."""
+    m = a_b.shape[0]
+    shard = -(-m // ndev)
+    if kernel == "pallas":
+        from ..ops import ed25519_pallas as ep
+        block = block or ep.BLOCK       # normalize the cache key
+        shard = -(-shard // block) * block
+    else:
+        interpret, block = False, 0     # ignored by the xla body
+    m2 = shard * ndev
+    if m2 != m:
+        pad = m2 - m
+        a_b = np.concatenate([a_b, np.zeros((pad, 32), a_b.dtype)])
+        r_b = np.concatenate([r_b, np.zeros((pad, 32), r_b.dtype)])
+        s_win = np.concatenate(
+            [s_win, np.zeros((s_win.shape[0], pad), s_win.dtype)],
+            axis=1)
+        k_win = np.concatenate(
+            [k_win, np.zeros((k_win.shape[0], pad), k_win.dtype)],
+            axis=1)
+    fn = _sharded_verify_fn(ndev, kernel, interpret, block)
+    ok = np.asarray(fn(jnp.asarray(a_b), jnp.asarray(r_b),
+                       jnp.asarray(s_win), jnp.asarray(k_win)))
+    return ok[:m]
 
 
 def sharded_verify_tally(mesh: Mesh):
