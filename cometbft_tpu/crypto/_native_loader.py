@@ -35,6 +35,7 @@ def _sources() -> list[str]:
             os.path.join(d, "sha256.hpp"),
             os.path.join(d, "sha256_ni.hpp"),
             os.path.join(d, "sha512.hpp"),
+            os.path.join(d, "sha512_mb.hpp"),
             os.path.join(d, "bls12381.hpp")]
 
 

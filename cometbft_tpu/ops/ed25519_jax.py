@@ -309,17 +309,15 @@ def prep_arrays(items, m: int):
     native = _load_native(allow_build=False)
     if native is not None and hasattr(native, "ed25519_prep"):
         # the ENTIRE host prep in one C pass (length checks,
-        # canonical-S, k = SHA-512(R||A||msg) mod L, window split)
+        # canonical-S, k = SHA-512(R||A||msg) mod L, window split,
+        # transpose to the kernel's window-major int32 layout),
+        # threaded across cores with the GIL released
         a_buf, r_buf, sw_buf, kw_buf, bad_buf = native.ed25519_prep(
             items, m, _B_BYTES, _IDENTITY_BYTES)
         a_b = np.frombuffer(a_buf, np.uint8).reshape(m, 32)
         r_b = np.frombuffer(r_buf, np.uint8).reshape(m, 32)
-        s_win = np.ascontiguousarray(
-            np.frombuffer(sw_buf, np.uint8).reshape(m, 64).T
-        ).astype(np.int32)
-        k_win = np.ascontiguousarray(
-            np.frombuffer(kw_buf, np.uint8).reshape(m, 64).T
-        ).astype(np.int32)
+        s_win = np.frombuffer(sw_buf, np.int32).reshape(64, m)
+        k_win = np.frombuffer(kw_buf, np.int32).reshape(64, m)
         pre_bad = np.frombuffer(bad_buf, np.uint8).astype(bool)
         return a_b, r_b, s_win, k_win, pre_bad
 

@@ -14,10 +14,14 @@
 #include <Python.h>
 
 #include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
 #include <vector>
 
 #include "sha256.hpp"
 #include "sha512.hpp"
+#include "sha512_mb.hpp"
 #include "bls12381.hpp"
 
 namespace {
@@ -181,13 +185,190 @@ PyObject* ed25519_kscalars(PyObject*, PyObject* arg) {
 // ed25519_prep(items, m, b_bytes, identity_bytes) ->
 //   (a_b, r_b, s_win, k_win, pre_bad)
 // items: sequence of (pub, msg, sig) byte tuples; m: padded lane
-// count (>= len(items)).  Outputs are numpy-ready buffers:
+// count (>= len(items)).  Outputs are numpy-ready buffers in the
+// KERNEL'S layout (no host-side transpose or cast remains):
 //   a_b, r_b: [m, 32] uint8 (padding lanes = B / identity)
 //   s_win, k_win: [64, m] int32 4-bit windows, window-major
 //   pre_bad: [m] uint8 (1 = malformed or non-canonical S)
-// This is the batch verifier's entire host prep in one C pass — the
-// python per-item loop costs ~40 ms at 10k sigs, the <5 ms e2e
-// budget's biggest consumer.
+// This is the batch verifier's entire host prep: pointers are
+// extracted under the GIL (cheap), then the SHA-512 / window loop and
+// the blocked transpose-to-int32 run GIL-free across hardware
+// threads — the budget (BASELINE: < 5 ms e2e at 10k sigs) leaves
+// < 3 ms for all host work, and single-threaded SHA-512 alone is
+// ~9 ms at 10k.
+namespace prep {
+
+struct ItemRef {
+    const uint8_t* pub;
+    const uint8_t* msg;
+    size_t msglen;
+    const uint8_t* sig;
+    bool bad;
+};
+
+// L little-endian, for the canonical-S check
+static const uint8_t L_LE[32] = {
+    0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58,
+    0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9, 0xde, 0x14,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10,
+};
+
+inline void write_windows(uint8_t* row, const uint8_t le[32]) {
+    for (int b = 0; b < 32; b++) {
+        row[2 * b] = le[b] & 0x0F;
+        row[2 * b + 1] = le[b] >> 4;
+    }
+}
+
+inline void k_windows_from_digest(const uint8_t digest[64],
+                                  uint8_t* kw8, Py_ssize_t lane) {
+    uint8_t k_le[32];
+    sha512::reduce_mod_l(digest, k_le);
+    write_windows(kw8 + lane * 64, k_le);
+}
+
+#if COMETBFT_SHA512MB_X86
+// pending 8-lane group of equal-block-count messages for the
+// multi-buffer hasher
+struct KGroup {
+    size_t nblocks = 0;
+    int n = 0;
+    Py_ssize_t lane[8];
+    const ItemRef* item[8];
+};
+
+inline void flush_group(KGroup& g, std::vector<uint8_t>& scratch,
+                        uint8_t* kw8) {
+    if (g.n == 0) return;
+    size_t slot = g.nblocks * 128;
+    scratch.assign(slot * 8, 0);
+    const uint8_t* base[8];
+    for (int l = 0; l < 8; l++) {
+        int src = l < g.n ? l : 0;      // pad group with lane 0
+        if (l < g.n) {
+            uint8_t* buf = scratch.data() + size_t(l) * slot;
+            const ItemRef* it = g.item[l];
+            std::memcpy(buf, it->sig, 32);
+            std::memcpy(buf + 32, it->pub, 32);
+            std::memcpy(buf + 64, it->msg, it->msglen);
+            sha512mb::write_padding(buf, 64 + it->msglen,
+                                    g.nblocks);
+            base[l] = buf;
+        } else {
+            base[l] = scratch.data() + size_t(src) * slot;
+        }
+    }
+    uint8_t digests[8][64];
+    sha512mb::hash8(base, g.nblocks, digests);
+    for (int l = 0; l < g.n; l++)
+        k_windows_from_digest(digests[l], kw8, g.lane[l]);
+    g.n = 0;
+}
+#endif
+
+// phase 2 worker: lanes [lo, hi) — canonical-S, row copies, SHA-512
+// (8-way multi-buffer where AVX-512 is present), item-major windows
+void lanes(const ItemRef* refs, Py_ssize_t lo, Py_ssize_t hi,
+           uint8_t* a_p, uint8_t* r_p, uint8_t* sw8, uint8_t* kw8,
+           uint8_t* bad_p) {
+#if COMETBFT_SHA512MB_X86
+    const bool use_mb = sha512mb::available();
+    // groups keyed by block count (messages in one batch are nearly
+    // always uniform-length vote sign-bytes, so this stays tiny)
+    std::vector<KGroup> groups;
+    std::vector<uint8_t> scratch;
+#endif
+    for (Py_ssize_t i = lo; i < hi; i++) {
+        const ItemRef& it = refs[i];
+        if (it.bad) {
+            bad_p[i] = 1;
+            continue;
+        }
+        const uint8_t* s_le = it.sig + 32;
+        bool lt = false, gt = false;
+        for (int b = 31; b >= 0; b--) {
+            if (s_le[b] < L_LE[b]) { lt = true; break; }
+            if (s_le[b] > L_LE[b]) { gt = true; break; }
+        }
+        if (!lt || gt) {     // s >= L: non-canonical
+            bad_p[i] = 1;
+            continue;
+        }
+        std::memcpy(a_p + i * 32, it.pub, 32);
+        std::memcpy(r_p + i * 32, it.sig, 32);
+        write_windows(sw8 + i * 64, s_le);
+#if COMETBFT_SHA512MB_X86
+        if (use_mb) {
+            size_t nb = sha512mb::block_count(64 + it.msglen);
+            if (nb <= 128) {            // > 16 KiB msgs go scalar
+                KGroup* g = nullptr;
+                for (auto& cand : groups)
+                    if (cand.nblocks == nb) { g = &cand; break; }
+                if (!g) {
+                    groups.emplace_back();
+                    g = &groups.back();
+                    g->nblocks = nb;
+                }
+                g->lane[g->n] = i;
+                g->item[g->n] = &it;
+                if (++g->n == 8) flush_group(*g, scratch, kw8);
+                continue;
+            }
+        }
+#endif
+        // scalar fallback: k = SHA-512(R || A || msg) mod L
+        sha512::Ctx c;
+        sha512::init(&c);
+        sha512::update(&c, it.sig, 32);
+        sha512::update(&c, it.pub, 32);
+        sha512::update(&c, it.msg, it.msglen);
+        uint8_t digest[64];
+        sha512::final(&c, digest);
+        k_windows_from_digest(digest, kw8, i);
+    }
+#if COMETBFT_SHA512MB_X86
+    for (auto& g : groups) flush_group(g, scratch, kw8);
+#endif
+}
+
+// phase 3 worker: item-major uint8 [m, 64] -> window-major int32
+// [64, m], blocked so reads stay within a few cache lines per tile;
+// columns [lo, hi) of the output (= items lo..hi)
+void transpose_widen(const uint8_t* in8, int32_t* out32,
+                     Py_ssize_t m, Py_ssize_t lo, Py_ssize_t hi) {
+    const Py_ssize_t TILE = 64;
+    for (Py_ssize_t i0 = lo; i0 < hi; i0 += TILE) {
+        Py_ssize_t i1 = i0 + TILE < hi ? i0 + TILE : hi;
+        for (int w = 0; w < 64; w++) {
+            int32_t* orow = out32 + Py_ssize_t(w) * m;
+            for (Py_ssize_t i = i0; i < i1; i++)
+                orow[i] = in8[i * 64 + w];
+        }
+    }
+}
+
+void run_threads(Py_ssize_t n,
+                 const std::function<void(Py_ssize_t, Py_ssize_t)>& fn) {
+    unsigned hw = std::thread::hardware_concurrency();
+    unsigned nt = hw > 8 ? 8 : (hw ? hw : 1);
+    if (nt <= 1 || n < 2048) {
+        fn(0, n);
+        return;
+    }
+    std::vector<std::thread> ts;
+    Py_ssize_t chunk = (n + nt - 1) / nt;
+    for (unsigned t = 0; t < nt; t++) {
+        Py_ssize_t lo = Py_ssize_t(t) * chunk;
+        Py_ssize_t hi = lo + chunk < n ? lo + chunk : n;
+        if (lo >= hi) break;
+        ts.emplace_back(fn, lo, hi);
+    }
+    for (auto& th : ts) th.join();
+}
+
+}  // namespace prep
+
 PyObject* ed25519_prep(PyObject*, PyObject* args) {
     PyObject* seq_in;
     Py_ssize_t m;
@@ -210,22 +391,12 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
         PyErr_SetString(PyExc_ValueError, "m < len(items)");
         return nullptr;
     }
-    // L little-endian, for the canonical-S check
-    static const uint8_t L_LE[32] = {
-        0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58,
-        0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9, 0xde, 0x14,
-        0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
-        0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10,
-    };
     PyObject* a_out = PyBytes_FromStringAndSize(nullptr, m * 32);
     PyObject* r_out = PyBytes_FromStringAndSize(nullptr, m * 32);
-    // windows are item-major uint8 [m, 64]; python transposes to the
-    // kernel's [64, m] int32 layout vectorized (a window-major scatter
-    // here would cost a cache miss per window per item)
     PyObject* sw_out = PyBytes_FromStringAndSize(
-        nullptr, Py_ssize_t(64) * m);
+        nullptr, Py_ssize_t(64) * m * 4);
     PyObject* kw_out = PyBytes_FromStringAndSize(
-        nullptr, Py_ssize_t(64) * m);
+        nullptr, Py_ssize_t(64) * m * 4);
     PyObject* bad_out = PyBytes_FromStringAndSize(nullptr, m);
     if (!a_out || !r_out || !sw_out || !kw_out || !bad_out) {
         Py_XDECREF(a_out); Py_XDECREF(r_out); Py_XDECREF(sw_out);
@@ -234,30 +405,30 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
     }
     uint8_t* a_p = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(a_out));
     uint8_t* r_p = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(r_out));
-    uint8_t* sw_p =
-        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(sw_out));
-    uint8_t* kw_p =
-        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(kw_out));
+    int32_t* sw_p =
+        reinterpret_cast<int32_t*>(PyBytes_AS_STRING(sw_out));
+    int32_t* kw_p =
+        reinterpret_cast<int32_t*>(PyBytes_AS_STRING(kw_out));
     uint8_t* bad_p =
         reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(bad_out));
-    // padding defaults
-    for (Py_ssize_t i = 0; i < m; i++) {
-        std::memcpy(a_p + i * 32, b_bytes, 32);
-        std::memcpy(r_p + i * 32, id_bytes, 32);
-        bad_p[i] = 0;
-    }
-    std::memset(sw_p, 0, size_t(64) * m);
-    std::memset(kw_p, 0, size_t(64) * m);
 
+    // phase 1 (GIL held): borrow data pointers out of the Python
+    // objects; kept alive by `fast` + `fits` until the workers join
+    std::vector<prep::ItemRef> refs;
+    refs.resize(static_cast<size_t>(n));
+    std::vector<PyObject*> fits;
+    fits.reserve(size_t(n));
     for (Py_ssize_t i = 0; i < n; i++) {
+        prep::ItemRef& ref = refs[size_t(i)];
+        ref.bad = true;
         PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
         PyObject* fit = PySequence_Fast(it, "item must be a tuple");
         if (!fit || PySequence_Fast_GET_SIZE(fit) != 3) {
             PyErr_Clear();
             Py_XDECREF(fit);
-            bad_p[i] = 1;
             continue;
         }
+        fits.push_back(fit);
         char *pub, *msg, *sig;
         Py_ssize_t publen, msglen, siglen;
         if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fit, 0),
@@ -267,50 +438,40 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
             PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fit, 2),
                                     &sig, &siglen) < 0) {
             PyErr_Clear();
-            Py_DECREF(fit);
-            bad_p[i] = 1;
             continue;
         }
-        if (publen != 32 || siglen != 64) {
-            Py_DECREF(fit);
-            bad_p[i] = 1;
-            continue;
-        }
-        const uint8_t* s_le = reinterpret_cast<uint8_t*>(sig) + 32;
-        // canonical S: big-endian-wise compare s < L
-        bool lt = false, gt = false;
-        for (int b = 31; b >= 0; b--) {
-            if (s_le[b] < L_LE[b]) { lt = true; break; }
-            if (s_le[b] > L_LE[b]) { gt = true; break; }
-        }
-        if (!lt || gt) {     // s >= L
-            Py_DECREF(fit);
-            bad_p[i] = 1;
-            continue;
-        }
-        std::memcpy(a_p + i * 32, pub, 32);
-        std::memcpy(r_p + i * 32, sig, 32);
-        // k = SHA-512(R || A || msg) mod L
-        sha512::Ctx c;
-        sha512::init(&c);
-        sha512::update(&c, reinterpret_cast<uint8_t*>(sig), 32);
-        sha512::update(&c, reinterpret_cast<uint8_t*>(pub), 32);
-        sha512::update(&c, reinterpret_cast<uint8_t*>(msg),
-                       size_t(msglen));
-        uint8_t digest[64], k_le[32];
-        sha512::final(&c, digest);
-        sha512::reduce_mod_l(digest, k_le);
-        // 4-bit windows, item-major [m, 64] (contiguous writes)
-        uint8_t* srow = sw_p + i * 64;
-        uint8_t* krow = kw_p + i * 64;
-        for (int b = 0; b < 32; b++) {
-            srow[2 * b] = s_le[b] & 0x0F;
-            srow[2 * b + 1] = s_le[b] >> 4;
-            krow[2 * b] = k_le[b] & 0x0F;
-            krow[2 * b + 1] = k_le[b] >> 4;
-        }
-        Py_DECREF(fit);
+        if (publen != 32 || siglen != 64) continue;
+        ref.pub = reinterpret_cast<uint8_t*>(pub);
+        ref.msg = reinterpret_cast<uint8_t*>(msg);
+        ref.msglen = size_t(msglen);
+        ref.sig = reinterpret_cast<uint8_t*>(sig);
+        ref.bad = false;
     }
+
+    // phases 2+3 (GIL released): hash/window lanes, then transpose
+    {
+        std::vector<uint8_t> sw8(size_t(64) * size_t(m), 0);
+        std::vector<uint8_t> kw8(size_t(64) * size_t(m), 0);
+        uint8_t* sw8p = sw8.data();
+        uint8_t* kw8p = kw8.data();
+        const prep::ItemRef* refp = refs.data();
+        Py_BEGIN_ALLOW_THREADS
+        // padding defaults
+        for (Py_ssize_t i = 0; i < m; i++) {
+            std::memcpy(a_p + i * 32, b_bytes, 32);
+            std::memcpy(r_p + i * 32, id_bytes, 32);
+            bad_p[i] = 0;
+        }
+        prep::run_threads(n, [&](Py_ssize_t lo, Py_ssize_t hi) {
+            prep::lanes(refp, lo, hi, a_p, r_p, sw8p, kw8p, bad_p);
+        });
+        prep::run_threads(m, [&](Py_ssize_t lo, Py_ssize_t hi) {
+            prep::transpose_widen(sw8p, sw_p, m, lo, hi);
+            prep::transpose_widen(kw8p, kw_p, m, lo, hi);
+        });
+        Py_END_ALLOW_THREADS
+    }
+    for (PyObject* fit : fits) Py_DECREF(fit);
     Py_DECREF(fast);
     PyObject* out = PyTuple_Pack(5, a_out, r_out, sw_out, kw_out,
                                  bad_out);

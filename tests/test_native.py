@@ -105,7 +105,8 @@ class TestEd25519Prep:
             8, b"b" * 32, b"i" * 32)
         a_b, r_b, s_win, k_win, bad = out
         assert bad[0] == 1 and bad[1] == 1 and bad[3] == 1
-        assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64
+        # s_win is window-major int32 since the threaded prep rewrite
+        assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64 * 4
 
 
 class TestSha512AndKScalars:
@@ -226,3 +227,45 @@ class TestBLSFinalExp:
         if not hasattr(native, "bls_selftest"):
             pytest.skip("older native module")
         assert native.bls_selftest()
+
+
+class TestPrepParityVariedLengths:
+    def test_c_prep_matches_python_prep(self, monkeypatch):
+        """The threaded C prep (incl. the 8-way AVX-512 SHA-512 path,
+        its equal-block-count grouping, partial groups, and the scalar
+        fallback) must produce bit-identical arrays to the pure-python
+        prep across message lengths spanning 1..9 SHA-512 blocks,
+        non-canonical S, and malformed lanes."""
+        import numpy as np
+
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        from cometbft_tpu.ops import ed25519_jax as ej
+
+        _native()   # skip when no compiler
+        lengths = [0, 5, 47, 48, 63, 64, 111, 112, 120, 200, 300,
+                   1000]
+        items = []
+        for i in range(200):
+            seed = secrets.token_bytes(32)
+            msg = secrets.token_bytes(lengths[i % len(lengths)])
+            pub = ref.public_key(seed)
+            sig = ref.sign(seed, msg)
+            if i % 9 == 4:    # non-canonical S
+                sig = sig[:32] + (ref.L + 5).to_bytes(32, "little")
+            if i % 13 == 6:   # malformed
+                pub = b"short"
+            items.append((pub, msg, sig))
+        native_out = ej.prep_arrays(items, 256)
+
+        monkeypatch.setenv("COMETBFT_TPU_NATIVE", "0")
+        saved_mod, saved_failed = (_native_loader._mod,
+                                   _native_loader._failed)
+        _native_loader._mod = None
+        try:
+            python_out = ej.prep_arrays(items, 256)
+        finally:
+            _native_loader._mod = saved_mod
+            _native_loader._failed = saved_failed
+        for name, a, b in zip(("a_b", "r_b", "s_win", "k_win",
+                               "pre_bad"), native_out, python_out):
+            assert np.array_equal(a, b), f"{name} differs"
