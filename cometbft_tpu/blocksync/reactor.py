@@ -53,13 +53,16 @@ class BlocksyncReactor(Reactor):
     def __init__(self, state: SMState, block_exec, block_store,
                  active: bool,
                  on_caught_up: Optional[Callable] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 metrics=None):
         """on_caught_up(state, height) fires once when sync completes
         (the node switches to consensus there — reference:
         SwitchToConsensus)."""
         super().__init__("BLOCKSYNC")
         if logger is not None:
             self.logger = logger
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.state = state
         self.block_exec = block_exec
         self.block_store = block_store
@@ -82,6 +85,7 @@ class BlocksyncReactor(Reactor):
             send_request=self._send_block_request,
             ban_peer=self._ban_peer)
         self.pool.start()
+        self.metrics.syncing.set(1)
         loop = asyncio.get_running_loop()
         self._tasks = [
             loop.create_task(self._sync_routine()),
@@ -264,6 +268,12 @@ class BlocksyncReactor(Reactor):
                 self.state = await self.block_exec.apply_verified_block(
                     self.state, first_id, first,
                     pool.max_peer_height())
+                self.metrics.latest_block_height.set(
+                    first.header.height)
+                self.metrics.num_txs.set(len(first.data.txs))
+                self.metrics.total_txs.add(len(first.data.txs))
+                self.metrics.block_size_bytes.set(
+                    sum(len(tx) for tx in first.data.txs))
                 pool.pop_request()
         except asyncio.CancelledError:
             raise
@@ -277,6 +287,7 @@ class BlocksyncReactor(Reactor):
         switch at its first real suspension point."""
         height = pool.height - 1
         pool.stop()
+        self.metrics.syncing.set(0)
         self.pool = None
         current = asyncio.current_task()
         for t in self._tasks:

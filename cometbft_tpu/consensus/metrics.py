@@ -1,0 +1,189 @@
+"""Consensus metrics, fed at the point of action inside the state
+machine and reactor.
+
+Reference: internal/consensus/metrics.go:190 (+ metrics.gen.go) — the
+metric names, labels and semantics match the reference so existing
+dashboards port unchanged; recording mirrors recordMetrics in
+internal/consensus/state.go.
+"""
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+from ..libs import metrics as libmetrics
+
+
+class Metrics:
+    def __init__(self, registry: Optional[libmetrics.Registry] = None):
+        m = registry if registry is not None else libmetrics.Registry()
+        self.height = m.gauge(
+            "consensus", "height", "Height of the chain.")
+        self.validator_last_signed_height = m.gauge(
+            "consensus", "validator_last_signed_height",
+            "Last height signed by this validator if the node is a "
+            "validator.")
+        self.rounds = m.gauge(
+            "consensus", "rounds", "Number of rounds.")
+        self.round_duration_seconds = m.histogram(
+            "consensus", "round_duration_seconds",
+            "Histogram of round duration.")
+        self.validators = m.gauge(
+            "consensus", "validators", "Number of validators.")
+        self.validators_power = m.gauge(
+            "consensus", "validators_power",
+            "Total power of all validators.")
+        self.missing_validators = m.gauge(
+            "consensus", "missing_validators",
+            "Number of validators who did not sign.")
+        self.missing_validators_power = m.gauge(
+            "consensus", "missing_validators_power",
+            "Total power of the missing validators.")
+        self.byzantine_validators = m.gauge(
+            "consensus", "byzantine_validators",
+            "Number of validators who tried to double sign.")
+        self.byzantine_validators_power = m.gauge(
+            "consensus", "byzantine_validators_power",
+            "Total power of the byzantine validators.")
+        self.block_interval_seconds = m.histogram(
+            "consensus", "block_interval_seconds",
+            "Time between this and the last block.")
+        self.num_txs = m.gauge(
+            "consensus", "num_txs", "Number of transactions.")
+        self.block_size_bytes = m.gauge(
+            "consensus", "block_size_bytes", "Size of the block.")
+        self.chain_size_bytes = m.counter(
+            "consensus", "chain_size_bytes",
+            "Size of the chain in bytes.")
+        self.total_txs = m.counter(
+            "consensus", "total_txs",
+            "Total number of transactions.")
+        self.latest_block_height = m.gauge(
+            "consensus", "latest_block_height",
+            "The latest block height.")
+        self.step_duration_seconds = m.histogram(
+            "consensus", "step_duration_seconds",
+            "Histogram of durations for each step in the consensus "
+            "protocol.", labels=("step",))
+        self.block_parts = m.counter(
+            "consensus", "block_parts",
+            "Number of block parts transmitted by each peer.",
+            labels=("peer_id",))
+        self.duplicate_block_part = m.counter(
+            "consensus", "duplicate_block_part",
+            "Number of times we received a duplicate block part")
+        self.duplicate_vote = m.counter(
+            "consensus", "duplicate_vote",
+            "Number of times we received a duplicate vote")
+        self.block_gossip_parts_received = m.counter(
+            "consensus", "block_gossip_parts_received",
+            "Number of block parts received by the node, separated "
+            "by whether the part was relevant to the block the node "
+            "is trying to gather or not.",
+            labels=("matches_current",))
+        self.quorum_prevote_delay = m.gauge(
+            "consensus", "quorum_prevote_delay",
+            "Interval in seconds between the proposal timestamp and "
+            "the timestamp of the earliest prevote that achieved a "
+            "quorum.", labels=("proposer_address",))
+        self.full_prevote_delay = m.gauge(
+            "consensus", "full_prevote_delay",
+            "Interval in seconds between the proposal timestamp and "
+            "the timestamp of the latest prevote in a round where "
+            "all validators voted.", labels=("proposer_address",))
+        self.vote_extension_receive_count = m.counter(
+            "consensus", "vote_extension_receive_count",
+            "Number of vote extensions received, annotated by "
+            "application verdict.", labels=("status",))
+        self.proposal_receive_count = m.counter(
+            "consensus", "proposal_receive_count",
+            "Total number of proposals received since process "
+            "start, annotated by app verdict.", labels=("status",))
+        self.proposal_create_count = m.counter(
+            "consensus", "proposal_create_count",
+            "Total number of proposals created since process start.")
+        self.round_voting_power_percent = m.gauge(
+            "consensus", "round_voting_power_percent",
+            "Percentage of the total voting power received with a "
+            "round, by vote type.", labels=("vote_type",))
+        self.late_votes = m.counter(
+            "consensus", "late_votes",
+            "Number of votes received corresponding to earlier "
+            "heights/rounds than the node is in.",
+            labels=("vote_type",))
+        self.proposal_timestamp_difference = m.histogram(
+            "consensus", "proposal_timestamp_difference",
+            "Difference in seconds between local receive time and "
+            "the proposal message timestamp.",
+            labels=("is_timely",),
+            buckets=(-1.0, -0.5, -0.1, 0.0, 0.1, 0.5, 1.0, 2.0, 5.0))
+
+        self._step_name = ""
+        self._step_t = time.monotonic()
+        self._round_t = time.monotonic()
+        self._block_t = 0.0
+
+    # ---- recording hooks (mirrors recordMetrics) ---------------------
+    def mark_step(self, rs) -> None:
+        now = time.monotonic()
+        if self._step_name:
+            self.step_duration_seconds.with_labels(
+                self._step_name).observe(now - self._step_t)
+        self._step_name = rs.step_name()
+        self._step_t = now
+        self.rounds.set(rs.round)
+
+    def mark_round(self, round_: int) -> None:
+        now = time.monotonic()
+        self.round_duration_seconds.observe(now - self._round_t)
+        self._round_t = now
+        self.rounds.set(round_)
+
+    def record_commit(self, block, last_validators,
+                      current_validators) -> None:
+        """Per-commit stats (reference: recordMetrics, state.go).
+        last_validators signed block.last_commit."""
+        now = time.monotonic()
+        self.height.set(block.header.height)
+        self.latest_block_height.set(block.header.height)
+        self.num_txs.set(len(block.data.txs))
+        self.total_txs.add(len(block.data.txs))
+        size = sum(len(tx) for tx in block.data.txs)
+        self.block_size_bytes.set(size)
+        self.chain_size_bytes.add(size)
+        if self._block_t:
+            self.block_interval_seconds.observe(now - self._block_t)
+        self._block_t = now
+        if current_validators is not None:
+            self.validators.set(current_validators.size())
+            self.validators_power.set(
+                current_validators.total_voting_power())
+        if last_validators is not None and block.last_commit and \
+                block.last_commit.signatures:
+            from ..types.commit import BLOCK_ID_FLAG_ABSENT
+            missing = 0
+            missing_power = 0
+            for i, sig in enumerate(block.last_commit.signatures):
+                if sig.block_id_flag == BLOCK_ID_FLAG_ABSENT and \
+                        i < last_validators.size():
+                    missing += 1
+                    missing_power += \
+                        last_validators.validators[i].voting_power
+            self.missing_validators.set(missing)
+            self.missing_validators_power.set(missing_power)
+        byz = 0
+        byz_power = 0
+        for ev in block.evidence:   # gauges reset below when no evidence
+            addrs = getattr(ev, "byzantine_addresses", None)
+            if addrs is None:
+                va = getattr(ev, "vote_a", None)
+                addrs = [va.validator_address] if va is not None \
+                    else []
+            for addr in addrs:
+                byz += 1
+                if last_validators is not None:
+                    _, v = last_validators.get_by_address(addr)
+                    if v is not None:
+                        byz_power += v.voting_power
+        self.byzantine_validators.set(byz)
+        self.byzantine_validators_power.set(byz_power)

@@ -65,7 +65,10 @@ class ConsensusState:
                  priv_validator: Optional[PrivValidator] = None,
                  event_bus: Optional[EventBus] = None,
                  wal: Optional[WAL] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 metrics: Optional["Metrics"] = None):
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.config = config
         self.block_exec = block_exec
         self.block_store = block_store
@@ -342,6 +345,7 @@ class ConsensusState:
                         **self.rs.event_summary()})
         self.n_steps += 1
         self.event_bus.publish_new_round_step(self.rs.event_summary())
+        self.metrics.mark_step(self.rs)
         for hook in self.on_new_step:
             hook(self.rs)
 
@@ -380,6 +384,7 @@ class ConsensusState:
             rs.proposal_block_parts = None
         rs.votes.set_round(round_ + 1)  # track next round too
         rs.triggered_timeout_precommit = False
+        self.metrics.mark_round(round_)
         self.event_bus.publish_new_round(rs.event_summary())
         await self._enter_propose(height, round_)
 
@@ -440,6 +445,7 @@ class ConsensusState:
             block_id=prop_block_id, timestamp=block.header.time)
         try:
             await self._pv_sign_proposal(proposal)
+            self.metrics.proposal_create_count.add()
         except Exception as e:
             if not self.replay_mode:
                 self.logger.error("failed signing proposal",
@@ -521,6 +527,15 @@ class ConsensusState:
 
         rs.proposal = proposal
         rs.proposal_receive_time = recv_time
+        diff_s = recv_time.sub(proposal.timestamp) / 1e9
+        timely = "true"
+        if self._pbts_enabled(rs.height):
+            sp = self.sm_state.consensus_params.synchrony.in_round(
+                proposal.round)
+            timely = "true" if proposal.is_timely(
+                recv_time, sp) else "false"
+        self.metrics.proposal_timestamp_difference.with_labels(
+            timely).observe(diff_s)
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header)
@@ -542,9 +557,15 @@ class ConsensusState:
             # consensus failure — reference state.go:2129-2150 returns
             # ErrPartSetInvalidProof to handleMsg, which only logs it.
             self.logger.debug("Invalid block part", err=str(e), peer=peer_id)
+            self.metrics.block_gossip_parts_received.with_labels(
+                "false").add()
             return False
         if not added:
+            self.metrics.duplicate_block_part.add()
             return False
+        self.metrics.block_parts.with_labels(peer_id or "local").add()
+        self.metrics.block_gossip_parts_received.with_labels(
+            "true").add()
         max_bytes = self.sm_state.consensus_params.block.max_bytes
         if max_bytes == -1:
             max_bytes = MAX_BLOCK_SIZE_BYTES
@@ -642,6 +663,8 @@ class ConsensusState:
                     return
                 is_app_valid = await self.block_exec.process_proposal(
                     rs.proposal_block, self.sm_state)
+                self.metrics.proposal_receive_count.with_labels(
+                    "accepted" if is_app_valid else "rejected").add()
                 if not is_app_valid:
                     self.logger.error(
                         "prevote step: app rejected proposal; "
@@ -877,6 +900,8 @@ class ConsensusState:
         fail.fail()    # crash point: barrier written, block not applied
                        # (state.go:1911)
 
+        self.metrics.record_commit(block, rs.last_validators,
+                                   rs.validators)
         state_copy = self.sm_state.copy()
         state_copy = await self.block_exec.apply_verified_block(
             state_copy,
@@ -960,6 +985,8 @@ class ConsensusState:
                 vote.verify_extension(self.sm_state.chain_id,
                                       val.pub_key)
                 ok = await self.block_exec.verify_vote_extension(vote)
+                self.metrics.vote_extension_receive_count.with_labels(
+                    "accepted" if ok else "rejected").add()
                 if not ok:
                     raise VoteSetError("invalid vote extension")
         elif vote.extension or vote.extension_signature or \
@@ -968,16 +995,40 @@ class ConsensusState:
                 "received vote with extension while extensions are "
                 "disabled")
 
+        vt_label = "prevote" \
+            if vote.type == canonical.PREVOTE_TYPE else "precommit"
+        if vote.round < rs.round:
+            self.metrics.late_votes.with_labels(vt_label).add()
         height = rs.height
         added = rs.votes.add_vote(vote, peer_id)
         if not added:
+            self.metrics.duplicate_vote.add()
             return False
+        vs = rs.votes.prevotes(vote.round) \
+            if vote.type == canonical.PREVOTE_TYPE \
+            else rs.votes.precommits(vote.round)
+        total_power = rs.validators.total_voting_power()
+        if vs is not None and total_power > 0:
+            self.metrics.round_voting_power_percent.with_labels(
+                vt_label).set(vs.sum / total_power)
         self.event_bus.publish_vote(vote)
         self._broadcast(("has_vote", vote))
 
         if vote.type == canonical.PREVOTE_TYPE:
             prevotes = rs.votes.prevotes(vote.round)
             block_id, ok = prevotes.two_thirds_majority()
+            if ok and rs.proposal is not None:
+                proposer = rs.validators.get_proposer() \
+                    .address.hex().upper()
+                delay_s = vote.timestamp.sub(
+                    rs.proposal.timestamp) / 1e9
+                self.metrics.quorum_prevote_delay.with_labels(
+                    proposer).set(delay_s)
+                if prevotes.bit_array().size() and \
+                        all(prevotes.bit_array().get_index(i)
+                            for i in range(rs.validators.size())):
+                    self.metrics.full_prevote_delay.with_labels(
+                        proposer).set(delay_s)
             if ok and not block_id.is_nil():
                 # update valid block
                 if rs.valid_round < vote.round and \
@@ -1124,6 +1175,7 @@ class ConsensusState:
         vote = await self._sign_vote(msg_type, hash_, psh, block)
         if vote is None:
             return
+        self.metrics.validator_last_signed_height.set(self.rs.height)
         self.send_internal(VoteMessage(vote))
         self._broadcast(VoteMessage(vote))
 

@@ -115,7 +115,8 @@ class CListMempool(Mempool):
                  lanes: Optional[dict[str, int]] = None,
                  default_lane: str = "",
                  height: int = 0,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 metrics=None):
         """proxy_app: the mempool ABCI connection.  lanes: lane id →
         priority from the app's InfoResponse; empty → single implicit
         lane (priority 0)."""
@@ -123,6 +124,8 @@ class CListMempool(Mempool):
             raise MempoolError("lanes set but no default lane")
         if lanes and default_lane not in lanes:
             raise MempoolError("default lane not in lane list")
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.config = config
         self.proxy_app = proxy_app
         self.logger = logger if logger is not None else \
@@ -223,6 +226,7 @@ class CListMempool(Mempool):
                 e = d.get(key)
                 if e is not None and sender:
                     e.senders.add(sender)
+            self.metrics.already_received_txs.add()
             raise TxInCacheError("tx already exists in cache")
         try:
             res = await self.proxy_app.check_tx(
@@ -233,6 +237,7 @@ class CListMempool(Mempool):
         if res.code != abci.CODE_TYPE_OK:
             if not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
+            self.metrics.failed_txs.add()
             raise InvalidTxError(res.code, res.log)
         try:
             lane = self._resolve_lane(res.lane_id)
@@ -254,6 +259,7 @@ class CListMempool(Mempool):
     def _check_full(self, tx_size: int) -> None:
         if self.size() >= self.config.size or \
                 self._size_bytes + tx_size > self.config.max_txs_bytes:
+            self.metrics.rejected_txs.add()
             raise MempoolFullError(
                 f"mempool is full: {self.size()} txs, "
                 f"{self._size_bytes} bytes")
@@ -272,6 +278,8 @@ class CListMempool(Mempool):
                           seq=self._seq)
         self._lane_txs[lane][key] = entry
         self._size_bytes += len(tx)
+        self.metrics.tx_size_bytes.observe(len(tx))
+        self.metrics.update_sizes(self)
         self.logger.debug("Added tx", lane=lane,
                           tx=key.hex().upper()[:12])
         self._notify_txs_available()
@@ -364,7 +372,12 @@ class CListMempool(Mempool):
             except MempoolError:
                 pass
         if self.config.recheck and self.size() > 0:
+            import time as _time
+            t0 = _time.perf_counter()
             await self._recheck_txs()
+            self.metrics.recheck_duration_seconds.set(
+                _time.perf_counter() - t0)
+        self.metrics.update_sizes(self)
         self._notify_txs_available()
 
     async def _recheck_txs(self) -> None:
@@ -377,9 +390,11 @@ class CListMempool(Mempool):
                     continue
                 res = await self.proxy_app.check_tx(abci.CheckTxRequest(
                     tx=e.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+                self.metrics.recheck_times.add()
                 if res.code != abci.CODE_TYPE_OK:
                     d.pop(key, None)
                     self._size_bytes -= len(e.tx)
+                    self.metrics.evicted_txs.add()
                     if not self.config.keep_invalid_txs_in_cache:
                         self.cache.remove(key)
 

@@ -1,0 +1,58 @@
+"""Mempool metrics (reference: mempool/metrics.go + metrics.gen.go —
+same names/labels so dashboards port)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs import metrics as libmetrics
+
+
+class Metrics:
+    def __init__(self, registry: Optional[libmetrics.Registry] = None):
+        m = registry if registry is not None else libmetrics.Registry()
+        self.size = m.gauge(
+            "mempool", "size",
+            "Number of uncommitted transactions in the mempool.")
+        self.size_bytes = m.gauge(
+            "mempool", "size_bytes",
+            "Total size of the mempool in bytes.")
+        self.lane_size = m.gauge(
+            "mempool", "lane_size",
+            "Number of txs in a lane.", labels=("lane",))
+        self.lane_bytes = m.gauge(
+            "mempool", "lane_bytes",
+            "Bytes in a lane.", labels=("lane",))
+        self.tx_size_bytes = m.histogram(
+            "mempool", "tx_size_bytes",
+            "Histogram of transaction sizes in bytes.",
+            buckets=(16, 64, 256, 1024, 4096, 16384, 65536, 262144,
+                     1048576))
+        self.failed_txs = m.counter(
+            "mempool", "failed_txs",
+            "Number of failed transactions.")
+        self.rejected_txs = m.counter(
+            "mempool", "rejected_txs",
+            "Number of rejected transactions (mempool full / too "
+            "large).")
+        self.evicted_txs = m.counter(
+            "mempool", "evicted_txs",
+            "Number of evicted transactions.")
+        self.recheck_times = m.counter(
+            "mempool", "recheck_times",
+            "Number of times transactions were rechecked in the "
+            "mempool.")
+        self.recheck_duration_seconds = m.gauge(
+            "mempool", "recheck_duration_seconds",
+            "Duration of the last recheck pass.")
+        self.already_received_txs = m.counter(
+            "mempool", "already_received_txs",
+            "Number of duplicate transaction receptions (cache "
+            "hits).")
+
+    def update_sizes(self, mempool) -> None:
+        self.size.set(mempool.size())
+        self.size_bytes.set(mempool.size_bytes())
+        for lane in getattr(mempool, "_lane_txs", {}):
+            n, b = mempool.lane_sizes(lane)
+            self.lane_size.with_labels(lane).set(n)
+            self.lane_bytes.with_labels(lane).set(b)

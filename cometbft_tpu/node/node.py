@@ -128,35 +128,25 @@ class Node:
         # --- event bus --------------------------------------------------
         self.event_bus = EventBus()
 
-        # --- metrics (reference: per-package metrics.go + /metrics) -----
+        # --- metrics: one shared registry, per-subsystem families fed
+        # at the point of action (reference: per-package metrics.go,
+        # served at /metrics) -------------------------------------------
+        from ..abci.metrics import Metrics as ProxyMetrics
+        from ..blocksync.metrics import Metrics as BlocksyncMetrics
+        from ..consensus.metrics import Metrics as ConsensusMetrics
         from ..libs.metrics import Registry
+        from ..mempool.metrics import Metrics as MempoolMetrics
+        from ..p2p.metrics import Metrics as P2PMetrics
+        from ..state.metrics import Metrics as StateMetrics
+        from ..statesync.metrics import Metrics as StatesyncMetrics
         self.metrics_registry = Registry()
-        m = self.metrics_registry
-        self._m_height = m.gauge("consensus", "height",
-                                 "Height of the chain")
-        self._m_txs = m.counter("consensus", "total_txs",
-                                "Total committed txs")
-        self._m_block_interval = m.histogram(
-            "consensus", "block_interval_seconds",
-            "Time between this and the last block")
-        self._m_block_size = m.gauge("consensus", "block_size_bytes",
-                                     "Size of the latest block")
-        self._m_validators = m.gauge("consensus", "validators",
-                                     "Number of validators")
-        self._m_mempool_size = m.gauge("mempool", "size",
-                                       "Pending txs in the mempool")
-        self._m_peers = m.gauge("p2p", "peers", "Connected peers")
-        self._m_step_duration = m.histogram(
-            "consensus", "step_duration_seconds",
-            "Time spent in each consensus step", labels=("step",),
-            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0))
-        self._m_rounds = m.gauge("consensus", "rounds",
-                                 "Round of the latest committed height")
-        self._m_p2p_sent = m.gauge("p2p", "message_send_bytes_total",
-                                   "Bytes sent to peers")
-        self._m_p2p_recv = m.gauge("p2p", "message_receive_bytes_total",
-                                   "Bytes received from peers")
-        self._last_block_time_s: float = 0.0
+        self.consensus_metrics = ConsensusMetrics(self.metrics_registry)
+        self.mempool_metrics = MempoolMetrics(self.metrics_registry)
+        self.p2p_metrics = P2PMetrics(self.metrics_registry)
+        self.blocksync_metrics = BlocksyncMetrics(self.metrics_registry)
+        self.statesync_metrics = StatesyncMetrics(self.metrics_registry)
+        self.state_metrics = StateMetrics(self.metrics_registry)
+        self.proxy_metrics = ProxyMetrics(self.metrics_registry)
 
         # --- mempool ----------------------------------------------------
         self.mempool: Optional[CListMempool] = None
@@ -172,7 +162,8 @@ class Node:
             listen_addr=config.p2p.laddr.replace("tcp://", ""),
             moniker=config.base.moniker,
             send_rate=config.p2p.send_rate,
-            recv_rate=config.p2p.recv_rate)
+            recv_rate=config.p2p.recv_rate,
+            metrics=self.p2p_metrics)
         self.switch.private_ids = {
             s.strip() for s in
             config.p2p.private_peer_ids.split(",") if s.strip()}
@@ -207,6 +198,10 @@ class Node:
         # (reference: createAndStartProxyAppConns, setup.go:179)
         await self.app_conns.start()
 
+        # per-method ABCI timing (reference: proxy metrics)
+        from ..abci.metrics import instrument_app_conns
+        instrument_app_conns(self.app_conns, self.proxy_metrics)
+
         # optional ABCI call-trace recording for the grammar checker
         # (reference: the e2e app records requests for
         # test/e2e/pkg/grammar/checker.go)
@@ -231,7 +226,8 @@ class Node:
             cfg.mempool, self.app_conns.mempool,
             lanes=info.lane_priorities or None,
             default_lane=info.default_lane,
-            height=state.last_block_height)
+            height=state.last_block_height,
+            metrics=self.mempool_metrics)
 
         # pruner service (reference: state/pruner.go via setup.go)
         from ..state.pruner import Pruner
@@ -243,7 +239,8 @@ class Node:
             # companion configured, blocks it hasn't released must
             # survive restarts
             companion_enabled=bool(cfg.grpc.privileged_laddr and
-                                   cfg.grpc.pruning_service_enabled))
+                                   cfg.grpc.pruning_service_enabled),
+            metrics=self.state_metrics)
         # started below, once the indexers are attached — a pass that
         # ran before attachment would skip indexer pruning
 
@@ -292,29 +289,16 @@ class Node:
             self.state_store, self.app_conns.consensus,
             mempool=self.mempool, evpool=self.evidence_pool,
             event_bus=self.event_bus,
-            block_store=self.block_store)
+            block_store=self.block_store,
+            metrics=self.state_metrics)
         block_exec.pruner = self.pruner
-
-        # consensus step timings (reference: consensus metrics.go
-        # StepDurationSeconds via recordMetrics)
-        import time as _time
-        step_clock = {"name": "", "t": _time.monotonic()}
-
-        def _on_step(rs):
-            now = _time.monotonic()
-            if step_clock["name"]:
-                self._m_step_duration.with_labels(
-                    step_clock["name"]).observe(now - step_clock["t"])
-            step_clock["name"] = rs.step_name()
-            step_clock["t"] = now
-            self._m_rounds.set(rs.round)
 
         wal_path = cfg.base.path(cfg.consensus.wal_file)
         self.consensus_state = ConsensusState(
             cfg.consensus, state, block_exec, self.block_store,
             priv_validator=self.priv_validator,
-            event_bus=self.event_bus, wal=WAL(wal_path))
-        self.consensus_state.on_new_step.append(_on_step)
+            event_bus=self.event_bus, wal=WAL(wal_path),
+            metrics=self.consensus_metrics)
         try:
             try:
                 await catchup_replay(self.consensus_state, wal_path)
@@ -382,7 +366,8 @@ class Node:
         self.blocksync_reactor = BlocksyncReactor(
             state, block_exec, self.block_store,
             active=run_blocksync,
-            on_caught_up=_switch_to_consensus)
+            on_caught_up=_switch_to_consensus,
+            metrics=self.blocksync_metrics)
         self.switch.add_reactor(self.blocksync_reactor)
         self._run_blocksync = run_blocksync
 
@@ -404,9 +389,11 @@ class Node:
                 chunk_dir=cfg.statesync.temp_dir or None)
             self._statesync_syncer = syncer
             self.statesync_reactor = StatesyncReactor(
-                self.app_conns, syncer)
+                self.app_conns, syncer,
+                metrics=self.statesync_metrics)
         else:
-            self.statesync_reactor = StatesyncReactor(self.app_conns)
+            self.statesync_reactor = StatesyncReactor(
+                self.app_conns, metrics=self.statesync_metrics)
         self.switch.add_reactor(self.statesync_reactor)
 
         # RPC before p2p (reference: OnStart order)
@@ -461,6 +448,7 @@ class Node:
             self.state_store.bootstrap(new_state)
             self.block_store.save_seen_commit_standalone(commit)
             self.blocksync_reactor.state = new_state
+            self.statesync_reactor.metrics.syncing.set(0)
             self.logger.info("State sync complete",
                              height=new_state.last_block_height)
             await self.blocksync_reactor.start_sync()
@@ -468,16 +456,12 @@ class Node:
             await self.blocksync_reactor.start_sync()
         else:
             await self.consensus_state.start()
-        self._metrics_task = asyncio.get_running_loop().create_task(
-            self._metrics_watcher())
         self._started = True
         self.logger.info("Node started",
                          node_id=self.node_key.id[:12],
                          chain=self.genesis_doc.chain_id)
 
     async def stop(self) -> None:
-        if getattr(self, "_metrics_task", None) is not None:
-            self._metrics_task.cancel()
         if getattr(self, "pruner", None) is not None:
             await self.pruner.stop()
         if getattr(self, "indexer_service", None) is not None:
@@ -513,59 +497,6 @@ class Node:
             self.genesis_doc.chain_id, self.genesis_doc,
             list(cfg.rpc_servers), cfg.trust_height,
             bytes.fromhex(cfg.trust_hash), cfg.trust_period_ns)
-
-    async def _metrics_watcher(self) -> None:
-        """Event-driven metric updates (reference: recordMetrics in
-        internal/consensus/state.go + per-subsystem metrics.go)."""
-        import time as _time
-        from ..libs.pubsub import PubSubError
-        while True:
-            try:
-                self.event_bus.unsubscribe_all("node-metrics")
-            except Exception:
-                pass
-            sub = self.event_bus.subscribe("node-metrics",
-                                           "tm.event = 'NewBlock'")
-            try:
-                await self._metrics_pump(sub)
-            except asyncio.CancelledError:
-                raise
-            except PubSubError:
-                # subscription overflowed (e.g. during fast sync):
-                # resubscribe instead of dying with frozen gauges
-                await asyncio.sleep(0.5)
-            except Exception:
-                self.logger.error("metrics watcher error",
-                                  exc_info=True)
-                await asyncio.sleep(5)
-
-    async def _metrics_pump(self, sub) -> None:
-        import time as _time
-        while True:
-            msg = await sub.next()
-            now = _time.monotonic()
-            payload = msg.data.payload
-            block = payload.get("block")
-            if block is None:
-                continue
-            self._m_height.set(block.header.height)
-            self._m_txs.add(len(block.data.txs))
-            if self._last_block_time_s:
-                self._m_block_interval.observe(
-                    now - self._last_block_time_s)
-            self._last_block_time_s = now
-            state = self.state_store.load()
-            if state is not None:
-                self._m_validators.set(state.validators.size())
-            if self.mempool is not None:
-                self._m_mempool_size.set(self.mempool.size())
-            self._m_peers.set(self.switch.num_peers())
-            sent = recv = 0
-            for peer in self.switch.peers.values():
-                sent += peer.mconn.send_limiter.total
-                recv += peer.mconn.recv_limiter.total
-            self._m_p2p_sent.set(sent)
-            self._m_p2p_recv.set(recv)
 
     # ------------------------------------------------------------------
     @property

@@ -1,0 +1,135 @@
+"""AOT-exported kernel artifacts (jax.export serialization).
+
+The pooled TPU backend in this environment is flaky, so the first
+live window must pay as close to zero preparation as possible
+(VERDICT r2 #1).  The bucketed ed25519 kernels are exported
+ahead-of-time — traced and LOWERED for the TPU platform on any host,
+no TPU needed — and the serialized StableHLO artifacts are committed
+under ops/exported/.  On a live TPU the dispatch deserializes and
+calls them: zero tracing, stable programs keyed into the persistent
+compilation cache.
+
+Exporting also VALIDATES TPU lowerability today: generating these
+artifacts is what surfaced (and now guards against) Mosaic's
+unsupported scatter/dynamic_slice primitives in the Pallas kernel.
+
+Regenerate after kernel changes:  python -m cometbft_tpu.ops.aot
+"""
+from __future__ import annotations
+
+import functools
+import os
+import sys
+from typing import Optional
+
+ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "exported")
+
+def _xla_buckets() -> tuple:
+    """Mirror the dispatch's runtime buckets exactly — a mismatched
+    artifact is unreachable dead weight."""
+    from .ed25519_jax import _BUCKETS
+    return tuple(_BUCKETS)
+
+
+def _pallas_buckets() -> tuple:
+    from .ed25519_jax import _BUCKETS
+    from .ed25519_pallas import BLOCK
+    return tuple(max(b, BLOCK) for b in _BUCKETS)
+
+
+def _path(kernel: str, m: int) -> str:
+    return os.path.join(ARTIFACT_DIR, f"ed25519_{kernel}_{m}.jaxexport")
+
+
+@functools.lru_cache(maxsize=None)
+def load(kernel: str, m: int):
+    """Deserialized exported kernel for (kernel, lane count), or None
+    when no artifact exists (caller falls back to plain jit)."""
+    p = _path(kernel, m)
+    try:
+        with open(p, "rb") as f:
+            blob = f.read()
+    except OSError:
+        return None
+    try:
+        from jax import export
+        return export.deserialize(blob)
+    except Exception:
+        return None
+
+
+def call(kernel: str, a, r, s_win, k_win):
+    """Run the exported kernel on the current default platform, or
+    return None when no artifact matches.  For 'xla', a/r are [m,32]
+    uint8; for 'pallas', [32,m] int32 columns."""
+    m = a.shape[1] if kernel == "pallas" else a.shape[0]
+    exp = load(kernel, m)
+    if exp is None:
+        return None
+    import jax
+    platform = jax.default_backend()
+    if platform not in exp.platforms:
+        return None
+    try:
+        return exp.call(a, r, s_win, k_win)
+    except Exception:
+        return None
+
+
+def generate(xla_buckets=None, pallas_buckets=None,
+             out_dir: Optional[str] = None) -> list[str]:
+    """Export + serialize every bucketed kernel for the TPU (and, for
+    the portable xla kernel, CPU) platforms.  Runs on any host."""
+    import jax
+
+    # lowering happens per TARGET platform regardless of the local
+    # backend; pin CPU so generation never dials the pooled TPU (even
+    # probing jax.default_backend() would block on the axon claim)
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import export
+
+    from . import ed25519_jax as ej
+
+    if xla_buckets is None:
+        xla_buckets = _xla_buckets()
+    if pallas_buckets is None:
+        pallas_buckets = _pallas_buckets()
+    out_dir = out_dir or ARTIFACT_DIR
+    os.makedirs(out_dir, exist_ok=True)
+    written = []
+
+    for m in xla_buckets:
+        a = jnp.asarray(np.zeros((m, 32), np.uint8))
+        win = jnp.asarray(np.zeros((64, m), np.int32))
+        exp = export.export(jax.jit(ej._verify_kernel),
+                            platforms=["tpu", "cpu"])(a, a, win, win)
+        p = os.path.join(out_dir, f"ed25519_xla_{m}.jaxexport")
+        with open(p, "wb") as f:
+            f.write(exp.serialize())
+        written.append(p)
+        print(f"exported xla m={m}: {os.path.getsize(p)} bytes",
+              file=sys.stderr)
+
+    from . import ed25519_pallas as ep
+
+    for m in pallas_buckets:
+        cols = jnp.asarray(np.zeros((32, m), np.int32))
+        win = jnp.asarray(np.zeros((64, m), np.int32))
+        fn = jax.jit(functools.partial(ep.verify_cols,
+                                       interpret=False))
+        exp = export.export(fn, platforms=["tpu"])(cols, cols, win,
+                                                   win)
+        p = os.path.join(out_dir, f"ed25519_pallas_{m}.jaxexport")
+        with open(p, "wb") as f:
+            f.write(exp.serialize())
+        written.append(p)
+        print(f"exported pallas m={m}: {os.path.getsize(p)} bytes",
+              file=sys.stderr)
+    return written
+
+
+if __name__ == "__main__":
+    generate()

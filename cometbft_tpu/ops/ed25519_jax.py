@@ -378,6 +378,31 @@ def prep_arrays(items, m: int):
     return a_b, r_b, _windows_le(s_raw), _windows_le(k_raw), pre_bad
 
 
+def _try_aot(choice: str, interpret: bool, a_b, r_b, s_win, k_win):
+    """On a live TPU, prefer the committed AOT-exported artifact for
+    this kernel+bucket (zero tracing; stable cache key).  Returns the
+    ok array or None to fall through to plain jit.  Opt out with
+    COMETBFT_TPU_AOT=0."""
+    if interpret or os.environ.get("COMETBFT_TPU_AOT", "1") == "0":
+        return None
+    try:
+        if jax.default_backend() != "tpu":
+            return None
+    except Exception:
+        return None
+    from . import aot
+    if choice == "pallas":
+        out = aot.call(
+            "pallas",
+            jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),
+            jnp.asarray(np.ascontiguousarray(r_b.T).astype(np.int32)),
+            jnp.asarray(s_win), jnp.asarray(k_win))
+    else:
+        out = aot.call("xla", jnp.asarray(a_b), jnp.asarray(r_b),
+                       jnp.asarray(s_win), jnp.asarray(k_win))
+    return None if out is None else np.asarray(out)
+
+
 def _device_count() -> int:
     try:
         return len(jax.devices())
@@ -411,6 +436,9 @@ def _dispatch(n: int, a_b, r_b, s_win, k_win, pre_bad, *,
         ok = pmesh.verify_sharded(
             a_b, r_b, s_win, k_win, ndev=ndev, kernel=choice,
             interpret=interpret, block=block)
+    elif (aot_ok := _try_aot(choice, interpret, a_b, r_b, s_win,
+                             k_win)) is not None:
+        ok = aot_ok
     elif choice == "pallas":
         from . import ed25519_pallas as ep
         ok = np.asarray(ep.verify_cols(

@@ -193,7 +193,9 @@ def _decompress(b, d_col, sqrt_m1, four_p):
     """b: [32, B] int32 byte values -> (x, y, ok) limb-major."""
     sign = b[31:32] >> 7
     y = jnp.concatenate([b[:31], b[31:32] & 0x7F], axis=0)
-    one = jnp.zeros_like(y).at[0:1].set(1)
+    # concatenate, not .at[].set: scatter has no Mosaic TPU lowering
+    one = jnp.concatenate(
+        [jnp.ones_like(y[0:1]), jnp.zeros_like(y[1:])], axis=0)
     yy = _sqr(y)
     u = yy - one
     v = _mul(yy, d_col) + one
@@ -251,8 +253,9 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p)
     rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p)
-    one = jnp.zeros((LIMBS, B), jnp.int32).at[0:1].set(1)
     zero = jnp.zeros((LIMBS, B), jnp.int32)
+    one = jnp.concatenate(
+        [jnp.ones((1, B), jnp.int32), zero[1:]], axis=0)
 
     # -A in extended coords
     nax, nay = -ax, ay
@@ -275,9 +278,6 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
         return 0
 
     lax.fori_loop(1, 15, build_body, 0)
-
-    swin = swin_ref[:]
-    kwin = kwin_ref[:]
 
     def select_lane_table(w):
         """w: [1, B] 0..15 -> 4 coords [32, B] via masked sum."""
@@ -304,8 +304,10 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
         for _ in range(4):
             acc = _ext_double(acc)
         w = (_WINDOWS - 1) - j
-        sw = lax.dynamic_slice_in_dim(swin, w, 1, axis=0)
-        kw = lax.dynamic_slice_in_dim(kwin, w, 1, axis=0)
+        # dynamic REF reads (pl.ds) — dynamic_slice on values has no
+        # Mosaic TPU lowering
+        sw = swin_ref[pl.ds(w, 1)]
+        kw = kwin_ref[pl.ds(w, 1)]
         acc = _ext_add(acc, select_b_table(sw), two_d)
         acc = _ext_add(acc, select_lane_table(kw), two_d)
         return acc

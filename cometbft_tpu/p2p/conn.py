@@ -91,7 +91,14 @@ class MConnection:
                  on_error: Callable[[Exception], None],
                  logger: Optional[Logger] = None,
                  send_rate: float = 5_120_000,
-                 recv_rate: float = 5_120_000):
+                 recv_rate: float = 5_120_000,
+                 metrics=None, peer_id: str = ""):
+        if metrics is None:
+            from .metrics import Metrics
+            metrics = Metrics()
+        self.metrics = metrics
+        self.peer_id = peer_id or "unknown"
+        self._pending_bytes = 0
         self._sconn = sconn
         self._channels = {d.id: _Channel(d) for d in channels}
         self._on_receive = on_receive
@@ -136,6 +143,9 @@ class MConnection:
             ch.send_queue.put_nowait(msg)
         except asyncio.QueueFull:
             return False
+        self._pending_bytes += len(msg)
+        self.metrics.peer_pending_send_bytes.with_labels(
+            self.peer_id).set(self._pending_bytes)
         self._send_event.set()
         return True
 
@@ -144,6 +154,9 @@ class MConnection:
         if ch is None or self._closed:
             return False
         await ch.send_queue.put(msg)
+        self._pending_bytes += len(msg)
+        self.metrics.peer_pending_send_bytes.with_labels(
+            self.peer_id).set(self._pending_bytes)
         self._send_event.set()
         return True
 
@@ -171,8 +184,19 @@ class MConnection:
                 payload, eof = ch.next_packet()
                 pkt = bytes([_PKT_MSG, ch.desc.id,
                              1 if eof else 0]) + payload
+                _t0 = asyncio.get_running_loop().time()
                 await self.send_limiter.take(len(pkt))
+                _dt = asyncio.get_running_loop().time() - _t0
+                if _dt > 0:
+                    self.metrics.send_rate_limiter_delay.with_labels(
+                        self.peer_id).add(_dt)
                 await self._sconn.write_msg(pkt)
+                self.metrics.message_send_bytes_total.with_labels(
+                    f"{ch.desc.id:#x}").add(len(pkt))
+                self._pending_bytes = max(
+                    0, self._pending_bytes - len(payload))
+                self.metrics.peer_pending_send_bytes.with_labels(
+                    self.peer_id).set(self._pending_bytes)
                 # decay the ratio counters periodically
                 if ch.recently_sent > 1 << 20:
                     for c in self._channels.values():
@@ -186,8 +210,16 @@ class MConnection:
         try:
             while not self._closed:
                 msg = await self._sconn.read_msg()
+                _t0 = asyncio.get_running_loop().time()
                 await self.recv_limiter.take(len(msg))
+                _dt = asyncio.get_running_loop().time() - _t0
+                if _dt > 0:
+                    self.metrics.recv_rate_limiter_delay.with_labels(
+                        self.peer_id).add(_dt)
                 self._last_recv = asyncio.get_running_loop().time()
+                if len(msg) >= 2 and msg[0] == _PKT_MSG:
+                    self.metrics.message_receive_bytes_total \
+                        .with_labels(f"{msg[1]:#x}").add(len(msg))
                 if not msg:
                     raise MConnectionError("empty packet")
                 ptype = msg[0]

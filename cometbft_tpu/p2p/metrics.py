@@ -1,0 +1,34 @@
+"""P2P metrics (reference: p2p/metrics.go + metrics.gen.go — per-
+channel byte counters, peer gauge, flow-control delay)."""
+from __future__ import annotations
+
+from typing import Optional
+
+from ..libs import metrics as libmetrics
+
+
+class Metrics:
+    def __init__(self, registry: Optional[libmetrics.Registry] = None):
+        m = registry if registry is not None else libmetrics.Registry()
+        self.peers = m.gauge(
+            "p2p", "peers", "Number of peers.")
+        self.message_receive_bytes_total = m.counter(
+            "p2p", "message_receive_bytes_total",
+            "Number of bytes of each message type received.",
+            labels=("chID",))
+        self.message_send_bytes_total = m.counter(
+            "p2p", "message_send_bytes_total",
+            "Number of bytes of each message type sent.",
+            labels=("chID",))
+        self.peer_pending_send_bytes = m.gauge(
+            "p2p", "peer_pending_send_bytes",
+            "Pending bytes to be sent to a given peer.",
+            labels=("peer_id",))
+        self.recv_rate_limiter_delay = m.counter(
+            "p2p", "recv_rate_limiter_delay",
+            "Seconds spent sleeping in the receive rate limiter.",
+            labels=("peer_id",))
+        self.send_rate_limiter_delay = m.counter(
+            "p2p", "send_rate_limiter_delay",
+            "Seconds spent sleeping in the send rate limiter.",
+            labels=("peer_id",))

@@ -127,7 +127,8 @@ class Switch:
                  moniker: str = "anonymous",
                  logger: Optional[Logger] = None,
                  send_rate: float = 5_120_000,
-                 recv_rate: float = 5_120_000):
+                 recv_rate: float = 5_120_000,
+                 metrics=None):
         self.node_key = node_key
         self.network = network
         self.listen_addr = listen_addr
@@ -136,6 +137,10 @@ class Switch:
         self.recv_rate = recv_rate
         self.logger = logger if logger is not None else \
             new_logger("p2p")
+        if metrics is None:
+            from .metrics import Metrics
+            metrics = Metrics()
+        self.metrics = metrics
         self.reactors: dict[str, Reactor] = {}
         self._chan_to_reactor: dict[int, Reactor] = {}
         self._channel_descs: list[ChannelDescriptor] = []
@@ -247,10 +252,13 @@ class Switch:
 
         mconn = MConnection(sconn, self._channel_descs, on_receive,
                             on_error, send_rate=self.send_rate,
-                            recv_rate=self.recv_rate)
+                            recv_rate=self.recv_rate,
+                            metrics=self.metrics,
+                            peer_id=their_info.node_id)
         peer = Peer(their_info, mconn, outbound, remote_addr)
         peer_holder.append(peer)
         self.peers[peer.id] = peer
+        self.metrics.peers.set(len(self.peers))
         mconn.start()
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
@@ -262,6 +270,7 @@ class Switch:
         """Reference: Switch.StopPeerForError."""
         if self.peers.pop(peer.id, None) is None:
             return
+        self.metrics.peers.set(len(self.peers))
         peer.close()
         for reactor in self.reactors.values():
             await reactor.remove_peer(peer, reason)

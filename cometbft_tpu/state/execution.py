@@ -192,7 +192,10 @@ class BlockExecutor:
                  mempool=None, evpool=None,
                  event_bus: Optional[EventBus] = None,
                  block_store=None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 metrics=None):
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.store = state_store
         self.proxy_app = proxy_app   # ABCI consensus connection
         self.mempool = mempool if mempool is not None else _NopMempool()
@@ -359,6 +362,10 @@ class BlockExecutor:
         validator_updates = validate_validator_updates(
             abci_response.validator_updates,
             state.consensus_params.validator)
+        if validator_updates:
+            self.metrics.validator_set_updates.add()
+        if abci_response.consensus_param_updates is not None:
+            self.metrics.consensus_param_updates.add()
 
         state = update_state(state, block_id, block, abci_response,
                              validator_updates)
@@ -377,6 +384,9 @@ class BlockExecutor:
         # app-requested pruning: hand the retain height to the pruner
         # service (reference: execution.go pruneBlocks -> state/pruner.go)
         self.last_retain_height = retain_height
+        if retain_height > 0:
+            self.metrics.application_block_retain_height.set(
+                retain_height)
         if self.pruner is not None and retain_height > 0:
             self.pruner.set_application_retain_height(retain_height)
 

@@ -27,7 +27,10 @@ class Pruner:
                  interval_s: float = 10.0,
                  companion_enabled: bool = False,
                  logger: Optional[Logger] = None,
-                 tx_indexer=None, block_indexer=None):
+                 tx_indexer=None, block_indexer=None,
+                 metrics=None):
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.state_store = state_store
         self.block_store = block_store
         self._db = db                       # persistence for retain heights
@@ -59,11 +62,13 @@ class Pruner:
             return      # unchanged or backwards: skip the sync write —
                         # this runs on the per-block commit path
         self._set(_APP_RETAIN_KEY, height)
+        self.metrics.application_block_retain_height.set(height)
         self._wake.set()
 
     def set_companion_retain_height(self, height: int) -> None:
         """Reference: SetCompanionBlockRetainHeight (pruning RPC)."""
         self._set_companion_only(_COMPANION_RETAIN_KEY, height)
+        self.metrics.pruning_service_block_retain_height.set(height)
 
     def get_application_retain_height(self) -> int:
         return self._get(_APP_RETAIN_KEY)
@@ -153,6 +158,7 @@ class Pruner:
         if retain <= self.block_store.base or retain <= 0:
             return 0, self.block_store.base
         pruned, new_base = self.block_store.prune_blocks(retain)
+        self.metrics.block_store_base_height.set(new_base)
         if pruned:
             # state + ABCI results follow the block base
             self.state_store.prune_states(self.block_store.base - pruned,

@@ -45,11 +45,14 @@ MESSAGE = Msg(
 
 class StatesyncReactor(Reactor):
     def __init__(self, app_conns, syncer: Optional[Syncer] = None,
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None, metrics=None):
         """syncer present = we are state-syncing; absent = serve only."""
         super().__init__("STATESYNC")
         if logger is not None:
             self.logger = logger
+        from .metrics import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
+        self.metrics.syncing.set(1 if syncer is not None else 0)
         self.app_conns = app_conns
         self.syncer = syncer
         # chunk requests round-robin across peers that offered the

@@ -311,3 +311,22 @@ class TestMultiChipDispatch:
         golden.append(False)
         ok, mask = ej.verify_batch(items)
         assert mask == golden
+
+
+class TestAOTArtifacts:
+    def test_artifacts_cover_every_runtime_bucket(self):
+        """The committed jax.export artifacts must exist for the exact
+        runtime buckets and deserialize with TPU among their lowered
+        platforms — the zero-prep first-TPU-window guarantee
+        (VERDICT r2 #1; regenerate: python -m cometbft_tpu.ops.aot)."""
+        from cometbft_tpu.ops import aot
+
+        for m in aot._xla_buckets():
+            exp = aot.load("xla", m)
+            assert exp is not None, f"missing xla artifact m={m}"
+            assert "tpu" in exp.platforms
+            assert "cpu" in exp.platforms
+        for m in aot._pallas_buckets():
+            exp = aot.load("pallas", m)
+            assert exp is not None, f"missing pallas artifact m={m}"
+            assert exp.platforms == ("tpu",)
