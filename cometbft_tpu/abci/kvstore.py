@@ -58,6 +58,16 @@ def make_val_set_change_tx(pub_key_type: str, pub_key_bytes: bytes,
     return f"{VALIDATOR_PREFIX}{pub_key_type}!{pub}!{power}".encode()
 
 
+def _parse_val_value(raw: bytes) -> tuple[str, int]:
+    """Stored validator value 'type!power' (pre-mixed-key stores held
+    a bare power: treat those as ed25519)."""
+    s = raw.decode()
+    if "!" in s:
+        key_type, power_s = s.split("!", 1)
+        return key_type, int(power_s)
+    return "ed25519", int(s)
+
+
 def is_validator_tx(tx: bytes) -> bool:
     return tx.startswith(VALIDATOR_PREFIX.encode())
 
@@ -345,14 +355,15 @@ class KVStoreApplication(abci.Application):
         self._load_state()
         # rebuild the validator pubkey map from restored entries
         self._val_addr_to_pubkey.clear()
-        for key, raw_power in self.db.iterator():
+        for key, raw_val in self.db.iterator():
             if key.startswith(VALIDATOR_PREFIX.encode()):
                 pub_b64 = key[len(VALIDATOR_PREFIX):]
                 pub = base64.b64decode(pub_b64)
+                key_type, _ = _parse_val_value(raw_val)
                 from ..crypto import encoding as crypto_encoding
                 pk = crypto_encoding.pub_key_from_type_and_bytes(
-                    "ed25519", pub)
-                self._val_addr_to_pubkey[pk.address()] = ("ed25519",
+                    key_type, pub)
+                self._val_addr_to_pubkey[pk.address()] = (key_type,
                                                           pub)
 
     async def list_snapshots(self, req: abci.ListSnapshotsRequest
@@ -396,6 +407,10 @@ class KVStoreApplication(abci.Application):
         if req.path == "/val":
             value = self.db.get(
                 (VALIDATOR_PREFIX + req.data.decode()).encode()) or b""
+            if value:
+                # external contract stays the bare power (the key
+                # type tag is internal to the stored value)
+                value = str(_parse_val_value(value)[1]).encode()
             return abci.QueryResponse(key=req.data, value=value)
         value = self.db.get(_KV_PREFIX + req.data)
         return abci.QueryResponse(
@@ -417,7 +432,11 @@ class KVStoreApplication(abci.Application):
             self.db.delete(key)
             self._val_addr_to_pubkey.pop(addr, None)
         else:
-            self.db.set(key, str(v.power).encode())
+            # record the key TYPE with the power: snapshot restore
+            # must rebuild a mixed-key validator map (the b64 pubkey
+            # alone can't distinguish ed25519 from secp256k1)
+            self.db.set(key,
+                        f"{v.pub_key_type}!{v.power}".encode())
             self._val_addr_to_pubkey[addr] = (v.pub_key_type,
                                               v.pub_key_bytes)
 
@@ -429,6 +448,7 @@ class KVStoreApplication(abci.Application):
             raw = self.db.get(key)
             if raw:
                 out.append(abci.ValidatorUpdate(
-                    power=int(raw), pub_key_type=key_type,
+                    power=_parse_val_value(raw)[1],
+                    pub_key_type=key_type,
                     pub_key_bytes=pub))
         return out

@@ -273,7 +273,7 @@ class BlocksyncReactor(Reactor):
                 self.metrics.num_txs.set(len(first.data.txs))
                 self.metrics.total_txs.add(len(first.data.txs))
                 self.metrics.block_size_bytes.set(
-                    sum(len(tx) for tx in first.data.txs))
+                    first_parts.byte_size)
                 pool.pop_request()
         except asyncio.CancelledError:
             raise

@@ -140,15 +140,17 @@ class Metrics:
         self.rounds.set(round_)
 
     def record_commit(self, block, last_validators,
-                      current_validators) -> None:
+                      current_validators,
+                      block_size: int = 0) -> None:
         """Per-commit stats (reference: recordMetrics, state.go).
-        last_validators signed block.last_commit."""
+        last_validators signed block.last_commit; block_size is the
+        full wire size (part-set byte size)."""
         now = time.monotonic()
         self.height.set(block.header.height)
         self.latest_block_height.set(block.header.height)
         self.num_txs.set(len(block.data.txs))
         self.total_txs.add(len(block.data.txs))
-        size = sum(len(tx) for tx in block.data.txs)
+        size = block_size or sum(len(tx) for tx in block.data.txs)
         self.block_size_bytes.set(size)
         self.chain_size_bytes.add(size)
         if self._block_t:
@@ -174,8 +176,10 @@ class Metrics:
         byz = 0
         byz_power = 0
         for ev in block.evidence:   # gauges reset below when no evidence
-            addrs = getattr(ev, "byzantine_addresses", None)
-            if addrs is None:
+            byz_vals = getattr(ev, "byzantine_validators", None)
+            if byz_vals is not None:       # light-client attack
+                addrs = [v.address for v in byz_vals]
+            else:
                 va = getattr(ev, "vote_a", None)
                 addrs = [va.validator_address] if va is not None \
                     else []

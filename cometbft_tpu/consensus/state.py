@@ -901,7 +901,8 @@ class ConsensusState:
                        # (state.go:1911)
 
         self.metrics.record_commit(block, rs.last_validators,
-                                   rs.validators)
+                                   rs.validators,
+                                   block_size=block_parts.byte_size)
         state_copy = self.sm_state.copy()
         state_copy = await self.block_exec.apply_verified_block(
             state_copy,
@@ -1024,9 +1025,7 @@ class ConsensusState:
                     rs.proposal.timestamp) / 1e9
                 self.metrics.quorum_prevote_delay.with_labels(
                     proposer).set(delay_s)
-                if prevotes.bit_array().size() and \
-                        all(prevotes.bit_array().get_index(i)
-                            for i in range(rs.validators.size())):
+                if prevotes.has_all():
                     self.metrics.full_prevote_delay.with_labels(
                         proposer).set(delay_s)
             if ok and not block_id.is_nil():

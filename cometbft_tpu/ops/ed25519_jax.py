@@ -391,6 +391,10 @@ def _try_aot(choice: str, interpret: bool, a_b, r_b, s_win, k_win):
     except Exception:
         return None
     from . import aot
+    exp = aot.load(choice if choice == "pallas" else "xla",
+                   a_b.shape[0])
+    if exp is None or "tpu" not in exp.platforms:
+        return None     # before building any transposed copies
     if choice == "pallas":
         out = aot.call(
             "pallas",

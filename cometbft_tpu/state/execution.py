@@ -384,9 +384,6 @@ class BlockExecutor:
         # app-requested pruning: hand the retain height to the pruner
         # service (reference: execution.go pruneBlocks -> state/pruner.go)
         self.last_retain_height = retain_height
-        if retain_height > 0:
-            self.metrics.application_block_retain_height.set(
-                retain_height)
         if self.pruner is not None and retain_height > 0:
             self.pruner.set_application_retain_height(retain_height)
 

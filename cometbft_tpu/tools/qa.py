@@ -1,0 +1,381 @@
+"""QA scale run: a 15+ node, 100+ validator live net under staged
+load, with a kill/restart perturbation and a statesync late joiner.
+
+Reference: docs/references/qa/method.md + CometBFT-QA-v1.md (the
+200-node / 175-validator DigitalOcean saturation study, scaled to one
+host) and test/e2e/runner/benchmark.go (block-interval stats).  The
+run records a tx/s saturation table + latency quantiles per load
+window into QA_r{N}.json; docs/QA.md carries the narrative.
+
+Shape of the net (single host, in-process asyncio nodes):
+- 12 live validators (power 100 each) + 3 full nodes across three
+  latency zones (50/100/150 ms one-way links)
+- 90 "remote" validators in the genesis set with power 1 and mixed
+  key types (ed25519/secp256k1) that never come online: every commit
+  carries a 102-slot signature array, so commit verification runs at
+  the 100+ validator width the reference QA exercises, while quorum
+  rests with the live 12 (1200 of 1290 power)
+- one statesync late joiner that bootstraps from a snapshot mid-run
+
+Run:  python -m cometbft_tpu.tools.qa [--quick]
+"""
+from __future__ import annotations
+
+import asyncio
+import json
+import os
+import statistics
+import tempfile
+import time
+from dataclasses import dataclass, field
+from typing import Optional
+
+from ..config import Config
+from ..crypto import ed25519, secp256k1
+from ..libs.log import new_logger
+from ..p2p.key import NodeKey
+from ..privval import FilePV
+from ..types.genesis import GenesisDoc, GenesisValidator
+from ..types.timestamp import Timestamp
+
+logger = new_logger("qa")
+
+ZONES = ["zone-a", "zone-b", "zone-c"]
+ZONE_LATENCY_MS = {"zone-a:zone-b": 50, "zone-a:zone-c": 100,
+                   "zone-b:zone-c": 150}
+
+
+@dataclass
+class WindowResult:
+    rate: int
+    duration_s: float
+    sent: int = 0
+    accepted: int = 0
+    committed: int = 0
+    tx_per_s: float = 0.0
+    latency_p50_s: float = 0.0
+    latency_p90_s: float = 0.0
+    latency_max_s: float = 0.0
+
+
+@dataclass
+class QAReport:
+    nodes: int = 0
+    validators_total: int = 0
+    validators_live: int = 0
+    windows: list[WindowResult] = field(default_factory=list)
+    saturation_rate: int = 0
+    block_interval_avg_s: float = 0.0
+    block_interval_std_s: float = 0.0
+    block_interval_min_s: float = 0.0
+    block_interval_max_s: float = 0.0
+    final_height: int = 0
+    perturbation: str = ""
+    perturbed_recovered: bool = False
+    statesync_joiner_height: int = 0
+    mismatches: list[str] = field(default_factory=list)
+
+    def to_dict(self) -> dict:
+        import dataclasses
+        return dataclasses.asdict(self)
+
+
+def _mk_cfg(root: str, name: str, zone: str) -> Config:
+    import socket
+
+    def free_port() -> int:
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        p = s.getsockname()[1]
+        s.close()
+        return p
+
+    home = os.path.join(root, name)
+    cfg = Config()
+    cfg.base.home = home
+    cfg.base.moniker = name
+    cfg.base.db_backend = "memdb"
+    cfg.p2p.laddr = f"tcp://127.0.0.1:{free_port()}"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{free_port()}"
+    cfg.p2p.allow_duplicate_ip = True
+    cfg.p2p.pex = False          # fixed topology under latency relays
+    cfg.consensus.timeout_commit_ns = 200_000_000
+    cfg.mempool.size = 20_000
+    os.makedirs(os.path.join(home, "config"), exist_ok=True)
+    os.makedirs(os.path.join(home, "data"), exist_ok=True)
+    return cfg
+
+
+def _ghost_validators(n: int) -> list[GenesisValidator]:
+    """Validators in the set that never come online — mixed key types
+    so the commit verification path sees a heterogeneous 100+ slot
+    array (BASELINE config #5's shape)."""
+    out = []
+    for i in range(n):
+        if i % 2 == 0:
+            pub = ed25519.gen_priv_key().pub_key()
+        else:
+            pub = secp256k1.gen_priv_key().pub_key()
+        out.append(GenesisValidator(address=b"", pub_key=pub, power=1))
+    return out
+
+
+async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
+                 ghosts: int = 90,
+                 rates: tuple = (25, 50, 100, 200),
+                 window_s: float = 15.0) -> QAReport:
+    from ..abci.kvstore import KVStoreApplication
+    from ..db import new_db
+    from ..node.node import Node
+    from ..rpc.client import HTTPClient
+    from . import loadtime
+    from .manifest import Relay, RelaySpec, start_relay
+
+    report = QAReport()
+    names = [f"validator{i:02d}" for i in range(n_validators)] + \
+            [f"full{i:02d}" for i in range(n_full)]
+    zones = {name: ZONES[i % len(ZONES)]
+             for i, name in enumerate(names)}
+
+    cfgs = {name: _mk_cfg(outdir, name, zones[name])
+            for name in names}
+    joiner_cfg = _mk_cfg(outdir, "joiner", ZONES[0])
+
+    # genesis: live validators + ghost validators, mixed key types
+    pvs = {}
+    for name in names + ["joiner"]:
+        cfg = cfgs.get(name, joiner_cfg)
+        pvs[name] = FilePV.generate(
+            cfg.base.path(cfg.base.priv_validator_key_file),
+            cfg.base.path(cfg.base.priv_validator_state_file))
+        NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    vals = [GenesisValidator(address=b"",
+                             pub_key=pvs[n].get_pub_key(), power=100)
+            for n in names[:n_validators]]
+    vals += _ghost_validators(ghosts)
+    doc = GenesisDoc(chain_id="qa-net", genesis_time=Timestamp.now(),
+                     validators=vals)
+    doc.consensus_params.validator.pub_key_types = [
+        "ed25519", "secp256k1"]
+    doc.consensus_params.feature.pbts_enable_height = 1
+    report.validators_total = len(vals)
+    report.validators_live = n_validators
+    report.nodes = len(names) + 1
+
+    # topology: each node dials every "later" node, through a latency
+    # relay when the zones differ (manifest.py setup pattern)
+    node_ids = {}
+    for name in names + ["joiner"]:
+        cfg = cfgs.get(name, joiner_cfg)
+        doc.save_as(cfg.base.path(cfg.base.genesis_file))
+        node_ids[name] = NodeKey.load_or_gen(
+            cfg.base.path(cfg.base.node_key_file)).id
+    relay_specs: list[RelaySpec] = []
+
+    def link_port(a: str, b: str, target_port: int) -> int:
+        za, zb = zones.get(a, ZONES[0]), zones.get(b, ZONES[0])
+        key = f"{za}:{zb}" if f"{za}:{zb}" in ZONE_LATENCY_MS \
+            else f"{zb}:{za}"
+        ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
+        if ms == 0:
+            return target_port
+        import socket
+        s = socket.socket()
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+        s.close()
+        relay_specs.append(RelaySpec(
+            port=port, target_host="127.0.0.1",
+            target_port=target_port, delay_s=ms / 1000.0))
+        return port
+
+    p2p_port = {name: int(cfgs[name].p2p.laddr.rsplit(":", 1)[1])
+                for name in names}
+    for i, name in enumerate(names):
+        peers = []
+        for other in names[i + 1:]:
+            peers.append(f"{node_ids[other]}@127.0.0.1:"
+                         f"{link_port(name, other, p2p_port[other])}")
+        cfgs[name].p2p.persistent_peers = ",".join(peers)
+
+    nodes: dict[str, Node] = {}
+    relays: list[Relay] = []
+    joiner: Optional[Node] = None
+    try:
+        for spec in relay_specs:
+            relays.append(await start_relay(spec))
+        for name in names:
+            app = KVStoreApplication(
+                db=new_db("app", "memdb",
+                          cfgs[name].base.path("data")),
+                snapshot_interval=5)
+            nodes[name] = Node(cfgs[name], app=app)
+            await nodes[name].start()
+        logger.info("net booted", nodes=len(nodes),
+                    relays=len(relays))
+
+        endpoints = [f"http://{nodes[n]._rpc_server.listen_addr}"
+                     for n in names[:3]]
+        ref = nodes[names[0]]
+
+        async def wait_height(h: int, budget: float,
+                              who=None) -> None:
+            pool = who if who is not None else list(nodes.values())
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                if all(n.height >= h for n in pool):
+                    return
+                await asyncio.sleep(0.1)
+            raise TimeoutError(
+                f"net stuck: {[n.height for n in pool]} < {h}")
+
+        await wait_height(2, 120.0)
+
+        # --- load windows at increasing rates -----------------------
+        for wi, rate in enumerate(rates):
+            res = await loadtime.generate(
+                endpoints, rate=rate, connections=1,
+                duration_s=window_s, size=256, method="async")
+            # let the tail commit
+            h0 = ref.height
+            await wait_height(h0 + 2, 60.0, who=[ref])
+            rep = await loadtime.report(
+                endpoints[0], experiment_id=res.experiment_id)
+            w = WindowResult(
+                rate=rate, duration_s=window_s, sent=res.sent,
+                accepted=res.accepted, committed=rep.latency.count,
+                tx_per_s=rep.latency.count / window_s,
+                latency_p50_s=rep.latency.p50_s,
+                latency_p90_s=rep.latency.p90_s,
+                latency_max_s=rep.latency.max_s)
+            report.windows.append(w)
+            logger.info("load window done", rate=rate,
+                        committed=w.committed,
+                        tx_s=round(w.tx_per_s, 1),
+                        p50=round(w.latency_p50_s, 3))
+            # saturation: committed tx/s stops tracking the offered
+            # rate (< 80% of it) or stops growing
+            if w.tx_per_s >= 0.8 * rate:
+                report.saturation_rate = rate
+
+            if wi == 1:
+                # --- perturbation between windows: kill/restart one
+                # validator (reference: perturb.go)
+                victim = names[n_validators - 1]
+                report.perturbation = f"{victim}:kill-restart"
+                await nodes[victim].stop()
+                await asyncio.sleep(0.5)
+                app = KVStoreApplication(
+                    db=new_db("app", "memdb",
+                              cfgs[victim].base.path("data")),
+                    snapshot_interval=5)
+                nodes[victim] = Node(cfgs[victim], app=app)
+                await nodes[victim].start()
+                h = ref.height
+                await wait_height(h + 2, 120.0,
+                                  who=[nodes[victim]])
+                report.perturbed_recovered = True
+                logger.info("perturbed node recovered",
+                            victim=victim)
+
+        # --- statesync late joiner ----------------------------------
+        cli = HTTPClient(endpoints[0], timeout=30.0)
+        th = max(1, ref.height - 8)
+        blk = await cli.call("block", height=str(th))
+        joiner_cfg.statesync.enable = True
+        joiner_cfg.statesync.rpc_servers = [endpoints[0],
+                                            endpoints[1]]
+        joiner_cfg.statesync.trust_height = th
+        joiner_cfg.statesync.trust_hash = blk["block_id"]["hash"]
+        joiner_cfg.statesync.discovery_time_ns = int(2e9)
+        joiner_cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[n]}@127.0.0.1:{p2p_port[n]}"
+            for n in names[:4])
+        app = KVStoreApplication(
+            db=new_db("app", "memdb", joiner_cfg.base.path("data")),
+            snapshot_interval=5)
+        joiner = Node(joiner_cfg, app=app)
+        await joiner.start()
+        target = ref.height
+        await wait_height(target, 180.0, who=[joiner])
+        report.statesync_joiner_height = joiner.height
+        logger.info("statesync joiner caught up",
+                    height=joiner.height)
+
+        report.final_height = ref.height
+
+        # --- block interval stats (benchmark.go:15-24) --------------
+        times = []
+        for h in range(2, ref.height + 1):
+            meta = ref.block_store.load_block_meta(h)
+            if meta is not None:
+                times.append(meta.header.time.unix_ns() / 1e9)
+        intervals = [b - a for a, b in zip(times, times[1:])]
+        if intervals:
+            report.block_interval_avg_s = statistics.mean(intervals)
+            report.block_interval_std_s = (
+                statistics.pstdev(intervals)
+                if len(intervals) > 1 else 0.0)
+            report.block_interval_min_s = min(intervals)
+            report.block_interval_max_s = max(intervals)
+
+        # --- invariants ---------------------------------------------
+        for h in range(1, report.final_height + 1):
+            want = ref.block_store.load_block_meta(h)
+            if want is None:
+                continue
+            for name, n in list(nodes.items()) + [("joiner", joiner)]:
+                got = n.block_store.load_block_meta(h)
+                if got is None:
+                    continue
+                if got.block_id.hash != want.block_id.hash:
+                    report.mismatches.append(
+                        f"{name}@{h}: block hash mismatch")
+                if got.header.app_hash != want.header.app_hash:
+                    report.mismatches.append(
+                        f"{name}@{h}: app hash mismatch")
+    finally:
+        for n in list(nodes.values()) + ([joiner] if joiner else []):
+            try:
+                await n.stop()
+            except Exception:
+                pass
+        for r in relays:
+            r.close()
+        for r in relays:
+            await r.wait_closed()
+    return report
+
+
+def main(argv=None) -> int:
+    import argparse
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true",
+                    help="small shape for CI (6 nodes, 2 windows)")
+    ap.add_argument("--out", default="QA_r03.json")
+    args = ap.parse_args(argv)
+    with tempfile.TemporaryDirectory() as d:
+        if args.quick:
+            rep = asyncio.run(run_qa(
+                d, n_validators=4, n_full=1, ghosts=20,
+                rates=(25, 50), window_s=8.0))
+        else:
+            rep = asyncio.run(run_qa(d))
+    out = rep.to_dict()
+    with open(args.out, "w") as f:
+        json.dump(out, f, indent=2)
+        f.write("\n")
+    print(json.dumps({
+        "nodes": rep.nodes, "validators": rep.validators_total,
+        "saturation_rate": rep.saturation_rate,
+        "windows": [[w.rate, round(w.tx_per_s, 1),
+                     round(w.latency_p50_s, 3)]
+                    for w in rep.windows],
+        "block_interval_avg_s": round(rep.block_interval_avg_s, 3),
+        "mismatches": len(rep.mismatches),
+    }))
+    return 0 if not rep.mismatches else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
