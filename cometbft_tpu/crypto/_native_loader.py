@@ -39,12 +39,36 @@ def _sources() -> list[str]:
             os.path.join(d, "bls12381.hpp")]
 
 
+def _host_tag() -> str:
+    """Fingerprint of this machine's CPU features.  The module is
+    built with -march=native, so a cached .so copied to a different
+    CPU (container image, rsync'd tree) must be treated as STALE and
+    rebuilt — importing it could SIGILL, which no except clause can
+    catch."""
+    import hashlib
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return hashlib.sha256(
+                        line.encode()).hexdigest()[:16]
+    except OSError:
+        pass
+    import platform
+    return hashlib.sha256(
+        platform.processor().encode()).hexdigest()[:16]
+
+
 def _target_fresh() -> bool:
-    """True when the built module exists and is newer than EVERY
-    native source file (missing sources count as stale, not error)."""
+    """True when the built module exists, is newer than EVERY native
+    source file (missing sources count as stale, not error), and was
+    built on a machine with this CPU's feature set."""
     try:
         t = os.path.getmtime(_target_path())
-        return all(t >= os.path.getmtime(s) for s in _sources())
+        if not all(t >= os.path.getmtime(s) for s in _sources()):
+            return False
+        with open(_target_path() + ".host") as f:
+            return f.read().strip() == _host_tag()
     except OSError:
         return False
 
@@ -62,18 +86,25 @@ def _build() -> Optional[str]:
             return target
         include = sysconfig.get_paths()["include"]
         tmp = target + f".build-{os.getpid()}"
-        cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-               f"-I{include}", f"-I{_source_dir()}", src, "-o", tmp]
-        try:
-            subprocess.run(cmd, check=True, capture_output=True,
-                           timeout=120)
-            os.replace(tmp, target)
-        except (OSError, subprocess.SubprocessError):
+        base = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                f"-I{include}", f"-I{_source_dir()}", src, "-o", tmp]
+        # -march=native is safe here (the module is always built on
+        # the machine that runs it) and buys ~15% on the Montgomery
+        # bigint paths; retry portable if the flag is rejected
+        for cmd in (base[:1] + ["-march=native"] + base[1:], base):
             try:
-                os.unlink(tmp)
-            except OSError:
-                pass
-            return None
+                subprocess.run(cmd, check=True, capture_output=True,
+                               timeout=120)
+                os.replace(tmp, target)
+                with open(target + ".host", "w") as f:
+                    f.write(_host_tag())
+                return target
+            except (OSError, subprocess.SubprocessError):
+                try:
+                    os.unlink(tmp)
+                except OSError:
+                    pass
+        return None
     return target
 
 

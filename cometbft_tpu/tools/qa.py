@@ -352,8 +352,11 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small shape for CI (6 nodes, 2 windows)")
-    ap.add_argument("--out", default="QA_r03.json")
+    ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
+    # --quick must never clobber the committed full-scale record
+    out_path = args.out or (
+        "QA_quick.json" if args.quick else "QA_r03.json")
     with tempfile.TemporaryDirectory() as d:
         if args.quick:
             rep = asyncio.run(run_qa(
@@ -362,7 +365,7 @@ def main(argv=None) -> int:
         else:
             rep = asyncio.run(run_qa(d))
     out = rep.to_dict()
-    with open(args.out, "w") as f:
+    with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     print(json.dumps({

@@ -587,7 +587,7 @@ PyObject* bls_pairings_product_is_one(PyObject*, PyObject* arg) {
 PyObject* bls_selftest(PyObject*, PyObject*) {
     bool ok;
     Py_BEGIN_ALLOW_THREADS
-    ok = bls::selftest();
+    ok = bls::selftest() && bls::selftest_psi();
     Py_END_ALLOW_THREADS
     return PyBool_FromLong(ok);
 }
