@@ -1,14 +1,21 @@
 """SQL event sink.
 
 Reference: state/indexer/sink/psql (psql.go + schema.sql) — an
-operator-queryable relational mirror of block/tx events.  The
-reference targets PostgreSQL; this build uses the embedded SQLite
-engine with the SAME relational schema (blocks, tx_results, events,
-attributes + the joined views), so operator SQL written for the
-reference's views runs unchanged.  Like the reference sink, it is
-write-only from the node's perspective: tx_search/block_search RPCs
-are NOT served from this sink (psql.go returns "not supported" for
-reads) — operators query the database directly.
+operator-queryable relational mirror of block/tx events.  The sink
+speaks BOTH targets with the same relational schema (blocks,
+tx_results, events, attributes + the joined views):
+
+- `tx_index.psql_conn = <path|:memory:>` — the embedded SQLite
+  engine (no external database needed);
+- `tx_index.psql_conn = postgres://user:pw@host/db` — a real
+  PostgreSQL server via psycopg2 (gated: a clear error is raised
+  when the driver isn't installed; this image ships without it).
+
+Operator SQL written for the reference's views runs unchanged.  Like
+the reference sink, it is write-only from the node's perspective:
+tx_search/block_search RPCs are NOT served from this sink (psql.go
+returns "not supported" for reads) — operators query the database
+directly.
 """
 from __future__ import annotations
 
@@ -71,26 +78,98 @@ CREATE VIEW IF NOT EXISTS tx_events AS
 """
 
 
+def _psql_schema() -> str:
+    """The same schema in PostgreSQL dialect (reference: schema.sql —
+    BIGSERIAL keys, BYTEA blobs; rowid is an explicit column in both
+    dialects, so every query below runs unchanged)."""
+    s = _SCHEMA.replace("INTEGER PRIMARY KEY AUTOINCREMENT",
+                        "BIGSERIAL PRIMARY KEY")
+    s = s.replace("BLOB", "BYTEA")
+    return s.replace("CREATE VIEW IF NOT EXISTS",
+                     "CREATE OR REPLACE VIEW")
+
+
+class _Cursor:
+    """Driver-adapting cursor: rewrites the module's ?-placeholder
+    SQL to the target's paramstyle at the single choke point."""
+
+    def __init__(self, cur, ph: str):
+        self._cur = cur
+        self._ph = ph
+
+    def execute(self, sql, params=()):
+        if self._ph != "?":
+            sql = sql.replace("?", self._ph)
+        return self._cur.execute(sql, params)
+
+    def insert_returning(self, sql, params=()):
+        """INSERT and return the new rowid.  psycopg2's lastrowid is
+        the table OID (0 for ordinary tables), so the %s dialect uses
+        INSERT ... RETURNING rowid instead."""
+        if self._ph == "?":
+            self._cur.execute(sql, params)
+            return self._cur.lastrowid
+        self._cur.execute(
+            sql.replace("?", self._ph) + " RETURNING rowid", params)
+        return self._cur.fetchone()[0]
+
+    def __getattr__(self, name):
+        return getattr(self._cur, name)
+
+
 class SQLEventSink:
     """Write-side event sink with the reference's psql schema."""
 
     def __init__(self, conn_str: str, chain_id: str):
-        # conn_str is a filesystem path (or :memory:) — the embedded
-        # engine's analog of the reference's postgres conn string
-        self._conn = sqlite3.connect(conn_str, check_same_thread=False)
-        self._conn.executescript(_SCHEMA)
+        # conn_str: a PostgreSQL DSN (postgres://...) or a filesystem
+        # path / :memory: for the embedded engine
+        if conn_str.startswith(("postgres://", "postgresql://")):
+            try:
+                import psycopg2
+            except ImportError:
+                raise RuntimeError(
+                    "tx_index.psql_conn is a PostgreSQL DSN but "
+                    "psycopg2 is not installed — install it or "
+                    "point psql_conn at an embedded database path")
+            self._conn = psycopg2.connect(conn_str)
+            self._ph = "%s"
+            cur = self._conn.cursor()
+            for stmt in _psql_schema().split(";"):
+                if stmt.strip():
+                    cur.execute(stmt)
+        else:
+            self._conn = sqlite3.connect(conn_str,
+                                         check_same_thread=False)
+            self._ph = "?"
+            self._conn.executescript(_SCHEMA)
         self._conn.commit()
         self.chain_id = chain_id
+
+    def _cursor(self) -> _Cursor:
+        return _Cursor(self._conn.cursor(), self._ph)
 
     def close(self) -> None:
         self._conn.close()
 
     # -- write side --------------------------------------------------------
+    def _rollback(self) -> None:
+        try:
+            self._conn.rollback()
+        except Exception:
+            pass
+
     def index_block_events(self, height: int, events: list) -> None:
         """Reference: psql.go IndexBlockEvents — insert the block row
         plus its begin/end-block-style events."""
+        try:
+            self._index_block_events(height, events)
+        except Exception:
+            self._rollback()
+            raise
+
+    def _index_block_events(self, height: int, events: list) -> None:
         now = datetime.now(timezone.utc).isoformat()
-        cur = self._conn.cursor()
+        cur = self._cursor()
         cur.execute(
             "INSERT INTO blocks (height, chain_id, created_at) "
             "VALUES (?, ?, ?) "
@@ -111,23 +190,29 @@ class SQLEventSink:
         self._conn.commit()
 
     def index_tx_events(self, tx_results: list) -> None:
+        try:
+            self._index_tx_events(tx_results)
+        except Exception:
+            self._rollback()
+            raise
+
+    def _index_tx_events(self, tx_results: list) -> None:
         """Reference: psql.go IndexTxEvents — insert tx_results rows
         and their events (the TxResult proto bytes are stored for
         round-tripping)."""
         from ..types.tx import tx_hash
         now = datetime.now(timezone.utc).isoformat()
-        cur = self._conn.cursor()
+        cur = self._cursor()
         for txr in tx_results:
             cur.execute(
                 "SELECT rowid FROM blocks WHERE height = ? AND "
                 "chain_id = ?", (txr.height, self.chain_id))
             row = cur.fetchone()
             if row is None:
-                cur.execute(
+                block_rowid = cur.insert_returning(
                     "INSERT INTO blocks (height, chain_id, created_at)"
                     " VALUES (?, ?, ?)",
                     (txr.height, self.chain_id, now))
-                block_rowid = cur.lastrowid
             else:
                 block_rowid = row[0]
             raw = encode(abci_pb.TX_RESULT, {
@@ -186,17 +271,18 @@ class SQLEventSink:
         for ev in events:
             if not ev.type:
                 continue
-            cur.execute(
+            event_id = cur.insert_returning(
                 "INSERT INTO events (block_id, tx_id, type) "
                 "VALUES (?, ?, ?)", (block_id, tx_id, ev.type))
-            event_id = cur.lastrowid
             for attr in ev.attributes or []:
                 if not attr.key:
                     continue
                 cur.execute(
-                    "INSERT OR REPLACE INTO attributes "
+                    "INSERT INTO attributes "
                     "(event_id, key, composite_key, value) "
-                    "VALUES (?, ?, ?, ?)",
+                    "VALUES (?, ?, ?, ?) "
+                    "ON CONFLICT (event_id, key) DO UPDATE SET "
+                    "value = excluded.value",
                     (event_id, attr.key, f"{ev.type}.{attr.key}",
                      attr.value))
 
@@ -226,7 +312,7 @@ class _SinkTxAdapter:
             "database directly (reference: psql sink)")
 
     def prune(self, from_height: int, to_height: int) -> int:
-        cur = self._sink._conn.cursor()
+        cur = self._sink._cursor()
         cur.execute(
             "DELETE FROM attributes WHERE event_id IN "
             "(SELECT events.rowid FROM events JOIN blocks "
@@ -261,7 +347,7 @@ class _SinkBlockAdapter:
             "database directly (reference: psql sink)")
 
     def prune(self, from_height: int, to_height: int) -> int:
-        cur = self._sink._conn.cursor()
+        cur = self._sink._cursor()
         cur.execute(
             "DELETE FROM attributes WHERE event_id IN "
             "(SELECT events.rowid FROM events JOIN blocks "

@@ -402,6 +402,14 @@ class Node:
             self._rpc_server = RPCServer(self, cfg.rpc)
             await self._rpc_server.start()
 
+        # live profiling endpoint (reference: node.go pprofSrv, gated
+        # by instrumentation.pprof_laddr)
+        if cfg.instrumentation.pprof_listen_addr:
+            from ..libs.pprof import PprofServer
+            self._pprof_server = PprofServer(
+                cfg.instrumentation.pprof_listen_addr)
+            await self._pprof_server.start()
+
         # gRPC data-companion services (reference: node.go grpcSrv +
         # grpcPrivSrv, config.go GRPCConfig)
         if cfg.grpc.laddr:
@@ -473,6 +481,8 @@ class Node:
         await self.switch.stop()
         if self._rpc_server is not None:
             await self._rpc_server.stop()
+        if getattr(self, "_pprof_server", None) is not None:
+            await self._pprof_server.stop()
         if getattr(self, "_grpc_server", None) is not None:
             await self._grpc_server.stop()
         if getattr(self, "_grpc_priv_server", None) is not None:

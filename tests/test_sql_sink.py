@@ -150,3 +150,36 @@ class TestSinkReindex:
             "SELECT COUNT(*) FROM events WHERE tx_id IS NOT NULL")
         assert cur.fetchone()[0] == 3     # 2 implicit + t
         sink.close()
+
+
+class TestPsqlDSN:
+    def test_dsn_without_driver_raises_clear_error(self):
+        """A postgres:// DSN on a host without psycopg2 must fail
+        loudly with guidance, not fall back to a sqlite file named
+        'postgres://...' (reference: the sink targets a real psql)."""
+        import pytest
+
+        from cometbft_tpu.indexer.sink_sql import SQLEventSink
+        with pytest.raises(RuntimeError, match="psycopg2"):
+            SQLEventSink("postgres://u:p@localhost/db", "c")
+
+    def test_psql_schema_dialect(self):
+        from cometbft_tpu.indexer.sink_sql import _psql_schema
+        s = _psql_schema()
+        assert "BIGSERIAL PRIMARY KEY" in s
+        assert "BYTEA" in s
+        assert "AUTOINCREMENT" not in s and "BLOB" not in s
+        assert "CREATE OR REPLACE VIEW" in s
+
+    def test_cursor_paramstyle_rewrite(self):
+        from cometbft_tpu.indexer.sink_sql import _Cursor
+
+        captured = {}
+
+        class FakeCur:
+            def execute(self, sql, params=()):
+                captured["sql"] = sql
+        _Cursor(FakeCur(), "%s").execute(
+            "SELECT rowid FROM blocks WHERE height = ?", (1,))
+        assert captured["sql"] == \
+            "SELECT rowid FROM blocks WHERE height = %s"
