@@ -13,16 +13,20 @@ Structure follows the standard construction:
   E' : y^2 = x^3 + 4(u+1)  over Fq2  (G2, D-twist; untwist via w^2, w^3)
 
 Pairing: optimal-ate Miller loop in affine coordinates over E(Fq12) with a
-naive final exponentiation f^((p^12-1)/r) — correct and adequate for the
-host-side single-verify path (this key type never batches; reference
-crypto/batch/batch.go is ed25519-only).
+naive final exponentiation f^((p^12-1)/r) — this module is the GOLDEN
+MODEL: simple, auditable formulas that the optimized C++ port
+(native/bls12381.hpp: projective Fq2 Miller loop, cyclotomic squaring,
+psi-endomorphism subgroup/cofactor fast paths) is differentially tested
+against.  This key type never batches (reference crypto/batch/batch.go
+is ed25519-only), so the host-side single-verify path is the workload.
 
-Hash-to-curve NOTE: message expansion is RFC-9380 expand_message_xmd
-(SHA-256) with the ciphersuite DST, but the map-to-curve step uses a
-deterministic try-and-increment search instead of the SSWU 3-isogeny map
-(whose 16 isogeny constants are not derivable offline). Signatures are
-therefore self-consistent and domain-separated but NOT byte-compatible
-with blst's. The API surface and all group/serialization rules match.
+Hash-to-curve implements the full RFC-9380
+BLS12381G2_XMD:SHA-256_SSWU_RO_ ciphersuite: expand_message_xmd,
+simplified SWU onto the 3-isogenous curve, and the degree-3 isogeny to
+E — with the isogeny DERIVED OFFLINE from the curve parameters via
+Vélu's formulas rather than copied constant tables (see the SSWU
+section below and its re-derivation test).  Signatures are
+byte-compatible with blst-class stacks.
 """
 from __future__ import annotations
 
