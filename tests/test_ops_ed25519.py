@@ -330,3 +330,20 @@ class TestAOTArtifacts:
             exp = aot.load("pallas", m)
             assert exp is not None, f"missing pallas artifact m={m}"
             assert exp.platforms == ("tpu",)
+
+
+class TestPallasMultiBlock:
+    def test_grid_of_two_blocks(self):
+        """A batch spanning two grid steps (n=16, block=8) must
+        produce the same per-lane verdicts — exercises the BlockSpec
+        index maps and the per-block VMEM scratch reset, which a
+        single-block run never touches."""
+        items, golden = [], []
+        for i in range(16):
+            pub, msg, sig = _sig()
+            if i in (3, 11):
+                sig = sig[:32] + bytes(32)            # S = 0
+            items.append((pub, msg, sig))
+            golden.append(ref.verify(pub, msg, sig))
+        assert _pallas_verify_items(items, block=8) == golden
+        assert golden[3] is False and golden[11] is False
