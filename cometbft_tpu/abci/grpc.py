@@ -162,8 +162,19 @@ class GRPCClient:
         self._channel: Optional[grpc.aio.Channel] = None
         self._calls: dict = {}
 
-    async def connect(self, retries: int = 80,
-                      delay_s: float = 0.05) -> None:
+    async def connect(self, timeout_s: Optional[float] = None) -> None:
+        """Dial and block until the channel is READY.
+
+        The reference dials with grpc.WaitForReady(true)
+        (abci/client/grpc_client.go:109) — no fixed retry budget; the
+        channel's own reconnect logic absorbs slow server startup
+        (e.g. a subprocess still importing).  channel_ready() is the
+        grpc.aio analog; the deadline only bounds pathological cases.
+        """
+        if timeout_s is None:
+            import os
+            timeout_s = float(os.environ.get(
+                "COMETBFT_ABCI_GRPC_CONNECT_TIMEOUT", "60"))
         if self._channel is not None:
             await self.close()
         self._channel = grpc.aio.insecure_channel(
@@ -180,17 +191,14 @@ class GRPCClient:
             for method, (key, req_desc, resp_desc)
             in _METHODS.items()
         }
-        # wait for the server (reference: dialerFunc retry loop)
         import asyncio
-        for i in range(retries):
-            try:
-                await self.echo("ping")
-                return
-            except grpc.aio.AioRpcError:
-                if i == retries - 1:
-                    await self.close()
-                    raise
-                await asyncio.sleep(delay_s)
+        try:
+            await asyncio.wait_for(self._channel.channel_ready(),
+                                   timeout=timeout_s)
+            await self.echo("ping")
+        except (asyncio.TimeoutError, grpc.aio.AioRpcError):
+            await self.close()
+            raise
 
     async def close(self) -> None:
         if self._channel is not None:

@@ -72,7 +72,8 @@ class SocketServer:
             t.cancel()
 
     async def serve_forever(self) -> None:
-        await self.start()
+        if self._server is None:
+            await self.start()
         assert self._server is not None
         async with self._server:
             await self._server.serve_forever()
@@ -169,8 +170,17 @@ def main(argv=None) -> int:
         srv = GRPCServer(args.address, app)
     else:
         srv = SocketServer(args.address, app)
+
+    async def _serve():
+        await srv.start()
+        # machine-readable ready line: parents wait for this instead of
+        # guessing at import/startup time (reference: e2e runner greps
+        # the node's listen log line before dialing)
+        print(f"abci-server listening {args.address}", flush=True)
+        await srv.serve_forever()
+
     try:
-        asyncio.run(srv.serve_forever())
+        asyncio.run(_serve())
     except KeyboardInterrupt:
         pass
     return 0

@@ -506,6 +506,7 @@ def final_exponentiation(f):
 def pairings_product_is_one(pairs) -> bool:
     """prod e(P_i, Q_i) == 1, with P_i in G1 (affine Fq), Q_i in G2 (affine
     Fq2). One shared final exponentiation."""
+    pairs = list(pairs)  # generators must survive the native-path attempt
     native = _native()
     if native is not None:
         try:

@@ -105,7 +105,6 @@ def _build() -> Optional[str]:
                 except OSError:
                     pass
         return None
-    return target
 
 
 def load(allow_build: bool = True):

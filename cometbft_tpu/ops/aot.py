@@ -42,6 +42,34 @@ def _path(kernel: str, m: int) -> str:
     return os.path.join(ARTIFACT_DIR, f"ed25519_{kernel}_{m}.jaxexport")
 
 
+def _host_tag() -> str:
+    """CPU feature fingerprint, same idea as crypto/_native_loader.py:
+    flags that change XLA:CPU codegen (avx512, amx, …)."""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith("flags"):
+                    return " ".join(sorted(line.split(":", 1)[1].split()))
+    except OSError:
+        pass
+    import platform
+    return platform.machine()
+
+
+@functools.lru_cache(maxsize=None)
+def _host_tag_matches() -> bool:
+    """True when the committed artifacts were generated on a host with
+    this machine's CPU feature set.  CPU-platform executables
+    deserialized across feature boundaries can SIGILL (XLA:CPU AOT
+    feature-mismatch warnings in the r3 dryrun log); TPU programs are
+    host-independent and never need this gate."""
+    try:
+        with open(os.path.join(ARTIFACT_DIR, "HOST")) as f:
+            return f.read().strip() == _host_tag()
+    except OSError:
+        return False
+
+
 @functools.lru_cache(maxsize=None)
 def load(kernel: str, m: int):
     """Deserialized exported kernel for (kernel, lane count), or None
@@ -70,6 +98,8 @@ def call(kernel: str, a, r, s_win, k_win):
     import jax
     platform = jax.default_backend()
     if platform not in exp.platforms:
+        return None
+    if platform == "cpu" and not _host_tag_matches():
         return None
     try:
         return exp.call(a, r, s_win, k_win)
@@ -128,6 +158,8 @@ def generate(xla_buckets=None, pallas_buckets=None,
         written.append(p)
         print(f"exported pallas m={m}: {os.path.getsize(p)} bytes",
               file=sys.stderr)
+    with open(os.path.join(out_dir, "HOST"), "w") as f:
+        f.write(_host_tag())
     return written
 
 

@@ -81,10 +81,18 @@ class TestNodeWithGRPCApp:
                     [sys.executable, "-m", "cometbft_tpu.abci.server",
                      "--address", f"127.0.0.1:{port}",
                      "--app", "kvstore", "--transport", "grpc"],
-                    stdout=subprocess.DEVNULL,
+                    stdout=subprocess.PIPE,
                     stderr=subprocess.DEVNULL,
                     env={**os.environ, "JAX_PLATFORMS": ""})
                 try:
+                    # wait for the child's ready line before booting the
+                    # node — on a loaded 1-vCPU box the import alone can
+                    # take seconds (reference: WaitForReady dial)
+                    line = await asyncio.wait_for(
+                        asyncio.get_event_loop().run_in_executor(
+                            None, proc.stdout.readline), timeout=60)
+                    assert b"listening" in line, (
+                        f"abci server never became ready: {line!r}")
                     home = os.path.join(d, "node")
                     cfg = Config()
                     cfg.base.home = home
