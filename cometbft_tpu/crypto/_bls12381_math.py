@@ -590,6 +590,10 @@ def g1_uncompress(data: bytes):
     """Compressed 48 bytes -> point (raises ValueError)."""
     if len(data) != 48:
         raise ValueError("bad G1 compressed length")
+    native = _native()
+    if native is not None and hasattr(native, "bls_g1_uncompress"):
+        raw = native.bls_g1_uncompress(data)   # ValueError propagates
+        return None if raw is None else _g1_unraw(raw)
     flags = data[0]
     if not flags & 0x80:
         raise ValueError("uncompressed flag in compressed G1")
@@ -645,6 +649,10 @@ def g2_compress(pt) -> bytes:
 def g2_uncompress(data: bytes):
     if len(data) != 96:
         raise ValueError("bad G2 compressed length")
+    native = _native()
+    if native is not None and hasattr(native, "bls_g2_uncompress"):
+        raw = native.bls_g2_uncompress(data)   # ValueError propagates
+        return None if raw is None else _g2_unraw(raw)
     flags = data[0]
     if not flags & 0x80:
         raise ValueError("uncompressed flag in compressed G2")
@@ -791,7 +799,15 @@ def _iso3_g2(pt):
     yn = f2_mul(yp, f2_sub(
         (1, 0), f2_add(f2_mul(_iso_t, inv_d2),
                        f2_mul(f2_muls(_iso_u, 2), inv_d3))))
-    return (f2_mul(xn, _INV9), f2_mul(yn, _INV27))
+    # The isomorphism from y^2 = x^3 + 2916(1+i) down to E is
+    # (x, y) -> (x/z^2, y/z^3) for z = ±3; both are valid and differ
+    # only in the sign of y (equivalently: ±phi share kernel and
+    # x-map, so the k_(1,3) check cannot distinguish them).  RFC
+    # 9380's iso_map is the z = -3 branch — pinned by the appendix
+    # J.10.1 expected-output vectors in tests/test_crypto.py, which
+    # a flipped sign fails (output would be -P for every message,
+    # breaking cross-stack verify while passing every property test).
+    return (f2_mul(xn, _INV9), f2_neg(f2_mul(yn, _INV27)))
 
 
 def _map_to_curve_g2(u):

@@ -634,6 +634,48 @@ PyObject* bls_hash_to_g2(PyObject*, PyObject* args) {
     return g2_bytes(r);
 }
 
+PyObject* bls_g1_uncompress(PyObject*, PyObject* arg) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(arg, &buf, &len) < 0) return nullptr;
+    if (len != 48) {
+        PyErr_SetString(PyExc_ValueError, "bad G1 compressed length");
+        return nullptr;
+    }
+    bls::G1 p;
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = bls::g1_uncompress(reinterpret_cast<uint8_t*>(buf), &p);
+    Py_END_ALLOW_THREADS
+    if (rc < 0) {
+        PyErr_SetString(PyExc_ValueError, "invalid compressed G1");
+        return nullptr;
+    }
+    if (rc == 1) Py_RETURN_NONE;
+    return g1_bytes(p);
+}
+
+PyObject* bls_g2_uncompress(PyObject*, PyObject* arg) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(arg, &buf, &len) < 0) return nullptr;
+    if (len != 96) {
+        PyErr_SetString(PyExc_ValueError, "bad G2 compressed length");
+        return nullptr;
+    }
+    bls::G2 p;
+    int rc;
+    Py_BEGIN_ALLOW_THREADS
+    rc = bls::g2_uncompress(reinterpret_cast<uint8_t*>(buf), &p);
+    Py_END_ALLOW_THREADS
+    if (rc < 0) {
+        PyErr_SetString(PyExc_ValueError, "invalid compressed G2");
+        return nullptr;
+    }
+    if (rc == 1) Py_RETURN_NONE;
+    return g2_bytes(p);
+}
+
 PyObject* bls_g1_mul(PyObject*, PyObject* args) {
     PyObject* pt_obj;
     const char* k;
@@ -701,6 +743,10 @@ PyMethodDef kMethods[] = {
      "curve + r-order check for a raw affine G2 point"},
     {"bls_hash_to_g2", bls_hash_to_g2, METH_VARARGS,
      "hash_to_g2(msg, dst) -> raw affine G2"},
+    {"bls_g1_uncompress", bls_g1_uncompress, METH_O,
+     "ZCash-flag compressed 48B -> raw affine G1 | None (infinity)"},
+    {"bls_g2_uncompress", bls_g2_uncompress, METH_O,
+     "ZCash-flag compressed 96B -> raw affine G2 | None (infinity)"},
     {"bls_g1_mul", bls_g1_mul, METH_VARARGS,
      "scalar multiple of a raw affine G1 point (k big-endian)"},
     {"bls_g2_mul", bls_g2_mul, METH_VARARGS,

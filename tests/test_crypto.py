@@ -257,3 +257,159 @@ class TestSSWUDerivation:
             h = M.hash_to_g2(msg, dst)
             assert M.g2_in_subgroup(h)
             assert h == M.hash_to_g2(msg, dst)
+
+
+class TestRFC9380Vectors:
+    """Known-answer vectors from RFC 9380 appendices — the interop
+    pin for the hash-to-curve pipeline (reference: blst's HashToG2
+    behind crypto/bls12381/key_bls12381.go).  Property tests cannot
+    catch a globally inverted y sign (negation commutes with point
+    addition and cofactor clearing, so -P passes on-curve/subgroup/
+    x-coordinate checks for every message); these vectors do.
+    """
+
+    # RFC 9380 K.1: expand_message_xmd(SHA-256), len_in_bytes=0x20
+    K1_DST = b"QUUX-V01-CS02-with-expander-SHA256-128"
+    K1 = [
+        (b"", "68a985b87eb6b46952128911f2a4412bbc302a9d759667f87f"
+              "7a21d803f07235"),
+        (b"abc", "d8ccab23b5985ccea865c6c97b6e5b8350e794e603b4b979"
+                 "02f53a8a0d605615"),
+        (b"abcdef0123456789", "eff31487c770a893cfb36f912fbfcbff40d5"
+                              "661771ca4b2cb4eafe524333f5c1"),
+        (b"q128_" + b"q" * 128, "b23a1d2b4d97b2ef7785562a7e8bac7eed"
+                                "54ed6e97e29aa51bfe3f12ddad1ff9"),
+        (b"a512_" + b"a" * 512, "4623227bcc01293b8c130bf771da8c29"
+                                "8dede7383243dc0993d2d94823958c4c"),
+    ]
+
+    def test_expand_message_xmd_k1(self):
+        from cometbft_tpu.crypto import _bls12381_math as M
+        for msg, want in self.K1:
+            got = M.expand_message_xmd(msg, self.K1_DST, 0x20).hex()
+            assert got == want, msg
+
+    # RFC 9380 J.10.1: BLS12381G2_XMD:SHA-256_SSWU_RO_ — final output
+    # point P = (x0 + i*x1, y0 + i*y1) for the five appendix messages.
+    J101_DST = b"QUUX-V01-CS02-with-BLS12381G2_XMD:SHA-256_SSWU_RO_"
+    J101 = [
+        (b"",
+         "0141ebfbdca40eb85b87142e130ab689c673cf60f1a3e98d69335266f30d"
+         "9b8d4ac44c1038e9dcdd5393faf5c41fb78a",
+         "05cb8437535e20ecffaef7752baddf98034139c38452458baeefab379ba1"
+         "3dff5bf5dd71b72418717047f5b0f37da03d",
+         "0503921d7f6a12805e72940b963c0cf3471c7b2a524950ca195d11062ee7"
+         "5ec076daf2d4bc358c4b190c0c98064fdd92",
+         "12424ac32561493f3fe3c260708a12b7c620e7be00099a974e259ddc7d1f"
+         "6395c3c811cdd19f1e8dbf3e9ecfdcbab8d6"),
+        (b"abc",
+         "02c2d18e033b960562aae3cab37a27ce00d80ccd5ba4b7fe0e7a21024512"
+         "9dbec7780ccc7954725f4168aff2787776e6",
+         "139cddbccdc5e91b9623efd38c49f81a6f83f175e80b06fc374de9eb4b41"
+         "dfe4ca3a230ed250fbe3a2acf73a41177fd8",
+         "1787327b68159716a37440985269cf584bcb1e621d3a7202be6ea05c4cfe"
+         "244aeb197642555a0645fb87bf7466b2ba48",
+         "00aa65dae3c8d732d10ecd2c50f8a1baf3001578f71c694e03866e9f3d49"
+         "ac1e1ce70dd94a733534f106d4cec0eddd16"),
+        (b"abcdef0123456789",
+         "121982811d2491fde9ba7ed31ef9ca474f0e1501297f68c298e9f4c0028"
+         "add35aea8bb83d53c08cfc007c1e005723cd0",
+         "190d119345b94fbd15497bcba94ecf7db2cbfd1e1fe7da034d26cbba169f"
+         "b3968288b3fafb265f9ebd380512a71c3f2c",
+         "05571a0f8d3c08d094576981f4a3b8eda0a8e771fcdcc8ecceaf1356a6ac"
+         "f17574518acb506e435b639353c2e14827c8",
+         "0bb5e7572275c567462d91807de765611490205a941a5a6af3b1691bfe59"
+         "6c31225d3aabdf15faff860cb4ef17c7c3be"),
+        (b"q128_" + b"q" * 128,
+         "19a84dd7248a1066f737cc34502ee5555bd3c19f2ecdb3c7d9e24dc65d4e"
+         "25e50d83f0f77105e955d78f4762d33c17da",
+         "0934aba516a52d8ae479939a91998299c76d39cc0c035cd18813bec433f5"
+         "87e2d7a4fef038260eef0cef4d02aae3eb91",
+         "14f81cd421617428bc3b9fe25afbb751d934a00493524bc4e065635b0555"
+         "084dd54679df1536101b2c979c0152d09192",
+         "09bcccfa036b4847c9950780733633f13619994394c23ff0b32fa6b79584"
+         "4f4a0673e20282d07bc69641cee04f5e5662"),
+        (b"a512_" + b"a" * 512,
+         "01a6ba2f9a11fa5598b2d8ace0fbe0a0eacb65deceb476fbbcb64fd24557"
+         "c2f4b18ecfc5663e54ae16a84f5ab7f62534",
+         "11fca2ff525572795a801eed17eb12785887c7b63fb77a42be46ce4a3413"
+         "1d71f7a73e95fee3f812aea3de78b4d01569",
+         "0b6798718c8aed24bc19cb27f866f1c9effcdbf92397ad6448b5c9db90d2"
+         "b9da6cbabf48adc1adf59a1a28344e79d57e",
+         "03a47f8e6d1763ba0cad63d6114c0accbef65707825a511b251a660a9b39"
+         "94249ae4e63fac38b23da0c398689ee2ab52"),
+    ]
+
+    # hash_to_field intermediate for msg="" (same appendix): catches a
+    # regression upstream of the curve maps with a precise finger.
+    J101_U_EMPTY = (
+        ("03dbc2cce174e91ba93cbb08f26b917f98194a2ea08d1cce75b2b9cc9f21"
+         "689d80bd79b594a613d0a68eb807dfdc1cf8",
+         "05a2acec64114845711a54199ea339abd125ba38253b70a92c876df10598"
+         "bd1986b739cad67961eb94f7076511b3b39a"),
+        ("02f99798e8a5acdeed60d7e18e9120521ba1f47ec090984662846bc825de"
+         "191b5b7641148c0dbc237726a334473eee94",
+         "145a81e418d4010cc027a68f14391b30074e89e60ee7a22f87217b2f6eb0"
+         "c4b94c9115b436e6fa4607e95a98de30a435"),
+    )
+
+    def test_hash_to_field_j101(self, monkeypatch):
+        from cometbft_tpu.crypto import _bls12381_math as M
+        monkeypatch.setattr(M, "_native", lambda: None)
+        u = M.hash_to_field_fq2(b"", self.J101_DST, 2)
+        for got, want in zip(u, self.J101_U_EMPTY):
+            assert got == (int(want[0], 16), int(want[1], 16))
+
+    def _check_suite(self, M, hash_fn):
+        for msg, x0, x1, y0, y1 in self.J101:
+            (gx0, gx1), (gy0, gy1) = hash_fn(msg)
+            assert gx0 == int(x0, 16) and gx1 == int(x1, 16), msg
+            assert gy0 == int(y0, 16) and gy1 == int(y1, 16), msg
+
+    def test_hash_to_g2_j101_python(self, monkeypatch):
+        # monkeypatch the module's native hook, not the env var: the
+        # loader caches the module after first load, so the env flag
+        # cannot force the pure-python golden model mid-process
+        from cometbft_tpu.crypto import _bls12381_math as M
+        monkeypatch.setattr(M, "_native", lambda: None)
+        self._check_suite(
+            M, lambda msg: M.hash_to_g2(msg, self.J101_DST))
+
+    def test_hash_to_g2_j101_native(self):
+        from cometbft_tpu.crypto import _bls12381_math as M
+        from cometbft_tpu.crypto import _native_loader
+        import pytest
+        if _native_loader.load() is None:
+            pytest.skip("native module unavailable")
+        self._check_suite(
+            M, lambda msg: M._g2_unraw(
+                _native_loader.load().bls_hash_to_g2(
+                    msg, self.J101_DST)))
+
+    def test_blst_interop_sign_triple(self, monkeypatch):
+        """A fixed (sk, msg, signature) triple produced by a
+        blst-based stack (the eth2 BLS sign suite,
+        BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_): our sk*H(msg)
+        must reproduce the blst signature BYTE-FOR-BYTE, pinning the
+        full pipeline — expand, field hashing, SSWU, isogeny sign
+        convention, cofactor, scalar mult, and compressed
+        serialization — to blst's."""
+        from cometbft_tpu.crypto import _bls12381_math as M
+        monkeypatch.setattr(M, "_native", lambda: None)
+        sk = int("328388aff0d4a5b7dc9205abd374e7e98f3cd9f3418edb4eaf"
+                 "da5fb16473d216", 16)
+        msg = bytes.fromhex("ab" * 32)
+        dst = b"BLS_SIG_BLS12381G2_XMD:SHA-256_SSWU_RO_POP_"
+        want_sig = bytes.fromhex(
+            "ae82747ddeefe4fd64cf9cedb9b04ae3e8a43420cd255e3c7cd06a8d"
+            "88b7c7f8638543719981c5d16fa3527c468c25f0026704a6951bde89"
+            "1360c7e8d12ddee0559004ccdbe6046b55bae1b257ee97f7cdb95577"
+            "3d7cf29adf3ccbb9975e4eb9")
+        sig_pt = M.pt_mul(M.G2_OPS, M.hash_to_g2(msg, dst), sk)
+        assert M.g2_compress(sig_pt) == want_sig
+        # and the public verify equation holds for the triple
+        pub = M.pt_mul(M.G1_OPS, M.G1_GEN, sk)
+        neg_pub = (pub[0], M.P - pub[1])
+        assert M.pairings_product_is_one(
+            [(neg_pub, M.hash_to_g2(msg, dst)),
+             (M.G1_GEN, M.g2_uncompress(want_sig))])
