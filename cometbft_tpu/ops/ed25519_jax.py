@@ -273,12 +273,14 @@ def verify_batch(
 
 
 def _kernel_choice() -> str:
-    """'pallas' (fused Mosaic kernel; TPU) or 'xla' (portable).
+    """'pallas' (fused Mosaic 24-limb kernel; TPU), 'pallas8' (the
+    first-generation 32x8-bit kernel) or 'xla' (portable).
 
-    COMETBFT_TPU_KERNEL=pallas|xla overrides; auto picks pallas on TPU
-    platforms only — on CPU the pallas path would run interpreted."""
+    COMETBFT_TPU_KERNEL=pallas|pallas8|xla overrides; auto picks
+    pallas on TPU platforms only — on CPU the pallas path would run
+    interpreted."""
     choice = os.environ.get("COMETBFT_TPU_KERNEL", "auto").lower()
-    if choice in ("pallas", "xla"):
+    if choice in ("pallas", "pallas8", "xla"):
         return choice
     try:
         platform = jax.devices()[0].platform
@@ -287,13 +289,23 @@ def _kernel_choice() -> str:
     return "pallas" if platform == "tpu" else "xla"
 
 
+def _pallas_module(choice: str):
+    """The Pallas kernel module for a 'pallas*' choice ('pallas' is
+    the 24-limb kernel, 'pallas8' the first-generation byte kernel)."""
+    if choice == "pallas8":
+        from . import ed25519_pallas8 as ep8
+        return ep8
+    from . import ed25519_pallas as ep
+    return ep
+
+
 def _verify_chunk(items) -> np.ndarray:
     enable_compilation_cache()
     n = len(items)
     m = _bucket(n)
-    if _kernel_choice() == "pallas":
-        from . import ed25519_pallas as ep
-        m = max(m, ep.BLOCK)
+    choice = _kernel_choice()
+    if choice.startswith("pallas"):
+        m = max(m, _pallas_module(choice).BLOCK)
     a_b, r_b, s_win, k_win, pre_bad = prep_arrays(items, m)
     return _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
 
@@ -390,9 +402,10 @@ def _try_aot(choice: str, interpret: bool, a_b, r_b, s_win, k_win):
             return None
     except Exception:
         return None
+    if choice not in ("pallas", "xla"):
+        return None     # no committed artifacts for fallback kernels
     from . import aot
-    exp = aot.load(choice if choice == "pallas" else "xla",
-                   a_b.shape[0])
+    exp = aot.load(choice, a_b.shape[0])
     if exp is None or "tpu" not in exp.platforms:
         return None     # before building any transposed copies
     if choice == "pallas":
@@ -443,8 +456,8 @@ def _dispatch(n: int, a_b, r_b, s_win, k_win, pre_bad, *,
     elif (aot_ok := _try_aot(choice, interpret, a_b, r_b, s_win,
                              k_win)) is not None:
         ok = aot_ok
-    elif choice == "pallas":
-        from . import ed25519_pallas as ep
+    elif choice.startswith("pallas"):
+        ep = _pallas_module(choice)
         ok = np.asarray(ep.verify_cols(
             jnp.asarray(np.ascontiguousarray(a_b.T).astype(np.int32)),
             jnp.asarray(np.ascontiguousarray(r_b.T).astype(np.int32)),
@@ -467,8 +480,8 @@ def warmup(n: int) -> None:
 @functools.lru_cache(maxsize=None)
 def _warmup_bucket(m: int) -> None:
     enable_compilation_cache()
-    if _kernel_choice() == "pallas":
-        from . import ed25519_pallas as ep
+    if _kernel_choice().startswith("pallas"):
+        ep = _pallas_module(_kernel_choice())
         m = max(m, ep.BLOCK)
         a = np.tile(np.frombuffer(_B_BYTES, np.uint8).astype(np.int32)
                     .reshape(32, 1), (1, m))

@@ -1,4 +1,4 @@
-"""Pallas TPU kernel for batch ed25519 verification.
+"""Pallas TPU kernel for batch ed25519 verification — 24-limb radix.
 
 The hot path of the framework (reference seam: crypto/ed25519/ed25519.go
 BatchVerifier → types/validation.go verifyCommitBatch).  One fused Mosaic
@@ -6,18 +6,20 @@ kernel verifies a block of lanes end-to-end: ZIP-215 decompression,
 4-bit-windowed Straus ladder for [8](s·B - R - k·A), and the identity
 test — all in VMEM.
 
-Layout is LIMB-MAJOR: a field element batch is int32[32, B] (limb rows ×
-lane columns), so every limb row is a full VPU vector and the limb
-convolution becomes 32 statically-shifted row MACs — ~2k vector MACs per
-multiply, with no selector matmul (the XLA formulation in ed25519_jax.py
-needs a [1024, 64] contraction per multiply to stay compile-time-sane;
-inside Mosaic the unrolled form compiles directly).  The ladder and the
-scalar-chain exponentiation run as fori_loops; the per-lane window tables
-live in VMEM scratch and are read back with masked selects (there is no
-cross-lane gather on the VPU).
+Second-generation field arithmetic (the r3 cost model's prescription,
+KERNEL_NOTES.md): 24 balanced limbs in an (11, 11, 10)-bit cycle
+(ops/field24.py has the schedule rationale and the int32 bounds
+analysis).  The limb convolution drops from 1024 slab MACs (32x8-bit
+kernel, kept as ed25519_pallas8.py behind COMETBFT_TPU_KERNEL=pallas8)
+to 576, and the off-grid x2 corrections are separable by residue
+class, so each of the 24 slab MACs just picks one of three pre-scaled
+copies of the multiplier.  Worst-case accumulator (both operands
+carry-normalized, exact per-position bound) is 0.93e9 < 2^31 with
+2.3x headroom.
 
-The math (radix-2^8 redundant limbs, carry folding at weight 38,
-magnitude discipline) matches ops/field.py — see the bounds notes there.
+Inputs are identical to the byte kernel: [32, B] byte columns for
+A and R, [64, B] nibble windows for s and k — the host prep and the
+dispatch are unchanged; bytes convert to limbs in VMEM.
 """
 from __future__ import annotations
 
@@ -31,20 +33,35 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 from ..crypto import _ed25519_ref as ref
-from . import field
+from . import field24 as f24
 
-LIMBS = 32
-_FOLD = 38
-BLOCK = 128                     # lanes per grid step (one VPU row set)
+LIMBS = f24.LIMBS               # 24
+_FOLD = f24.FOLD                # 38 = 2^256 mod p
+_SIZES = f24.SIZES
+_OFFS = f24.OFFSETS
+BLOCK = 128                     # lanes per grid step
 _WINDOWS = 64
 
 
+# --- balanced carry / field multiply ---------------------------------------
+
 def _carry(x):
-    """One parallel carry pass, limb-major ([32, B])."""
-    c = x >> 8
-    lo = x & 255
-    c = jnp.concatenate([c[LIMBS - 1:] * _FOLD, c[:LIMBS - 1]], axis=0)
-    return lo + c
+    """One balanced (round-to-nearest) carry pass, limb-major [24, B].
+    The top carry folds into limb 0 at weight 38 and is immediately
+    split again (fold-settle) so limb 0 keeps its resting bound."""
+    cs, los = [], []
+    for i in range(LIMBS):
+        t = _SIZES[i]
+        c = (x[i:i + 1] + (1 << (t - 1))) >> t
+        cs.append(c)
+        los.append(x[i:i + 1] - (c << t))
+    f = cs[-1] * _FOLD
+    fc = (f + 1024) >> 11               # limb 0 is an 11-bit position
+    rows = [los[0] + (f - (fc << 11)),
+            los[1] + cs[0] + fc]
+    for i in range(2, LIMBS):
+        rows.append(los[i] + cs[i - 1])
+    return jnp.concatenate(rows, axis=0)
 
 
 def _norm(x, passes):
@@ -53,67 +70,63 @@ def _norm(x, passes):
     return x
 
 
-def _mul(a, b):
-    """Field multiply, limb-major.  |inputs| <= ~1600, output <= ~600."""
-    a = _norm(a, 2)
-    b = _norm(b, 2)
-    xt = jnp.concatenate([a[1:] * _FOLD, a], axis=0)      # [63, B]
-    acc = xt[31:63] * b[0:1]
-    for j in range(1, LIMBS):
-        acc = acc + xt[31 - j:63 - j] * b[j:j + 1]
-    return _norm(acc, 3)
+def _mul(a, b, pats):
+    """Field multiply, limb-major.  Each input gets one normalizing
+    pass (resting bound ~1030/515 per position); the 24-slab
+    convolution then stays under 0.93e9 < 2^31 (see field24.py)."""
+    return _mul_nn(_carry(a), _carry(b), pats)
 
 
-def _sqr(a):
-    return _mul(a, a)
+def _mul_nn(a, b, pats):
+    """Multiply of already-normalized operands (used by _sqr to avoid
+    re-normalizing the shared input twice)."""
+    pat1, pat2 = pats
+    v0 = b
+    v1 = b * pat1
+    v2 = b * pat2
+    bt = []
+    for v in (v0, v1, v2):
+        w = v * _FOLD
+        bt.append(jnp.concatenate([w[1:], v], axis=0))   # [47, B]
+    acc = None
+    for i in range(LIMBS):
+        sl = bt[i % 3][LIMBS - 1 - i:2 * LIMBS - 1 - i]  # [24, B]
+        term = sl * a[i:i + 1]
+        acc = term if acc is None else acc + term
+    return _norm(acc, 2)
+
+
+def _make_sqr(pats):
+    def _sqr(a):
+        a = _carry(a)
+        return _mul_nn(a, a, pats)
+    return _sqr
 
 
 def _mul_const(x, c):
-    return _norm(x * c, 3)
+    return _norm(x * c, 2)
 
 
-def _pow2k_loop(x, k):
-    return lax.fori_loop(0, k, lambda _, v: _sqr(v), x)
+# --- canonical / comparisons (limb-major) ----------------------------------
 
-
-def _pow_p58(x):
-    """x^(2^252 - 3) (same chain as field.pow_p58)."""
-    x2 = _sqr(x)
-    t = _sqr(_sqr(x2))
-    z9 = _mul(x, t)
-    z11 = _mul(x2, z9)
-    z_5_0 = _mul(z9, _sqr(z11))
-    z_10_0 = _mul(_pow2k_loop(z_5_0, 5), z_5_0)
-    z_20_0 = _mul(_pow2k_loop(z_10_0, 10), z_10_0)
-    z_40_0 = _mul(_pow2k_loop(z_20_0, 20), z_20_0)
-    z_50_0 = _mul(_pow2k_loop(z_40_0, 10), z_10_0)
-    z_100_0 = _mul(_pow2k_loop(z_50_0, 50), z_50_0)
-    z_200_0 = _mul(_pow2k_loop(z_100_0, 100), z_100_0)
-    z_250_0 = _mul(_pow2k_loop(z_200_0, 50), z_50_0)
-    return _mul(x, _pow2k_loop(z_250_0, 2))
-
-
-# --- canonical / comparisons (limb-major) -----------------------------------
-
-_P_NP = np.frombuffer(field.P.to_bytes(32, "little"), np.uint8
-                      ).astype(np.int32)
-
+_P_DIGITS = [int(v) for v in f24.P_DIGITS]
 
 
 def _seq_carry(x):
-    """Exact sequential sweep: rows -> [0,256), plus carry row."""
+    """Exact sequential sweep: rows -> [0, 2^t_i), plus carry row."""
     outs = []
     c = jnp.zeros_like(x[0:1])
     for i in range(LIMBS):
+        t = _SIZES[i]
         v = x[i:i + 1] + c
-        outs.append(v & 255)
-        c = v >> 8
+        outs.append(v & ((1 << t) - 1))
+        c = v >> t
     return jnp.concatenate(outs, axis=0), c
 
 
 def _canonical(x, four_p):
-    x = _norm(x, 4)
-    x = x + four_p                                            # + 4p
+    x = _norm(x, 2)
+    x = x + four_p                                        # + 4p > 0
     for _ in range(3):
         x, c = _seq_carry(x)
         x = jnp.concatenate([x[0:1] + _FOLD * c, x[1:]], axis=0)
@@ -121,24 +134,23 @@ def _canonical(x, four_p):
         ge = jnp.ones_like(x[0:1], dtype=jnp.bool_)
         gt = jnp.zeros_like(x[0:1], dtype=jnp.bool_)
         for i in range(LIMBS - 1, -1, -1):
-            pi = int(_P_NP[i])
+            pi = _P_DIGITS[i]
             gt = gt | (ge & (x[i:i + 1] > pi))
             ge = ge & (x[i:i + 1] == pi)
         take = gt | ge
-        # subtract p where take
         outs = []
         c = jnp.zeros_like(x[0:1])
         for i in range(LIMBS):
-            v = x[i:i + 1] - int(_P_NP[i]) + c
-            outs.append(v & 255)
-            c = v >> 8
+            t = _SIZES[i]
+            v = x[i:i + 1] - _P_DIGITS[i] + c
+            outs.append(v & ((1 << t) - 1))
+            c = v >> t
         sub = jnp.concatenate(outs, axis=0)
         x = jnp.where(take, sub, x)
     return x
 
 
 def _is_zero(x, four_p):
-    """[1, B] bool: x == 0 mod p."""
     c = _canonical(x, four_p)
     nz = c[0:1]
     for i in range(1, LIMBS):
@@ -154,91 +166,135 @@ def _parity(x, four_p):
     return _canonical(x, four_p)[0:1] & 1
 
 
-# --- point ops (extended twisted Edwards, limb-major) -----------------------
+# --- byte -> limb conversion (in VMEM) -------------------------------------
 
-_D_COL = field.to_limbs(ref.D).reshape(LIMBS, 1)
-_2D_COL = field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1)
-_SQRT_M1_COL = field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1)
+def _from_bytes(b):
+    """[32, B] byte values -> [24, B] digits (limb i covers bits
+    [OFFSETS[i], OFFSETS[i+1]) of the little-endian value)."""
+    rows = []
+    for i in range(LIMBS):
+        s, t = _OFFS[i], _SIZES[i]
+        b0, sh = s >> 3, s & 7
+        acc = b[b0:b0 + 1] >> sh
+        if sh + t > 8:
+            acc = acc + (b[b0 + 1:b0 + 2] << (8 - sh))
+        if sh + t > 16 and b0 + 2 < 32:
+            acc = acc + (b[b0 + 2:b0 + 3] << (16 - sh))
+        rows.append(acc & ((1 << t) - 1))
+    return jnp.concatenate(rows, axis=0)
 
 
-def _ext_add(p, q, two_d):
+# --- exponentiation chain ---------------------------------------------------
+
+def _pow_p58(x, pats):
+    """x^(2^252 - 3) (same chain as field.pow_p58)."""
+    _sqr = _make_sqr(pats)
+
+    def pow2k(v, k):
+        return lax.fori_loop(0, k, lambda _, u: _sqr(u), v)
+
+    x2 = _sqr(x)
+    t = _sqr(_sqr(x2))
+    z9 = _mul(x, t, pats)
+    z11 = _mul(x2, z9, pats)
+    z_5_0 = _mul(z9, _sqr(z11), pats)
+    z_10_0 = _mul(pow2k(z_5_0, 5), z_5_0, pats)
+    z_20_0 = _mul(pow2k(z_10_0, 10), z_10_0, pats)
+    z_40_0 = _mul(pow2k(z_20_0, 20), z_20_0, pats)
+    z_50_0 = _mul(pow2k(z_40_0, 10), z_10_0, pats)
+    z_100_0 = _mul(pow2k(z_50_0, 50), z_50_0, pats)
+    z_200_0 = _mul(pow2k(z_100_0, 100), z_100_0, pats)
+    z_250_0 = _mul(pow2k(z_200_0, 50), z_50_0, pats)
+    return _mul(x, pow2k(z_250_0, 2), pats)
+
+
+# --- point ops (extended twisted Edwards, limb-major) ----------------------
+
+def _ext_add(p, q, two_d, pats):
     """Unified add (complete for a=-1)."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
-    a = _mul(Y1 - X1, Y2 - X2)
-    b = _mul(Y1 + X1, Y2 + X2)
-    c = _mul(_mul(T1, T2), two_d)
-    d = _mul_const(_mul(Z1, Z2), 2)
+    a = _mul(Y1 - X1, Y2 - X2, pats)
+    b = _mul(Y1 + X1, Y2 + X2, pats)
+    c = _mul(_mul(T1, T2, pats), two_d, pats)
+    d = _mul_const(_mul(Z1, Z2, pats), 2)
     e = b - a
-    f = d - c
+    ff = d - c
     g = d + c
     h = b + a
-    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+    return (_mul(e, ff, pats), _mul(g, h, pats),
+            _mul(ff, g, pats), _mul(e, h, pats))
 
 
-def _ext_double(p):
+def _ext_double(p, pats):
     """dbl-2008-hwcd, a=-1: 4 squarings + 4 products."""
+    _sqr = _make_sqr(pats)
     X1, Y1, Z1, _ = p
     a = _sqr(X1)
     b = _sqr(Y1)
     c = _mul_const(_sqr(Z1), 2)
     e = _sqr(X1 + Y1) - a - b
     g = b - a
-    f = g - c
+    ff = g - c
     h = -(a + b)
-    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+    return (_mul(e, ff, pats), _mul(g, h, pats),
+            _mul(ff, g, pats), _mul(e, h, pats))
 
 
-def _decompress(b, d_col, sqrt_m1, four_p):
-    """b: [32, B] int32 byte values -> (x, y, ok) limb-major."""
+def _decompress(b, d_col, sqrt_m1, four_p, pats):
+    """b: [32, B] int32 byte values -> (x, y, ok) limb-major [24, B]."""
     sign = b[31:32] >> 7
-    y = jnp.concatenate([b[:31], b[31:32] & 0x7F], axis=0)
-    # concatenate, not .at[].set: scatter has no Mosaic TPU lowering
+    yb = jnp.concatenate([b[:31], b[31:32] & 0x7F], axis=0)
+    y = _from_bytes(yb)
     one = jnp.concatenate(
         [jnp.ones_like(y[0:1]), jnp.zeros_like(y[1:])], axis=0)
+    _sqr = _make_sqr(pats)
     yy = _sqr(y)
     u = yy - one
-    v = _mul(yy, d_col) + one
-    v3 = _mul(_sqr(v), v)
-    v7 = _mul(_sqr(v3), v)
-    x = _mul(_mul(u, v3), _pow_p58(_mul(u, v7)))
-    vxx = _mul(v, _sqr(x))
+    v = _mul(yy, d_col, pats) + one
+    v3 = _mul(_sqr(v), v, pats)
+    v7 = _mul(_sqr(v3), v, pats)
+    x = _mul(_mul(u, v3, pats), _pow_p58(_mul(u, v7, pats), pats),
+             pats)
+    vxx = _mul(v, _sqr(x), pats)
     ok_direct = _eq(vxx, u, four_p)
     ok_flip = _eq(vxx, -u, four_p)
-    x = jnp.where(ok_flip, _mul(x, sqrt_m1), x)
+    x = jnp.where(ok_flip, _mul(x, sqrt_m1, pats), x)
     valid = ok_direct | ok_flip
     wrong_sign = _parity(x, four_p) != sign
     x = jnp.where(wrong_sign, -x, x)
     return x, y, valid
 
 
-# --- the kernel -------------------------------------------------------------
+# --- constant tables --------------------------------------------------------
 
 def _build_b_table_cols() -> np.ndarray:
-    """Constant i·B table, [16, 4, 32, 1]: (entry, coord, limb, bcast)."""
+    """Constant i·B table, [16, 4, 24, 1]: (entry, coord, limb, bcast)."""
     pts = [(0, 1)] + [ref.scalar_mult(i, ref.B) for i in range(1, 16)]
     out = np.zeros((16, 4, LIMBS, 1), np.int32)
     for i, (x, y) in enumerate(pts):
-        out[i, 0, :, 0] = field.to_limbs(x)
-        out[i, 1, :, 0] = field.to_limbs(y)
-        out[i, 2, :, 0] = field.to_limbs(1)
-        out[i, 3, :, 0] = field.to_limbs(x * y % ref.P)
+        out[i, 0, :, 0] = f24.to_limbs(x)
+        out[i, 1, :, 0] = f24.to_limbs(y)
+        out[i, 2, :, 0] = f24.to_limbs(1)
+        out[i, 3, :, 0] = f24.to_limbs(x * y % ref.P)
     return out
 
 
 _B_TABLE_NP = _build_b_table_cols()
 
-# packed constants input: D, 2D, sqrt(-1), 4p, then the flattened B table
+# packed constants: D, 2D, sqrt(-1), 4p, pat1, pat2, then the B table
 _CONSTS_NP = np.concatenate([
-    field.to_limbs(ref.D).reshape(LIMBS, 1).astype(np.int32),
-    field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1).astype(np.int32),
-    field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1).astype(np.int32),
-    # 4p as limb-wise double of 2p = 2^256 - 38 (fits 32 bytes)
-    (2 * np.frombuffer((2 * field.P).to_bytes(32, "little"), np.uint8)
-     .astype(np.int32)).reshape(LIMBS, 1),
+    f24.to_limbs(ref.D).reshape(LIMBS, 1).astype(np.int32),
+    f24.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1).astype(np.int32),
+    f24.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1).astype(np.int32),
+    f24.FOUR_P_DIGITS.reshape(LIMBS, 1).astype(np.int32),
+    f24.PAT_R1.reshape(LIMBS, 1).astype(np.int32),
+    f24.PAT_R2.reshape(LIMBS, 1).astype(np.int32),
     _B_TABLE_NP.reshape(16 * 4 * LIMBS, 1),
 ], axis=0)
 
+
+# --- the kernel -------------------------------------------------------------
 
 def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
             tab_ref):
@@ -249,38 +305,38 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
     two_d = consts_ref[LIMBS:2 * LIMBS]
     sqrt_m1 = consts_ref[2 * LIMBS:3 * LIMBS]
     four_p = consts_ref[3 * LIMBS:4 * LIMBS]
-    b_tab = consts_ref[4 * LIMBS:].reshape(16, 4, LIMBS, 1)
+    pats = (consts_ref[4 * LIMBS:5 * LIMBS],
+            consts_ref[5 * LIMBS:6 * LIMBS])
+    b_tab = consts_ref[6 * LIMBS:].reshape(16, 4, LIMBS, 1)
 
-    ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p)
-    rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p)
+    ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p, pats)
+    rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p, pats)
     zero = jnp.zeros((LIMBS, B), jnp.int32)
     one = jnp.concatenate(
         [jnp.ones((1, B), jnp.int32), zero[1:]], axis=0)
 
     # -A in extended coords
     nax, nay = -ax, ay
-    nat = _mul(nax, nay)
+    nat = _mul(nax, nay, pats)
 
     # per-lane table of i·(-A), i=0..15, in VMEM scratch
-    # tab layout: [16, 4*LIMBS, B] (coords stacked along the limb axis)
+    # tab layout: [16, 4*LIMBS, B]
     ident = jnp.concatenate([zero, one, one, zero], axis=0)
     tab_ref[0] = ident
-    neg_a_stack = jnp.concatenate([nax, nay, one, nat], axis=0)
-    tab_ref[1] = neg_a_stack
+    tab_ref[1] = jnp.concatenate([nax, nay, one, nat], axis=0)
 
     def build_body(i, _):
         prev = tab_ref[i]
         p = (prev[0:LIMBS], prev[LIMBS:2 * LIMBS],
              prev[2 * LIMBS:3 * LIMBS], prev[3 * LIMBS:])
         q = (nax, nay, one, nat)
-        r = _ext_add(p, q, two_d)
+        r = _ext_add(p, q, two_d, pats)
         tab_ref[i + 1] = jnp.concatenate(r, axis=0)
         return 0
 
     lax.fori_loop(1, 15, build_body, 0)
 
     def select_lane_table(w):
-        """w: [1, B] 0..15 -> 4 coords [32, B] via masked sum."""
         acc = None
         for t in range(16):
             m = (w == t).astype(jnp.int32)
@@ -302,24 +358,22 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     def ladder_body(j, acc):
         for _ in range(4):
-            acc = _ext_double(acc)
+            acc = _ext_double(acc, pats)
         w = (_WINDOWS - 1) - j
-        # dynamic REF reads (pl.ds) — dynamic_slice on values has no
-        # Mosaic TPU lowering
         sw = swin_ref[pl.ds(w, 1)]
         kw = kwin_ref[pl.ds(w, 1)]
-        acc = _ext_add(acc, select_b_table(sw), two_d)
-        acc = _ext_add(acc, select_lane_table(kw), two_d)
+        acc = _ext_add(acc, select_b_table(sw), two_d, pats)
+        acc = _ext_add(acc, select_lane_table(kw), two_d, pats)
         return acc
 
     acc = lax.fori_loop(0, _WINDOWS, ladder_body,
                         (zero, one, one, zero))
 
     # subtract R, clear cofactor, identity test
-    nrt = _mul(-rx, ry)
-    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d)
+    nrt = _mul(-rx, ry, pats)
+    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d, pats)
     for _ in range(3):
-        acc = _ext_double(acc)
+        acc = _ext_double(acc, pats)
     X, Y, Z, _T = acc
     ok = _is_zero(X, four_p) & _eq(Y, Z, four_p) & a_ok & r_ok
     ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, B))
@@ -328,10 +382,9 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 @functools.partial(jax.jit, static_argnames=("interpret", "block"))
 def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False,
                    block=BLOCK):
-    """a_cols, r_cols: [32, n] int32; s_win, k_win: [64, n] int32.
-    Returns ok [n] bool.  n must be a multiple of block (the
-    production path pads to BLOCK; tests run interpret mode with a
-    small block so the emulated kernel stays tractable)."""
+    """a_cols, r_cols: [32, n] int32 byte values; s_win, k_win:
+    [64, n] int32 nibble windows.  Returns ok [n] bool.  n must be a
+    multiple of block."""
     n = a_cols.shape[1]
     if n % block != 0:
         raise ValueError(
@@ -343,9 +396,9 @@ def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False,
         out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
         grid=(grid,),
         in_specs=[
-            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
+            pl.BlockSpec((32, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
+            pl.BlockSpec((32, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((_WINDOWS, block), lambda i: (0, i),
                          memory_space=pltpu.VMEM),

@@ -1,0 +1,374 @@
+"""Pallas TPU kernel for batch ed25519 verification - 32x8-bit radix.
+
+First-generation kernel, kept as the fallback behind
+COMETBFT_TPU_KERNEL=pallas8 (the 24-limb kernel in ed25519_pallas.py
+is the default; see ops/field24.py for why the radix changed).
+
+The hot path of the framework (reference seam: crypto/ed25519/ed25519.go
+BatchVerifier → types/validation.go verifyCommitBatch).  One fused Mosaic
+kernel verifies a block of lanes end-to-end: ZIP-215 decompression,
+4-bit-windowed Straus ladder for [8](s·B - R - k·A), and the identity
+test — all in VMEM.
+
+Layout is LIMB-MAJOR: a field element batch is int32[32, B] (limb rows ×
+lane columns), so every limb row is a full VPU vector and the limb
+convolution becomes 32 statically-shifted row MACs — ~2k vector MACs per
+multiply, with no selector matmul (the XLA formulation in ed25519_jax.py
+needs a [1024, 64] contraction per multiply to stay compile-time-sane;
+inside Mosaic the unrolled form compiles directly).  The ladder and the
+scalar-chain exponentiation run as fori_loops; the per-lane window tables
+live in VMEM scratch and are read back with masked selects (there is no
+cross-lane gather on the VPU).
+
+The math (radix-2^8 redundant limbs, carry folding at weight 38,
+magnitude discipline) matches ops/field.py — see the bounds notes there.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..crypto import _ed25519_ref as ref
+from . import field
+
+LIMBS = 32
+_FOLD = 38
+BLOCK = 128                     # lanes per grid step (one VPU row set)
+_WINDOWS = 64
+
+
+def _carry(x):
+    """One parallel carry pass, limb-major ([32, B])."""
+    c = x >> 8
+    lo = x & 255
+    c = jnp.concatenate([c[LIMBS - 1:] * _FOLD, c[:LIMBS - 1]], axis=0)
+    return lo + c
+
+
+def _norm(x, passes):
+    for _ in range(passes):
+        x = _carry(x)
+    return x
+
+
+def _mul(a, b):
+    """Field multiply, limb-major.  |inputs| <= ~1600, output <= ~600."""
+    a = _norm(a, 2)
+    b = _norm(b, 2)
+    xt = jnp.concatenate([a[1:] * _FOLD, a], axis=0)      # [63, B]
+    acc = xt[31:63] * b[0:1]
+    for j in range(1, LIMBS):
+        acc = acc + xt[31 - j:63 - j] * b[j:j + 1]
+    return _norm(acc, 3)
+
+
+def _sqr(a):
+    return _mul(a, a)
+
+
+def _mul_const(x, c):
+    return _norm(x * c, 3)
+
+
+def _pow2k_loop(x, k):
+    return lax.fori_loop(0, k, lambda _, v: _sqr(v), x)
+
+
+def _pow_p58(x):
+    """x^(2^252 - 3) (same chain as field.pow_p58)."""
+    x2 = _sqr(x)
+    t = _sqr(_sqr(x2))
+    z9 = _mul(x, t)
+    z11 = _mul(x2, z9)
+    z_5_0 = _mul(z9, _sqr(z11))
+    z_10_0 = _mul(_pow2k_loop(z_5_0, 5), z_5_0)
+    z_20_0 = _mul(_pow2k_loop(z_10_0, 10), z_10_0)
+    z_40_0 = _mul(_pow2k_loop(z_20_0, 20), z_20_0)
+    z_50_0 = _mul(_pow2k_loop(z_40_0, 10), z_10_0)
+    z_100_0 = _mul(_pow2k_loop(z_50_0, 50), z_50_0)
+    z_200_0 = _mul(_pow2k_loop(z_100_0, 100), z_100_0)
+    z_250_0 = _mul(_pow2k_loop(z_200_0, 50), z_50_0)
+    return _mul(x, _pow2k_loop(z_250_0, 2))
+
+
+# --- canonical / comparisons (limb-major) -----------------------------------
+
+_P_NP = np.frombuffer(field.P.to_bytes(32, "little"), np.uint8
+                      ).astype(np.int32)
+
+
+
+def _seq_carry(x):
+    """Exact sequential sweep: rows -> [0,256), plus carry row."""
+    outs = []
+    c = jnp.zeros_like(x[0:1])
+    for i in range(LIMBS):
+        v = x[i:i + 1] + c
+        outs.append(v & 255)
+        c = v >> 8
+    return jnp.concatenate(outs, axis=0), c
+
+
+def _canonical(x, four_p):
+    x = _norm(x, 4)
+    x = x + four_p                                            # + 4p
+    for _ in range(3):
+        x, c = _seq_carry(x)
+        x = jnp.concatenate([x[0:1] + _FOLD * c, x[1:]], axis=0)
+    for _ in range(2):
+        ge = jnp.ones_like(x[0:1], dtype=jnp.bool_)
+        gt = jnp.zeros_like(x[0:1], dtype=jnp.bool_)
+        for i in range(LIMBS - 1, -1, -1):
+            pi = int(_P_NP[i])
+            gt = gt | (ge & (x[i:i + 1] > pi))
+            ge = ge & (x[i:i + 1] == pi)
+        take = gt | ge
+        # subtract p where take
+        outs = []
+        c = jnp.zeros_like(x[0:1])
+        for i in range(LIMBS):
+            v = x[i:i + 1] - int(_P_NP[i]) + c
+            outs.append(v & 255)
+            c = v >> 8
+        sub = jnp.concatenate(outs, axis=0)
+        x = jnp.where(take, sub, x)
+    return x
+
+
+def _is_zero(x, four_p):
+    """[1, B] bool: x == 0 mod p."""
+    c = _canonical(x, four_p)
+    nz = c[0:1]
+    for i in range(1, LIMBS):
+        nz = nz | c[i:i + 1]
+    return nz == 0
+
+
+def _eq(a, b, four_p):
+    return _is_zero(a - b, four_p)
+
+
+def _parity(x, four_p):
+    return _canonical(x, four_p)[0:1] & 1
+
+
+# --- point ops (extended twisted Edwards, limb-major) -----------------------
+
+_D_COL = field.to_limbs(ref.D).reshape(LIMBS, 1)
+_2D_COL = field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1)
+_SQRT_M1_COL = field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1)
+
+
+def _ext_add(p, q, two_d):
+    """Unified add (complete for a=-1)."""
+    X1, Y1, Z1, T1 = p
+    X2, Y2, Z2, T2 = q
+    a = _mul(Y1 - X1, Y2 - X2)
+    b = _mul(Y1 + X1, Y2 + X2)
+    c = _mul(_mul(T1, T2), two_d)
+    d = _mul_const(_mul(Z1, Z2), 2)
+    e = b - a
+    f = d - c
+    g = d + c
+    h = b + a
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _ext_double(p):
+    """dbl-2008-hwcd, a=-1: 4 squarings + 4 products."""
+    X1, Y1, Z1, _ = p
+    a = _sqr(X1)
+    b = _sqr(Y1)
+    c = _mul_const(_sqr(Z1), 2)
+    e = _sqr(X1 + Y1) - a - b
+    g = b - a
+    f = g - c
+    h = -(a + b)
+    return (_mul(e, f), _mul(g, h), _mul(f, g), _mul(e, h))
+
+
+def _decompress(b, d_col, sqrt_m1, four_p):
+    """b: [32, B] int32 byte values -> (x, y, ok) limb-major."""
+    sign = b[31:32] >> 7
+    y = jnp.concatenate([b[:31], b[31:32] & 0x7F], axis=0)
+    # concatenate, not .at[].set: scatter has no Mosaic TPU lowering
+    one = jnp.concatenate(
+        [jnp.ones_like(y[0:1]), jnp.zeros_like(y[1:])], axis=0)
+    yy = _sqr(y)
+    u = yy - one
+    v = _mul(yy, d_col) + one
+    v3 = _mul(_sqr(v), v)
+    v7 = _mul(_sqr(v3), v)
+    x = _mul(_mul(u, v3), _pow_p58(_mul(u, v7)))
+    vxx = _mul(v, _sqr(x))
+    ok_direct = _eq(vxx, u, four_p)
+    ok_flip = _eq(vxx, -u, four_p)
+    x = jnp.where(ok_flip, _mul(x, sqrt_m1), x)
+    valid = ok_direct | ok_flip
+    wrong_sign = _parity(x, four_p) != sign
+    x = jnp.where(wrong_sign, -x, x)
+    return x, y, valid
+
+
+# --- the kernel -------------------------------------------------------------
+
+def _build_b_table_cols() -> np.ndarray:
+    """Constant i·B table, [16, 4, 32, 1]: (entry, coord, limb, bcast)."""
+    pts = [(0, 1)] + [ref.scalar_mult(i, ref.B) for i in range(1, 16)]
+    out = np.zeros((16, 4, LIMBS, 1), np.int32)
+    for i, (x, y) in enumerate(pts):
+        out[i, 0, :, 0] = field.to_limbs(x)
+        out[i, 1, :, 0] = field.to_limbs(y)
+        out[i, 2, :, 0] = field.to_limbs(1)
+        out[i, 3, :, 0] = field.to_limbs(x * y % ref.P)
+    return out
+
+
+_B_TABLE_NP = _build_b_table_cols()
+
+# packed constants input: D, 2D, sqrt(-1), 4p, then the flattened B table
+_CONSTS_NP = np.concatenate([
+    field.to_limbs(ref.D).reshape(LIMBS, 1).astype(np.int32),
+    field.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1).astype(np.int32),
+    field.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1).astype(np.int32),
+    # 4p as limb-wise double of 2p = 2^256 - 38 (fits 32 bytes)
+    (2 * np.frombuffer((2 * field.P).to_bytes(32, "little"), np.uint8)
+     .astype(np.int32)).reshape(LIMBS, 1),
+    _B_TABLE_NP.reshape(16 * 4 * LIMBS, 1),
+], axis=0)
+
+
+def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
+            tab_ref):
+    B = a_ref.shape[1]
+    a_b = a_ref[:]
+    r_b = r_ref[:]
+    d_col = consts_ref[0:LIMBS]
+    two_d = consts_ref[LIMBS:2 * LIMBS]
+    sqrt_m1 = consts_ref[2 * LIMBS:3 * LIMBS]
+    four_p = consts_ref[3 * LIMBS:4 * LIMBS]
+    b_tab = consts_ref[4 * LIMBS:].reshape(16, 4, LIMBS, 1)
+
+    ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p)
+    rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p)
+    zero = jnp.zeros((LIMBS, B), jnp.int32)
+    one = jnp.concatenate(
+        [jnp.ones((1, B), jnp.int32), zero[1:]], axis=0)
+
+    # -A in extended coords
+    nax, nay = -ax, ay
+    nat = _mul(nax, nay)
+
+    # per-lane table of i·(-A), i=0..15, in VMEM scratch
+    # tab layout: [16, 4*LIMBS, B] (coords stacked along the limb axis)
+    ident = jnp.concatenate([zero, one, one, zero], axis=0)
+    tab_ref[0] = ident
+    neg_a_stack = jnp.concatenate([nax, nay, one, nat], axis=0)
+    tab_ref[1] = neg_a_stack
+
+    def build_body(i, _):
+        prev = tab_ref[i]
+        p = (prev[0:LIMBS], prev[LIMBS:2 * LIMBS],
+             prev[2 * LIMBS:3 * LIMBS], prev[3 * LIMBS:])
+        q = (nax, nay, one, nat)
+        r = _ext_add(p, q, two_d)
+        tab_ref[i + 1] = jnp.concatenate(r, axis=0)
+        return 0
+
+    lax.fori_loop(1, 15, build_body, 0)
+
+    def select_lane_table(w):
+        """w: [1, B] 0..15 -> 4 coords [32, B] via masked sum."""
+        acc = None
+        for t in range(16):
+            m = (w == t).astype(jnp.int32)
+            term = tab_ref[t] * m
+            acc = term if acc is None else acc + term
+        return (acc[0:LIMBS], acc[LIMBS:2 * LIMBS],
+                acc[2 * LIMBS:3 * LIMBS], acc[3 * LIMBS:])
+
+    def select_b_table(w):
+        coords = []
+        for cix in range(4):
+            acc = None
+            for t in range(16):
+                m = (w == t).astype(jnp.int32)
+                term = b_tab[t, cix] * m
+                acc = term if acc is None else acc + term
+            coords.append(acc)
+        return tuple(coords)
+
+    def ladder_body(j, acc):
+        for _ in range(4):
+            acc = _ext_double(acc)
+        w = (_WINDOWS - 1) - j
+        # dynamic REF reads (pl.ds) — dynamic_slice on values has no
+        # Mosaic TPU lowering
+        sw = swin_ref[pl.ds(w, 1)]
+        kw = kwin_ref[pl.ds(w, 1)]
+        acc = _ext_add(acc, select_b_table(sw), two_d)
+        acc = _ext_add(acc, select_lane_table(kw), two_d)
+        return acc
+
+    acc = lax.fori_loop(0, _WINDOWS, ladder_body,
+                        (zero, one, one, zero))
+
+    # subtract R, clear cofactor, identity test
+    nrt = _mul(-rx, ry)
+    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d)
+    for _ in range(3):
+        acc = _ext_double(acc)
+    X, Y, Z, _T = acc
+    ok = _is_zero(X, four_p) & _eq(Y, Z, four_p) & a_ok & r_ok
+    ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, B))
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block"))
+def _pallas_verify(a_cols, r_cols, s_win, k_win, interpret=False,
+                   block=BLOCK):
+    """a_cols, r_cols: [32, n] int32; s_win, k_win: [64, n] int32.
+    Returns ok [n] bool.  n must be a multiple of block (the
+    production path pads to BLOCK; tests run interpret mode with a
+    small block so the emulated kernel stays tractable)."""
+    n = a_cols.shape[1]
+    if n % block != 0:
+        raise ValueError(
+            f"lane count {n} must be a multiple of block {block} — "
+            "remainder lanes would never be written by the kernel")
+    grid = n // block
+    out = pl.pallas_call(
+        _kernel,
+        out_shape=jax.ShapeDtypeStruct((8, n), jnp.int32),
+        grid=(grid,),
+        in_specs=[
+            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((LIMBS, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_WINDOWS, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_WINDOWS, block), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_CONSTS_NP.shape[0], 1), lambda i: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((8, block), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((16, 4 * LIMBS, block), jnp.int32),
+        ],
+        interpret=interpret,
+    )(a_cols, r_cols, s_win, k_win, jnp.asarray(_CONSTS_NP))
+    return out[0] != 0
+
+
+def verify_cols(a_cols, r_cols, s_win, k_win, interpret=False,
+                block=BLOCK):
+    return _pallas_verify(a_cols, r_cols, s_win, k_win,
+                          interpret=interpret, block=block)

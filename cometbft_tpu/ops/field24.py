@@ -1,0 +1,136 @@
+"""GF(2^255 - 19) in 24 balanced limbs with an (11,11,10)-bit cycle.
+
+The radix schedule for the second-generation Pallas kernel
+(ed25519_pallas.py).  Design, from the r3 cost model
+(KERNEL_NOTES.md): the 32x8-bit kernel spends 2048 of its ~3150
+per-lane ops in the 1024-MAC limb convolution; a bigger radix cuts the
+MAC count quadratically as long as every accumulated sum stays inside
+int32 (the VPU lane width).
+
+Why THIS schedule and not 22x12-bit (the first sketch in the model):
+
+  * Limb sizes cycle (11, 11, 10), eight times — 256 bits total, so
+    the carry out of limb 23 folds back into limb 0 with weight
+    2^256 mod p = 38, exactly like the byte kernel (2^256 = 2p + 38).
+  * The off-grid corrections are SEPARABLE.  With bit offsets
+    s_i = ceil(32*i/3), the product a_i*b_j carries an extra factor
+    2^(s_i + s_j - s_{i+j}) which depends only on (i mod 3, j mod 3):
+    it is 2 iff (i mod 3) + (j mod 3) >= 3.  So the convolution still
+    runs as 24 uniform slab MACs — row i just selects one of three
+    pre-scaled copies of b (plain / residue-2 doubled / residue-1,2
+    doubled) and their 38-folded counterparts.  A 22x12 schedule has
+    no such structure (the correction is a dense 22x22 matrix) and its
+    worst-case accumulator overflows int32 by ~0.7 bits.
+  * Balanced (signed, round-to-nearest carry) limbs: |limb| <= 2^10
+    for 11-bit positions, 2^9 for 10-bit ones.  Worst-case MAC
+    accumulation: 24 terms * (1026 * 1026*2*38) ~ 1.92e9 < 2^31,
+    with one normalizing carry pass applied to each multiplier input.
+
+Reference seam: crypto/ed25519/ed25519.go:189-222 (BatchVerifier);
+this module is the host-side mirror (converters + golden ops) used by
+the kernel's constant tables and by the unit tests.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+P = 2**255 - 19
+LIMBS = 24
+FOLD = 38                       # 2^256 mod p  (2^256 = 2p + 38)
+
+# bit offsets s_i = ceil(32*i/3); sizes cycle (11, 11, 10)
+OFFSETS = [(32 * i + 2) // 3 for i in range(LIMBS + 1)]
+SIZES = [OFFSETS[i + 1] - OFFSETS[i] for i in range(LIMBS)]
+assert OFFSETS[LIMBS] == 256 and set(SIZES) == {10, 11}
+
+# doubling pattern: product (i, j) needs x2 iff (i%3) + (j%3) >= 3
+PAT_R1 = np.array([2 if j % 3 == 2 else 1 for j in range(LIMBS)],
+                  np.int32)      # rows i with i%3 == 1
+PAT_R2 = np.array([2 if j % 3 >= 1 else 1 for j in range(LIMBS)],
+                  np.int32)      # rows i with i%3 == 2
+
+
+def to_limbs(x: int) -> np.ndarray:
+    """python int -> 24 canonical (unsigned) digits, int32."""
+    return _digits_raw(x % P)
+
+
+def from_limbs(a) -> int:
+    """limb array (any redundancy, signed ok) -> int mod p."""
+    limbs = np.asarray(a, dtype=np.int64).reshape(-1)
+    val = 0
+    for i, limb in enumerate(limbs):
+        val += int(limb) << OFFSETS[i]
+    return val % P
+
+
+def _digits_raw(x: int) -> np.ndarray:
+    """Digit rows of a value < 2^256 WITHOUT mod-p reduction (to_limbs
+    reduces first, which would turn p itself into zeros)."""
+    out = np.zeros(LIMBS, np.int32)
+    for i in range(LIMBS):
+        out[i] = (x >> OFFSETS[i]) & ((1 << SIZES[i]) - 1)
+    return out
+
+
+# canonical digit rows used by the kernel's exact comparisons
+P_DIGITS = _digits_raw(P)
+TWO_P_DIGITS = _digits_raw(2 * P)
+assert from_limbs(TWO_P_DIGITS) == 0          # 2p ≡ 0, fits 256 bits
+FOUR_P_DIGITS = 2 * TWO_P_DIGITS              # redundant, limbs < 2^12
+
+
+def carry(x: np.ndarray) -> np.ndarray:
+    """One balanced parallel carry pass (golden model of the kernel's
+    _carry): round-to-nearest split per position, top carry folds at
+    38.  x: [..., 24] int64-safe."""
+    x = np.asarray(x, np.int64)
+    c = np.empty_like(x)
+    lo = np.empty_like(x)
+    for i in range(LIMBS):
+        t = SIZES[i]
+        h = 1 << (t - 1)
+        ci = (x[..., i] + h) >> t
+        c[..., i] = ci
+        lo[..., i] = x[..., i] - (ci << t)
+    out = lo.copy()
+    out[..., 1:] += c[..., :-1]
+    out[..., 0] += FOLD * c[..., -1]
+    return out
+
+
+def mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Golden-model field multiply mirroring the kernel's slab/variant
+    structure (incl. the exact int32-range assertion the kernel's
+    bounds analysis claims)."""
+    a = carry(np.asarray(a, np.int64))
+    b = carry(np.asarray(b, np.int64))
+    v = [b, b * PAT_R1, b * PAT_R2]
+    w = [x * FOLD for x in v]
+    acc = np.zeros(a.shape[:-1] + (LIMBS,), np.int64)
+    for i in range(LIMBS):
+        sel_v, sel_w = v[i % 3], w[i % 3]
+        for j in range(LIMBS):
+            k = i + j
+            term = a[..., i] * (sel_v[..., j] if k < LIMBS
+                                else sel_w[..., j])
+            acc[..., k % LIMBS] += term
+    assert np.abs(acc).max() < 2**31, "int32 accumulator overflow"
+    return carry(carry(acc))
+
+
+def bytes_to_limbs(b: np.ndarray) -> np.ndarray:
+    """[..., 32] byte values -> [..., 24] digits (golden model of the
+    kernel's in-VMEM conversion)."""
+    b = np.asarray(b, np.int64)
+    out = np.zeros(b.shape[:-1] + (LIMBS,), np.int64)
+    for i in range(LIMBS):
+        s, t = OFFSETS[i], SIZES[i]
+        b0, sh = s >> 3, s & 7
+        acc = b[..., b0] >> sh
+        if sh + t > 8:
+            acc = acc | (b[..., b0 + 1] << (8 - sh))
+        if sh + t > 16 and b0 + 2 < 32:
+            acc = acc | (b[..., b0 + 2] << (16 - sh))
+        out[..., i] = acc & ((1 << t) - 1)
+    return out

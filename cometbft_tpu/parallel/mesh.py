@@ -41,8 +41,9 @@ def _sharded_verify_fn(ndev: int, kernel: str, interpret: bool,
     body is the selected kernel.  Cached per configuration — the jit
     itself caches per shape."""
     mesh = make_mesh(ndev)
-    if kernel == "pallas":
-        from ..ops import ed25519_pallas as ep
+    if kernel.startswith("pallas"):
+        from ..ops.ed25519_jax import _pallas_module
+        ep = _pallas_module(kernel)
 
         def body(a, r, s, k):
             return ep.verify_cols(
@@ -74,9 +75,9 @@ def verify_sharded(a_b, r_b, s_win, k_win, *, ndev: int,
     Returns the exact per-lane ok mask for the original m lanes."""
     m = a_b.shape[0]
     shard = -(-m // ndev)
-    if kernel == "pallas":
-        from ..ops import ed25519_pallas as ep
-        block = block or ep.BLOCK       # normalize the cache key
+    if kernel.startswith("pallas"):
+        from ..ops.ed25519_jax import _pallas_module
+        block = block or _pallas_module(kernel).BLOCK
         shard = -(-shard // block) * block
     else:
         interpret, block = False, 0     # ignored by the xla body

@@ -1,0 +1,103 @@
+"""Unit tests for the 24-limb balanced radix (ops/field24.py) — the
+second-generation Pallas kernel's field arithmetic.  The golden model
+here mirrors the kernel's slab/variant structure exactly, so these
+tests pin the schedule, the separable doubling pattern, the int32
+accumulator bound, and the carry/fold semantics without paying a
+Mosaic interpret run (the kernel itself is covered by the -m kernel
+suite in test_ops_ed25519.py).
+"""
+import random
+
+import numpy as np
+import pytest
+
+from cometbft_tpu.ops import field24 as f24
+
+
+class TestSchedule:
+    def test_offsets_and_sizes(self):
+        assert f24.OFFSETS[0] == 0
+        assert f24.OFFSETS[f24.LIMBS] == 256
+        assert set(f24.SIZES) == {10, 11}
+        # (11, 11, 10) cycle
+        for i, t in enumerate(f24.SIZES):
+            assert t == (11, 11, 10)[i % 3]
+
+    def test_p_digit_rows_are_raw_not_reduced(self):
+        # regression: to_limbs reduces mod p, which silently turned
+        # P_DIGITS into zeros and disarmed canonical's subtract-p
+        assert f24.P_DIGITS.sum() > 0
+        assert f24.from_limbs(f24.P_DIGITS) == 0          # ≡ 0 mod p
+        val = sum(int(v) << f24.OFFSETS[i]
+                  for i, v in enumerate(f24.P_DIGITS))
+        assert val == f24.P
+        val2 = sum(int(v) << f24.OFFSETS[i]
+                   for i, v in enumerate(f24.TWO_P_DIGITS))
+        assert val2 == 2 * f24.P
+
+    def test_doubling_pattern_matches_offset_identity(self):
+        # 2^(s_i + s_j - s_{(i+j) mod 24} [- 256 if wrapped]) must be
+        # exactly the residue rule the kernel uses
+        for i in range(f24.LIMBS):
+            for j in range(f24.LIMBS):
+                k = i + j
+                e = f24.OFFSETS[i] + f24.OFFSETS[j]
+                if k >= f24.LIMBS:
+                    e -= 256
+                e -= f24.OFFSETS[k % f24.LIMBS]
+                want = 2 if (i % 3) + (j % 3) >= 3 else 1
+                assert 2**e == want, (i, j)
+
+
+class TestArithmetic:
+    def test_roundtrip(self):
+        random.seed(0)
+        for _ in range(100):
+            x = random.randrange(f24.P)
+            assert f24.from_limbs(f24.to_limbs(x)) == x
+
+    def test_carry_preserves_value_and_bounds(self):
+        rng = np.random.default_rng(1)
+        for _ in range(100):
+            a = rng.integers(-2**28, 2**28, size=24)
+            v = f24.from_limbs(a)
+            c = f24.carry(a)
+            assert f24.from_limbs(c) == v
+        # post-mul-sized input settles to resting bounds in 2 passes
+        a = rng.integers(-9 * 10**8, 9 * 10**8, size=24)
+        c = f24.carry(f24.carry(a))
+        assert f24.from_limbs(c) == f24.from_limbs(a)
+
+    @pytest.mark.parametrize("redundant", [False, True])
+    def test_mul_matches_int_math(self, redundant):
+        random.seed(2)
+        for _ in range(50):
+            x = random.randrange(f24.P)
+            y = random.randrange(f24.P)
+            a = f24.to_limbs(x).astype(np.int64)
+            b = f24.to_limbs(y).astype(np.int64)
+            if redundant:
+                # lazy two-term sums, like the kernel's ext-add inputs
+                z = random.randrange(f24.P)
+                a = a + f24.to_limbs(z) - f24.to_limbs(z)
+                b = b - f24.to_limbs(0)
+            r = f24.mul(a, b)       # asserts the int32 bound inside
+            assert f24.from_limbs(r) == x * y % f24.P
+
+    def test_mul_worst_case_magnitude_stays_int32(self):
+        # all limbs at the lazy-sum maximum: the in-model assertion
+        # (|acc| < 2^31) is the kernel's overflow-safety proof
+        worst = np.full(24, 2**11 - 1, np.int64) * 2
+        r = f24.mul(worst, -worst)
+        assert f24.from_limbs(r) == \
+            f24.from_limbs(worst) * f24.from_limbs(-worst) % f24.P
+
+    def test_bytes_to_limbs_exact(self):
+        random.seed(3)
+        for _ in range(100):
+            x = random.randrange(2**256)
+            b = np.frombuffer(x.to_bytes(32, "little"), np.uint8)
+            digits = f24.bytes_to_limbs(b)
+            val = sum(int(v) << f24.OFFSETS[i]
+                      for i, v in enumerate(digits))
+            assert val == x

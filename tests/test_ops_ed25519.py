@@ -190,15 +190,15 @@ class TestShardedTally:
         assert int(count) == sum(golden)
 
 
-def _pallas_verify_items(items, block=8):
-    """Run the Pallas kernel in interpret mode through the production
+def _pallas_verify_items(items, block=8, kernel="pallas"):
+    """Run a Pallas kernel in interpret mode through the production
     prep + dispatch path (ops/ed25519_jax.py), with a small block so
     the emulated kernel stays tractable."""
     n = len(items)
     m = -(-n // block) * block
     a_b, r_b, s_win, k_win, pre_bad = ej.prep_arrays(items, m)
     return ej._dispatch(n, a_b, r_b, s_win, k_win, pre_bad,
-                        kernel="pallas", interpret=True,
+                        kernel=kernel, interpret=True,
                         block=block).tolist()
 
 
@@ -347,3 +347,16 @@ class TestPallasMultiBlock:
             golden.append(ref.verify(pub, msg, sig))
         assert _pallas_verify_items(items, block=8) == golden
         assert golden[3] is False and golden[11] is False
+
+
+class TestPallas8Fallback:
+    """The first-generation 32x8-bit kernel stays correct behind
+    COMETBFT_TPU_KERNEL=pallas8 (one smoke case; its full parity
+    history is r3's suite — the 24-limb kernel above inherits it)."""
+
+    def test_valid_and_corrupted(self):
+        pub, msg, sig = _sig()
+        bad = sig[:10] + bytes([sig[10] ^ 0xFF]) + sig[11:]
+        assert _pallas_verify_items(
+            [(pub, msg, sig), (pub, msg, bad)],
+            kernel="pallas8") == [True, False]
