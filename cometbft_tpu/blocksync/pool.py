@@ -56,6 +56,24 @@ class BlockPool:
         self.requesters: dict[int, _Requester] = {}
         self._task: Optional[asyncio.Task] = None
         self.is_running = False
+        # event-driven requester loop (reference: the pool blocks on
+        # channel events, internal/blocksync/pool.go makeRequestersRoutine);
+        # a slow fallback tick covers the time-based timeout scan
+        self._wake = asyncio.Event()
+        # separate wakeup for the reactor's verify-then-apply loop
+        self._apply_wake = asyncio.Event()
+
+    def _wakeup(self) -> None:
+        self._wake.set()
+
+    async def wait_apply(self, timeout: float = 0.25) -> None:
+        """Park the apply loop until a block lands or the pool head
+        advances (fallback tick covers the caught-up transition)."""
+        try:
+            await asyncio.wait_for(self._apply_wake.wait(), timeout)
+        except asyncio.TimeoutError:
+            pass
+        self._apply_wake.clear()
 
     # ------------------------------------------------------------------
     def start(self) -> None:
@@ -77,6 +95,7 @@ class BlockPool:
             p = _PoolPeer(peer_id=peer_id)
             self.peers[peer_id] = p
         p.base, p.height = base, height
+        self._wakeup()                    # new capacity / taller peer
 
     def remove_peer(self, peer_id: str) -> None:
         self.peers.pop(peer_id, None)
@@ -84,6 +103,7 @@ class BlockPool:
             if r.peer_id == peer_id and r.block is None:
                 r.peer_id = ""
                 r.requested_at = 0.0
+        self._wakeup()                    # orphaned requesters to reassign
 
     def max_peer_height(self) -> int:
         return max((p.height for p in self.peers.values()), default=0)
@@ -112,6 +132,8 @@ class BlockPool:
         p = self.peers.get(peer_id)
         if p is not None and p.num_pending > 0:
             p.num_pending -= 1
+        self._wakeup()                    # freed per-peer capacity
+        self._apply_wake.set()            # maybe two blocks ready now
 
     def redo_request(self, height: int, reason: str) -> None:
         """Block at `height` failed verification: ban the sender and
@@ -126,6 +148,7 @@ class BlockPool:
         r.block = None
         r.ext_commit = None
         r.requested_at = 0.0
+        self._wakeup()
 
     def peek_two_blocks(self):
         """(first, second, first_ext_commit) at pool.height and +1."""
@@ -139,6 +162,8 @@ class BlockPool:
         """First block was applied: advance (reference: PopRequest)."""
         self.requesters.pop(self.height, None)
         self.height += 1
+        self._wakeup()                    # room for a new requester
+        self._apply_wake.set()            # next pair may be complete
 
     # ------------------------------------------------------------------
     async def _make_requesters_routine(self) -> None:
@@ -146,7 +171,11 @@ class BlockPool:
             while self.is_running:
                 self._retry_timeouts()
                 self._spawn_requesters()
-                await asyncio.sleep(0.01)
+                try:
+                    await asyncio.wait_for(self._wake.wait(), 0.25)
+                except asyncio.TimeoutError:
+                    pass                  # fallback tick: timeout scan
+                self._wake.clear()
         except asyncio.CancelledError:
             raise
 

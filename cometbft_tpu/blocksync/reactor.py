@@ -188,10 +188,12 @@ class BlocksyncReactor(Reactor):
         caught_up_since: float = 0.0
         try:
             while True:
-                await asyncio.sleep(0.01)
                 pool = self.pool
                 if pool is None:
                     return
+                # park until a block arrives / the head advances; the
+                # 250ms fallback drives the caught-up grace check
+                await pool.wait_apply()
                 # caught up?  Require it to HOLD across more than one
                 # status-broadcast round so a single early low-height
                 # StatusResponse can't end the sync prematurely

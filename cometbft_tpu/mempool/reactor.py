@@ -70,13 +70,9 @@ class MempoolReactor(Reactor):
         so an unchanged pool costs nothing per tick (reference:
         per-peer broadcastTxRoutine over persistent lane iterators)."""
         sent: set[bytes] = set()
-        last_seq = -1
         try:
             while True:
-                if self.mempool._seq == last_seq:
-                    await asyncio.sleep(0.05)
-                    continue
-                progress = False
+                send_failed = False
                 for d in self.mempool._lane_txs.values():
                     for e in list(d.values()):
                         if e.key in sent or peer.id in e.senders:
@@ -84,7 +80,8 @@ class MempoolReactor(Reactor):
                         if peer.send(MEMPOOL_CHANNEL, encode(
                                 MESSAGE, {"txs": {"txs": [e.tx]}})):
                             sent.add(e.key)
-                            progress = True
+                        else:
+                            send_failed = True
                 last_seq = self.mempool._seq
                 # bound the dedup set by live pool content
                 if len(sent) > 4 * max(1, self.mempool.size()):
@@ -92,7 +89,14 @@ class MempoolReactor(Reactor):
                             self.mempool._lane_txs.values()
                             for e in d.values()}
                     sent &= live
-                await asyncio.sleep(0.02 if progress else 0.05)
+                if send_failed:
+                    # peer send-queue backpressure: retry on a timer
+                    await asyncio.sleep(0.05)
+                else:
+                    # park until the pool appends (clist-wait analog);
+                    # the call returns immediately if _seq already
+                    # moved during the scan above
+                    await self.mempool.wait_for_change(last_seq)
         except asyncio.CancelledError:
             raise
         except Exception as e:

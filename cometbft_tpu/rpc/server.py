@@ -131,14 +131,27 @@ class RPCServer:
         if http_method == "POST":
             try:
                 req = json.loads(body or b"{}")
-            except json.JSONDecodeError as e:
+            except (json.JSONDecodeError, UnicodeDecodeError) as e:
+                # invalid UTF-8 raises UnicodeDecodeError, not
+                # JSONDecodeError — both are the client's parse error,
+                # not a server crash (found by the input fuzzer)
                 return _err_response(None, -32700,
                                      "Parse error", str(e))
             if isinstance(req, list):
+                if not req:
+                    # JSON-RPC 2.0: an empty batch is itself invalid
+                    # and gets a single error object, not []
+                    return _err_response(None, -32600,
+                                         "Invalid request",
+                                         "empty batch")
                 return [await self._call_one(r) for r in req]
             return await self._call_one(req)
         # URI over GET: /method?param=value
-        parts = urlsplit(target)
+        try:
+            parts = urlsplit(target)
+        except ValueError as e:
+            # e.g. "//[" -> "Invalid IPv6 URL" (found by the fuzzer)
+            return _err_response(None, -32700, "Parse error", str(e))
         name = parts.path.lstrip("/")
         if not name:
             return _err_response(
@@ -149,6 +162,12 @@ class RPCServer:
         return await self._call(name, params, rpc_id=-1)
 
     async def _call_one(self, req: dict) -> dict:
+        if not isinstance(req, dict):
+            # valid JSON that isn't a request object (e.g. `1`,
+            # `"str"`, or such an element inside a batch) — JSON-RPC
+            # Invalid Request, not a server crash (found by the fuzzer)
+            return _err_response(None, -32600, "Invalid request",
+                                 "request must be an object")
         rpc_id = req.get("id")
         name = req.get("method", "")
         params = req.get("params") or {}
