@@ -329,6 +329,11 @@ class KVStoreApplication(abci.Application):
         if self.snapshot_interval > 0 and self._height > 0 and \
                 self._height % self.snapshot_interval == 0:
             self._snapshots[self._height] = self._serialize_state()
+            # keep a bounded window (reference: the e2e app retains a
+            # small recent set) — each entry is a full state copy, so
+            # an unpruned dict grows without bound on long-lived nodes
+            while len(self._snapshots) > 5:
+                del self._snapshots[min(self._snapshots)]
         resp = abci.CommitResponse()
         if self.retain_blocks > 0 and self._height >= self.retain_blocks:
             resp.retain_height = self._height - self.retain_blocks + 1

@@ -70,8 +70,14 @@ class MempoolReactor(Reactor):
         so an unchanged pool costs nothing per tick (reference:
         per-peer broadcastTxRoutine over persistent lane iterators)."""
         sent: set[bytes] = set()
+        last_seq = -1
         try:
             while True:
+                if self.mempool._seq == last_seq:
+                    # fallback-timeout wakeup with no append since the
+                    # last scan: don't re-walk a large quiet pool
+                    await self.mempool.wait_for_change(last_seq)
+                    continue
                 send_failed = False
                 for d in self.mempool._lane_txs.values():
                     for e in list(d.values()):
@@ -90,8 +96,10 @@ class MempoolReactor(Reactor):
                             for e in d.values()}
                     sent &= live
                 if send_failed:
-                    # peer send-queue backpressure: retry on a timer
+                    # peer send-queue backpressure: retry on a timer;
+                    # reset the cursor so the retry actually rescans
                     await asyncio.sleep(0.05)
+                    last_seq = -1
                 else:
                     # park until the pool appends (clist-wait analog);
                     # the call returns immediately if _seq already

@@ -107,8 +107,12 @@ class Node:
         if app is None and config.base.abci in ("builtin",
                                                 "builtin_unsync"):
             if config.base.proxy_app in ("kvstore", "persistent_kvstore"):
+                # snapshots on by default so any builtin-kvstore node
+                # can serve statesync joiners (reference: e2e kvstore
+                # manifests set SnapshotInterval; snapshots are cheap)
                 app = KVStoreApplication(
-                    db=new_db("app", backend, db_dir))
+                    db=new_db("app", backend, db_dir),
+                    snapshot_interval=10)
             else:
                 raise NodeError(
                     f"unknown proxy_app {config.base.proxy_app!r} "

@@ -83,12 +83,21 @@ class State:
     # ------------------------------------------------------------------
     def block_time(self, height: int, last_commit: Commit) -> Timestamp:
         """The consensus-mandated block time (reference: state.go
-        MakeBlock): genesis time at the initial height; now() under
-        PBTS; otherwise the BFT-time weighted median of LastCommit."""
-        if height == self.initial_height:
-            return self.last_block_time
+        MakeBlock:252-260): now() under PBTS — INCLUDING the initial
+        height; genesis time at the initial height otherwise; else the
+        BFT-time weighted median of LastCommit.
+
+        The PBTS check must come first: with it second, a PBTS chain
+        whose nodes boot more than message_delay after the genesis
+        timestamp proposes height 1 with the genesis time, every
+        validator finds the proposal untimely, and the net churns
+        rounds at height 1 until the adaptive delay (+10%/round)
+        catches up with the boot lag — observed live as a 16-node
+        process net stuck for 20+ rounds."""
         if self.consensus_params.feature.pbts_enabled(height):
             return Timestamp.now()
+        if height == self.initial_height:
+            return self.last_block_time
         return last_commit.median_time(self.last_validators)
 
     def make_block(self, height: int, txs: list[bytes],

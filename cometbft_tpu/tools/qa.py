@@ -56,6 +56,14 @@ class WindowResult:
     latency_p50_s: float = 0.0
     latency_p90_s: float = 0.0
     latency_max_s: float = 0.0
+    # per-window resource series (process mode; reference QA method
+    # tables: CometBFT-QA-v1.md:318-334 record RSS/CPU per node)
+    rss_avg_mb: float = 0.0
+    rss_max_mb: float = 0.0
+    cpu_total_pct: float = 0.0
+    fds_max: int = 0
+    mempool_avg: float = 0.0
+    mempool_max: int = 0
 
 
 @dataclass
@@ -80,15 +88,26 @@ class QAReport:
         return dataclasses.asdict(self)
 
 
-def _mk_cfg(root: str, name: str, zone: str) -> Config:
-    import socket
+# every port this run has handed out: the bind-then-close pattern can
+# yield the same port twice across many rapid allocations (observed as
+# a relay bind EADDRINUSE on the 70-relay full-scale run)
+_USED_PORTS: set = set()
 
-    def free_port() -> int:
+
+def _free_port() -> int:
+    import socket
+    while True:
         s = socket.socket()
         s.bind(("127.0.0.1", 0))
         p = s.getsockname()[1]
         s.close()
-        return p
+        if p not in _USED_PORTS:
+            _USED_PORTS.add(p)
+            return p
+
+
+def _mk_cfg(root: str, name: str, zone: str) -> Config:
+    free_port = _free_port
 
     home = os.path.join(root, name)
     cfg = Config()
@@ -179,11 +198,7 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
         ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
         if ms == 0:
             return target_port
-        import socket
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
+        port = _free_port()
         relay_specs.append(RelaySpec(
             port=port, target_host="127.0.0.1",
             target_port=target_port, delay_s=ms / 1000.0))
@@ -347,23 +362,472 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
     return report
 
 
+# --------------------------------------------------------------------------
+# process mode: every node is a separate OS process (real GC/scheduler/
+# fd isolation), sampled with psutil — the reference QA method's shape
+# (docs/references/qa/method.md; resource tables CometBFT-QA-v1.md).
+
+class _Sampler:
+    """2 s psutil sampler over the node subprocesses."""
+
+    def __init__(self, procs: dict):
+        import psutil
+        self._psutil = psutil
+        self.procs = procs
+        self.samples: list[tuple] = []     # (t, name, rss, cpu, fds)
+        self._task: Optional[asyncio.Task] = None
+        self._ps: dict = {}
+        for name, proc in procs.items():
+            try:
+                p = psutil.Process(proc.pid)
+                p.cpu_percent(None)        # prime the cpu counter
+                self._ps[name] = p
+            except psutil.Error:
+                pass
+
+    def track(self, name: str, proc) -> None:
+        try:
+            p = self._psutil.Process(proc.pid)
+            p.cpu_percent(None)
+            self._ps[name] = p
+        except self._psutil.Error:
+            pass
+
+    async def _run(self, interval: float) -> None:
+        while True:
+            t = time.monotonic()
+            for name, p in list(self._ps.items()):
+                try:
+                    with p.oneshot():
+                        self.samples.append(
+                            (t, name,
+                             p.memory_info().rss,
+                             p.cpu_percent(None),
+                             p.num_fds()))
+                except self._psutil.Error:
+                    pass                   # process died/restarting
+            await asyncio.sleep(interval)
+
+    def start(self, interval: float = 2.0) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run(interval))
+
+    def stop(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+
+    def window_stats(self, t0: float, t1: float) -> dict:
+        sel = [s for s in self.samples if t0 <= s[0] <= t1]
+        if not sel:
+            return {}
+        rss = [s[2] for s in sel]
+        # total CPU: sum of simultaneous per-process readings / ticks
+        ticks = sorted({round(s[0], 1) for s in sel})
+        cpu_by_tick = {}
+        for s in sel:
+            cpu_by_tick.setdefault(round(s[0], 1), 0.0)
+            cpu_by_tick[round(s[0], 1)] += s[3]
+        return {
+            "rss_avg_mb": sum(rss) / len(rss) / 1e6,
+            "rss_max_mb": max(rss) / 1e6,
+            "cpu_total_pct": (sum(cpu_by_tick.values()) /
+                              max(1, len(ticks))),
+            "fds_max": max(s[4] for s in sel),
+        }
+
+
+def _write_node_overrides(cfg: Config) -> None:
+    from ..confix import save_overrides
+    save_overrides(cfg.base.home, {
+        "base": {"moniker": cfg.base.moniker, "db_backend": "memdb",
+                 "log_level": "error", "proxy_app": "kvstore"},
+        "p2p": {"laddr": cfg.p2p.laddr,
+                "persistent_peers": cfg.p2p.persistent_peers,
+                "allow_duplicate_ip": True, "pex": False},
+        "rpc": {"laddr": cfg.rpc.laddr},
+        "consensus": {
+            "timeout_commit_ns": cfg.consensus.timeout_commit_ns},
+        "mempool": {"size": cfg.mempool.size},
+        "statesync": {
+            "enable": cfg.statesync.enable,
+            "rpc_servers": list(cfg.statesync.rpc_servers or []),
+            "trust_height": cfg.statesync.trust_height,
+            "trust_hash": cfg.statesync.trust_hash,
+            "discovery_time_ns": cfg.statesync.discovery_time_ns,
+        },
+    })
+
+
+_PRCTL = None                     # resolved lazily, in the parent
+
+
+def _spawn_node(home: str):
+    import subprocess
+    import sys
+    env = dict(os.environ)
+    env["COMETBFT_TPU_CRYPTO_BACKEND"] = "cpu"
+    # hard-clear any inherited platform pin (this environment exports
+    # JAX_PLATFORMS=axon): a QA node child must never dial the pooled
+    # TPU — with the pin inherited, children stalled claiming it and
+    # consensus churned at height 1 for the whole run
+    env["JAX_PLATFORMS"] = ""
+    repo_root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    env["PYTHONPATH"] = repo_root + os.pathsep + \
+        env.get("PYTHONPATH", "")
+
+    # resolve libc.prctl in the PARENT: importing/loading inside the
+    # post-fork pre-exec window can deadlock on runtime locks held by
+    # other threads (asyncio executor/getaddrinfo threads are live
+    # when the victim restart and joiner spawns happen)
+    global _PRCTL
+    if _PRCTL is None:
+        import ctypes
+        try:
+            _PRCTL = ctypes.CDLL("libc.so.6").prctl
+        except OSError:
+            _PRCTL = False
+
+    def _die_with_parent():
+        # a coordinator killed with SIGKILL never reaches its finally
+        # block; leaked node processes then poison the NEXT run (CPU
+        # contention + same chain-id p2p noise — observed as height-1
+        # round churn).  PR_SET_PDEATHSIG ties each child's life to
+        # the coordinator's.
+        if _PRCTL:
+            _PRCTL(1, 9)                  # PR_SET_PDEATHSIG, SIGKILL
+
+    return subprocess.Popen(
+        [sys.executable, "-m", "cometbft_tpu.cmd", "--home", home,
+         "start"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+        env=env, cwd=repo_root, preexec_fn=_die_with_parent)
+
+
+async def _rpc_ready(endpoint: str, budget: float) -> bool:
+    from ..rpc.client import HTTPClient
+    deadline = time.monotonic() + budget
+    while time.monotonic() < deadline:
+        try:
+            cli = HTTPClient(endpoint, timeout=5.0)
+            await cli.call("status")
+            return True
+        except Exception:
+            await asyncio.sleep(0.5)
+    return False
+
+
+async def _rpc_height(endpoint: str) -> int:
+    from ..rpc.client import HTTPClient
+    cli = HTTPClient(endpoint, timeout=10.0)
+    st = await cli.call("status")
+    return int(st["sync_info"]["latest_block_height"])
+
+
+async def run_qa_procs(outdir: str, n_validators: int = 12,
+                       n_full: int = 3, ghosts: int = 90,
+                       rates: tuple = (25, 50, 100, 200),
+                       window_s: float = 90.0) -> QAReport:
+    """The reference-method QA run: separate OS process per node,
+    90 s load windows, psutil resource series, mempool occupancy.
+
+    Reference: docs/references/qa/method.md (the 90 s window and
+    saturation-point procedure) and CometBFT-QA-v1.md:141-170 (result
+    tables this report mirrors).
+    """
+    from ..rpc.client import HTTPClient
+    from . import loadtime
+    from .manifest import Relay, RelaySpec, start_relay
+
+    report = QAReport()
+    names = [f"validator{i:02d}" for i in range(n_validators)] + \
+            [f"full{i:02d}" for i in range(n_full)]
+    zones = {name: ZONES[i % len(ZONES)]
+             for i, name in enumerate(names)}
+    cfgs = {name: _mk_cfg(outdir, name, zones[name])
+            for name in names}
+    joiner_cfg = _mk_cfg(outdir, "joiner", ZONES[0])
+
+    pvs = {}
+    for name in names + ["joiner"]:
+        cfg = cfgs.get(name, joiner_cfg)
+        pvs[name] = FilePV.generate(
+            cfg.base.path(cfg.base.priv_validator_key_file),
+            cfg.base.path(cfg.base.priv_validator_state_file))
+        NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
+    vals = [GenesisValidator(address=b"",
+                             pub_key=pvs[n].get_pub_key(), power=100)
+            for n in names[:n_validators]]
+    vals += _ghost_validators(ghosts)
+    doc = GenesisDoc(chain_id="qa-net", genesis_time=Timestamp.now(),
+                     validators=vals)
+    doc.consensus_params.validator.pub_key_types = [
+        "ed25519", "secp256k1"]
+    doc.consensus_params.feature.pbts_enable_height = 1
+    report.validators_total = len(vals)
+    report.validators_live = n_validators
+    report.nodes = len(names) + 1
+
+    node_ids = {}
+    for name in names + ["joiner"]:
+        cfg = cfgs.get(name, joiner_cfg)
+        doc.save_as(cfg.base.path(cfg.base.genesis_file))
+        node_ids[name] = NodeKey.load_or_gen(
+            cfg.base.path(cfg.base.node_key_file)).id
+    relay_specs: list[RelaySpec] = []
+
+    def link_port(a: str, b: str, target_port: int) -> int:
+        za, zb = zones.get(a, ZONES[0]), zones.get(b, ZONES[0])
+        key = f"{za}:{zb}" if f"{za}:{zb}" in ZONE_LATENCY_MS \
+            else f"{zb}:{za}"
+        ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
+        if ms == 0:
+            return target_port
+        port = _free_port()
+        relay_specs.append(RelaySpec(
+            port=port, target_host="127.0.0.1",
+            target_port=target_port, delay_s=ms / 1000.0))
+        return port
+
+    p2p_port = {name: int(cfgs[name].p2p.laddr.rsplit(":", 1)[1])
+                for name in names}
+    for i, name in enumerate(names):
+        peers = []
+        for other in names[i + 1:]:
+            peers.append(f"{node_ids[other]}@127.0.0.1:"
+                         f"{link_port(name, other, p2p_port[other])}")
+        cfgs[name].p2p.persistent_peers = ",".join(peers)
+        _write_node_overrides(cfgs[name])
+
+    rpc_ep = {name: "http://" + cfgs[name].rpc.laddr[len("tcp://"):]
+              for name in names}
+    endpoints = [rpc_ep[n] for n in names[:3]]
+
+    procs: dict = {}
+    relays: list[Relay] = []
+    sampler: Optional[_Sampler] = None
+    try:
+        for spec in relay_specs:
+            relays.append(await start_relay(spec))
+        for name in names:
+            procs[name] = _spawn_node(cfgs[name].base.home)
+        ready = await asyncio.gather(
+            *(_rpc_ready(rpc_ep[n], 240.0) for n in names))
+        if not all(ready):
+            raise TimeoutError("not all nodes became RPC-ready")
+        sampler = _Sampler(procs)
+        sampler.start()
+        logger.info("process net booted", nodes=len(procs),
+                    relays=len(relays))
+
+        async def wait_height(h: int, budget: float, eps=None):
+            eps = eps or [endpoints[0]]
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                hs = await asyncio.gather(
+                    *(_rpc_height(e) for e in eps),
+                    return_exceptions=True)
+                if all(isinstance(x, int) and x >= h for x in hs):
+                    return
+                await asyncio.sleep(0.5)
+            raise TimeoutError(f"net stuck below {h}")
+
+        await wait_height(2, 180.0)
+
+        async def occupancy_series(stopper: asyncio.Event, out: list):
+            cli = HTTPClient(endpoints[0], timeout=10.0)
+            while not stopper.is_set():
+                try:
+                    r = await cli.call("num_unconfirmed_txs")
+                    out.append(int(r.get("n_txs", r.get(
+                        "total", 0)) or 0))
+                except Exception:
+                    pass
+                await asyncio.sleep(2.0)
+
+        for wi, rate in enumerate(rates):
+            occ: list[int] = []
+            stop_occ = asyncio.Event()
+            occ_task = asyncio.get_running_loop().create_task(
+                occupancy_series(stop_occ, occ))
+            t0 = time.monotonic()
+            res = await loadtime.generate(
+                endpoints, rate=rate, connections=1,
+                duration_s=window_s, size=256, method="async")
+            h0 = await _rpc_height(endpoints[0])
+            await wait_height(h0 + 2, 90.0)
+            t1 = time.monotonic()
+            stop_occ.set()
+            await occ_task
+            rep = await loadtime.report(
+                endpoints[0], experiment_id=res.experiment_id)
+            w = WindowResult(
+                rate=rate, duration_s=window_s, sent=res.sent,
+                accepted=res.accepted, committed=rep.latency.count,
+                tx_per_s=rep.latency.count / window_s,
+                latency_p50_s=rep.latency.p50_s,
+                latency_p90_s=rep.latency.p90_s,
+                latency_max_s=rep.latency.max_s,
+                mempool_avg=(sum(occ) / len(occ)) if occ else 0.0,
+                mempool_max=max(occ) if occ else 0)
+            for k, v in sampler.window_stats(t0, t1).items():
+                setattr(w, k, v)
+            report.windows.append(w)
+            logger.info(
+                "load window done", rate=rate, committed=w.committed,
+                tx_s=round(w.tx_per_s, 1),
+                p50=round(w.latency_p50_s, 3),
+                rss_max_mb=round(w.rss_max_mb, 1),
+                cpu_pct=round(w.cpu_total_pct, 1),
+                mempool_max=w.mempool_max)
+            if w.tx_per_s >= 0.8 * rate:
+                report.saturation_rate = rate
+
+            if wi == 1:
+                # kill -9 + restart one validator (reference:
+                # perturb.go kill); memdb state is lost, so recovery
+                # exercises a real from-scratch blocksync
+                victim = names[n_validators - 1]
+                report.perturbation = f"{victim}:kill9-restart"
+                procs[victim].kill()
+                procs[victim].wait(timeout=30)
+                await asyncio.sleep(0.5)
+                procs[victim] = _spawn_node(cfgs[victim].base.home)
+                sampler.track(victim, procs[victim])
+                if not await _rpc_ready(rpc_ep[victim], 240.0):
+                    raise TimeoutError("victim never came back")
+                h = await _rpc_height(endpoints[0])
+                await wait_height(h + 2, 240.0,
+                                  eps=[rpc_ep[victim]])
+                report.perturbed_recovered = True
+                logger.info("perturbed node recovered",
+                            victim=victim)
+
+        # --- statesync late joiner (own process) --------------------
+        cli = HTTPClient(endpoints[0], timeout=30.0)
+        th = max(1, await _rpc_height(endpoints[0]) - 8)
+        blk = await cli.call("block", height=str(th))
+        joiner_cfg.statesync.enable = True
+        joiner_cfg.statesync.rpc_servers = [endpoints[0],
+                                            endpoints[1]]
+        joiner_cfg.statesync.trust_height = th
+        joiner_cfg.statesync.trust_hash = blk["block_id"]["hash"]
+        joiner_cfg.statesync.discovery_time_ns = int(2e9)
+        joiner_cfg.p2p.persistent_peers = ",".join(
+            f"{node_ids[n]}@127.0.0.1:{p2p_port[n]}"
+            for n in names[:4])
+        _write_node_overrides(joiner_cfg)
+        target = await _rpc_height(endpoints[0])
+        procs["joiner"] = _spawn_node(joiner_cfg.base.home)
+        sampler.track("joiner", procs["joiner"])
+        joiner_ep = "http://" + \
+            joiner_cfg.rpc.laddr[len("tcp://"):]
+        if not await _rpc_ready(joiner_ep, 240.0):
+            raise TimeoutError("joiner RPC never came up")
+        await wait_height(target, 300.0, eps=[joiner_ep])
+        report.statesync_joiner_height = await _rpc_height(joiner_ep)
+        logger.info("statesync joiner caught up",
+                    height=report.statesync_joiner_height)
+
+        report.final_height = await _rpc_height(endpoints[0])
+
+        # --- block interval stats over RPC --------------------------
+        times = []
+        lo = 2
+        while lo <= report.final_height:
+            hi = min(lo + 19, report.final_height)
+            bc = await cli.call("blockchain", minHeight=str(lo),
+                                maxHeight=str(hi))
+            for meta in sorted(
+                    bc.get("block_metas", []),
+                    key=lambda m: int(m["header"]["height"])):
+                ts = meta["header"]["time"]
+                times.append((int(meta["header"]["height"]), ts))
+            lo = hi + 1
+        times.sort()
+
+        def _parse_ns(ts: str) -> float:
+            from ..types.timestamp import Timestamp
+            return Timestamp.from_rfc3339(ts).unix_ns() / 1e9
+
+        secs = [_parse_ns(t) for _, t in times]
+        intervals = [b - a for a, b in zip(secs, secs[1:])]
+        if intervals:
+            report.block_interval_avg_s = statistics.mean(intervals)
+            report.block_interval_std_s = (
+                statistics.pstdev(intervals)
+                if len(intervals) > 1 else 0.0)
+            report.block_interval_min_s = min(intervals)
+            report.block_interval_max_s = max(intervals)
+
+        # --- invariants over RPC (sampled heights) ------------------
+        check_eps = [rpc_ep[n] for n in names] + [joiner_ep]
+        for h in range(1, report.final_height + 1, 5):
+            want = None
+            for ep in check_eps:
+                c2 = HTTPClient(ep, timeout=15.0)
+                try:
+                    b = await c2.call("block", height=str(h))
+                except Exception:
+                    continue
+                pair = (b["block_id"]["hash"],
+                        b["block"]["header"]["app_hash"])
+                if want is None:
+                    want = pair
+                elif pair != want:
+                    report.mismatches.append(
+                        f"{ep}@{h}: hash/app_hash mismatch")
+    finally:
+        if sampler is not None:
+            sampler.stop()
+        for proc in procs.values():
+            try:
+                proc.terminate()
+            except Exception:
+                pass
+        for proc in procs.values():
+            try:
+                proc.wait(timeout=15)
+            except Exception:
+                try:
+                    proc.kill()
+                except Exception:
+                    pass
+        for r in relays:
+            r.close()
+        for r in relays:
+            await r.wait_closed()
+    return report
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser()
     ap.add_argument("--quick", action="store_true",
                     help="small shape for CI (6 nodes, 2 windows)")
+    ap.add_argument("--procs", action="store_true",
+                    help="one OS process per node + psutil resource "
+                         "series (the reference QA method's shape)")
+    ap.add_argument("--window", type=float, default=0.0)
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
     # --quick must never clobber the committed full-scale record
     out_path = args.out or (
-        "QA_quick.json" if args.quick else "QA_r03.json")
+        "QA_quick.json" if args.quick else "QA_r04.json")
     with tempfile.TemporaryDirectory() as d:
-        if args.quick:
+        if args.quick and args.procs:
+            rep = asyncio.run(run_qa_procs(
+                d, n_validators=4, n_full=1, ghosts=20,
+                rates=(25, 50), window_s=args.window or 10.0))
+        elif args.quick:
             rep = asyncio.run(run_qa(
                 d, n_validators=4, n_full=1, ghosts=20,
-                rates=(25, 50), window_s=8.0))
+                rates=(25, 50), window_s=args.window or 8.0))
+        elif args.procs:
+            rep = asyncio.run(run_qa_procs(
+                d, window_s=args.window or 90.0))
         else:
-            rep = asyncio.run(run_qa(d))
+            rep = asyncio.run(run_qa(d, window_s=args.window or 15.0))
     out = rep.to_dict()
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
