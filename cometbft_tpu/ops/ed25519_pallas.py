@@ -48,20 +48,21 @@ _WINDOWS = 64
 def _carry(x):
     """One balanced (round-to-nearest) carry pass, limb-major [24, B].
     The top carry folds into limb 0 at weight 38 and is immediately
-    split again (fold-settle) so limb 0 keeps its resting bound."""
-    cs, los = [], []
-    for i in range(LIMBS):
-        t = _SIZES[i]
-        c = (x[i:i + 1] + (1 << (t - 1))) >> t
-        cs.append(c)
-        los.append(x[i:i + 1] - (c << t))
-    f = cs[-1] * _FOLD
+    split again (fold-settle) so limb 0 keeps its resting bound.
+
+    Vectorized across the limb dimension: per-row ops on [1, B] slices
+    use one sublane of each (8, 128) int32 vreg — 1/8 of the VPU — so
+    a 24-row loop here costs ~8x what a full [24, B] op does (measured
+    on v5e: the row-sliced form put the whole kernel at ~126 ms for a
+    16k batch, ~3x the full-utilization prediction).  The (11, 11, 10)
+    size cycle makes the per-row shift a two-way select on i mod 3."""
+    m11 = (lax.broadcasted_iota(jnp.int32, (LIMBS, 1), 0) % 3) != 2
+    c = jnp.where(m11, (x + 1024) >> 11, (x + 512) >> 10)
+    lo = x - jnp.where(m11, c << 11, c << 10)
+    f = c[LIMBS - 1:] * _FOLD
     fc = (f + 1024) >> 11               # limb 0 is an 11-bit position
-    rows = [los[0] + (f - (fc << 11)),
-            los[1] + cs[0] + fc]
-    for i in range(2, LIMBS):
-        rows.append(los[i] + cs[i - 1])
-    return jnp.concatenate(rows, axis=0)
+    y = lo + jnp.concatenate([f - (fc << 11), c[:LIMBS - 1]], axis=0)
+    return jnp.concatenate([y[0:1], y[1:2] + fc, y[2:]], axis=0)
 
 
 def _norm(x, passes):

@@ -772,10 +772,22 @@ def _events_json(events) -> list:
         for a in e.attributes]} for e in events or []]
 
 
+class UriString(str):
+    """A quoted URI GET parameter.  The reference's URI handler treats
+    a quoted value as the raw string content — `tx="name=satoshi"`
+    submits the bytes `name=satoshi` — while JSON-RPC POST []byte
+    params are base64 (rpc/jsonrpc/server/http_uri_handler.go,
+    nonJSONStringToArg).  The server tags quoted URI params with this
+    type so decoders keep the two wire conventions apart."""
+
+
 def _decode_tx(tx) -> bytes:
-    """Txs arrive base64 (JSON-RPC) or 0x-hex (URI)."""
+    """Txs arrive base64 (JSON-RPC), 0x-hex (URI), or as a quoted
+    raw URI string."""
     if isinstance(tx, bytes):
         return tx
+    if isinstance(tx, UriString):
+        return str(tx).encode()
     if tx.startswith("0x"):
         return bytes.fromhex(tx[2:])
     return base64.b64decode(tx)
@@ -784,6 +796,8 @@ def _decode_tx(tx) -> bytes:
 def _decode_hex_or_str(v) -> bytes:
     if isinstance(v, bytes):
         return v
+    if isinstance(v, UriString):
+        return str(v).encode()
     if v.startswith("0x"):
         return bytes.fromhex(v[2:])
     return v.encode()

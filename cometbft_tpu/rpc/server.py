@@ -220,7 +220,8 @@ def _err_response(rpc_id, code: int, message: str,
 
 def _parse_uri_value(v: str):
     """URI params: 0x-hex → bytes-as-hex-string, quoted strings
-    unquoted (reference: http_uri_handler parsing)."""
+    unquoted and tagged as raw (reference: http_uri_handler parsing —
+    a quoted []byte param is the raw string content, not base64)."""
     if v.startswith('"') and v.endswith('"'):
-        return v[1:-1]
+        return core.UriString(v[1:-1])
     return v

@@ -107,15 +107,13 @@ def _free_port() -> int:
 
 
 def _mk_cfg(root: str, name: str, zone: str) -> Config:
-    free_port = _free_port
-
     home = os.path.join(root, name)
     cfg = Config()
     cfg.base.home = home
     cfg.base.moniker = name
     cfg.base.db_backend = "memdb"
-    cfg.p2p.laddr = f"tcp://127.0.0.1:{free_port()}"
-    cfg.rpc.laddr = f"tcp://127.0.0.1:{free_port()}"
+    cfg.p2p.laddr = f"tcp://127.0.0.1:{_free_port()}"
+    cfg.rpc.laddr = f"tcp://127.0.0.1:{_free_port()}"
     cfg.p2p.allow_duplicate_ip = True
     cfg.p2p.pex = False          # fixed topology under latency relays
     cfg.consensus.timeout_commit_ns = 200_000_000

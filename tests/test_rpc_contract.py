@@ -229,3 +229,61 @@ class TestRPCContract:
                 finally:
                     await node.stop()
         asyncio.run(run())
+
+
+class TestUriParamConventions:
+    """URI GET parameter decode semantics (reference:
+    rpc/jsonrpc/server/http_uri_handler.go nonJSONStringToArg): a
+    QUOTED value is the raw string content (`tx="name=satoshi"`
+    submits the bytes `name=satoshi`), 0x-prefixed is hex, and
+    JSON-RPC POST []byte params stay base64."""
+
+    def test_quoted_uri_tx_is_raw_bytes(self):
+        import base64 as b64
+
+        from cometbft_tpu.rpc import core as rpc_core
+        from cometbft_tpu.rpc.server import _parse_uri_value
+
+        v = _parse_uri_value('"name=satoshi"')
+        assert isinstance(v, rpc_core.UriString)
+        assert rpc_core._decode_tx(v) == b"name=satoshi"
+        # hex and base64 conventions unchanged
+        assert rpc_core._decode_tx("0x6162") == b"ab"
+        assert rpc_core._decode_tx(
+            b64.b64encode(b"posted").decode()) == b"posted"
+        # unquoted URI values are not tagged
+        assert not isinstance(_parse_uri_value("5"), rpc_core.UriString)
+
+    def test_quoted_tx_commits_over_http_get(self):
+        """End-to-end: the documented curl usage
+        broadcast_tx_commit?tx="k=v" commits and the value is
+        queryable (reference docs: kvstore quick-start)."""
+        import urllib.request
+
+        from cometbft_tpu.node.node import Node
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                node = Node(_make_node_cfg(d))
+                await node.start()
+                try:
+                    addr = node._rpc_server.listen_addr
+                    loop = asyncio.get_event_loop()
+
+                    async def fetch(path):
+                        url = f"http://{addr}{path}"
+                        raw = await loop.run_in_executor(
+                            None, lambda: urllib.request.urlopen(
+                                url, timeout=30).read())
+                        return json.loads(raw)
+
+                    res = await fetch(
+                        '/broadcast_tx_commit?tx=%22uriraw=yes%22')
+                    assert "error" not in res, res
+                    assert res["result"]["tx_result"]["code"] == 0
+                    q = await fetch('/abci_query?data=%22uriraw%22')
+                    val = q["result"]["response"]["value"]
+                    assert base64.b64decode(val) == b"yes"
+                finally:
+                    await node.stop()
+        asyncio.run(run())
