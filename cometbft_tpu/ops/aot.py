@@ -87,11 +87,12 @@ def load(kernel: str, m: int):
         return None
 
 
-def call(kernel: str, a, r, s_win, k_win):
+def call(kernel: str, a, r, s_w8, k_w8):
     """Run the exported kernel on the current default platform, or
-    return None when no artifact matches.  For 'xla', a/r are [m,32]
-    uint8; for 'pallas', [32,m] int32 columns."""
-    m = a.shape[1] if kernel == "pallas" else a.shape[0]
+    return None when no artifact matches.  Both kernels ship behind
+    the packed uint8 wire layout: a/r [m,32]u8, s/k [m,64]u8
+    (lane-major windows); the exported program unpacks on device."""
+    m = a.shape[0]
     exp = load(kernel, m)
     if exp is None:
         return None
@@ -102,7 +103,7 @@ def call(kernel: str, a, r, s_win, k_win):
     if platform == "cpu" and not _host_tag_matches():
         return None
     try:
-        return exp.call(a, r, s_win, k_win)
+        return exp.call(a, r, s_w8, k_w8)
     except Exception:
         return None
 
@@ -133,9 +134,9 @@ def generate(xla_buckets=None, pallas_buckets=None,
 
     for m in xla_buckets:
         a = jnp.asarray(np.zeros((m, 32), np.uint8))
-        win = jnp.asarray(np.zeros((64, m), np.int32))
-        exp = export.export(jax.jit(ej._verify_kernel),
-                            platforms=["tpu", "cpu"])(a, a, win, win)
+        w8 = jnp.asarray(np.zeros((m, 64), np.uint8))
+        exp = export.export(ej._jit_verify_packed,
+                            platforms=["tpu", "cpu"])(a, a, w8, w8)
         p = os.path.join(out_dir, f"ed25519_xla_{m}.jaxexport")
         with open(p, "wb") as f:
             f.write(exp.serialize())
@@ -143,15 +144,12 @@ def generate(xla_buckets=None, pallas_buckets=None,
         print(f"exported xla m={m}: {os.path.getsize(p)} bytes",
               file=sys.stderr)
 
-    from . import ed25519_pallas as ep
-
     for m in pallas_buckets:
-        cols = jnp.asarray(np.zeros((32, m), np.int32))
-        win = jnp.asarray(np.zeros((64, m), np.int32))
-        fn = jax.jit(functools.partial(ep.verify_cols,
-                                       interpret=False))
-        exp = export.export(fn, platforms=["tpu"])(cols, cols, win,
-                                                   win)
+        a = jnp.asarray(np.zeros((m, 32), np.uint8))
+        w8 = jnp.asarray(np.zeros((m, 64), np.uint8))
+        fn = jax.jit(functools.partial(ej._pallas_verify_packed,
+                                       kernel="pallas"))
+        exp = export.export(fn, platforms=["tpu"])(a, a, w8, w8)
         p = os.path.join(out_dir, f"ed25519_pallas_{m}.jaxexport")
         with open(p, "wb") as f:
             f.write(exp.serialize())

@@ -42,6 +42,17 @@ _OFFS = f24.OFFSETS
 BLOCK = 128                     # lanes per grid step
 _WINDOWS = 64
 
+def _carry_row_consts():
+    """Per-row carry constants ([24, 1], broadcast over lanes), built
+    from iota because Mosaic kernels cannot capture ndarray constants:
+    prescale m = 2^(11 - t_i) makes every row's rounding shift 11
+    bits; weight 2^t_i undoes the scale when reconstructing the low
+    part.  CSE collapses the repeats across carry passes."""
+    m10 = (lax.broadcasted_iota(jnp.int32, (LIMBS, 1), 0) % 3) == 2
+    prescale = jnp.where(m10, 2, 1)
+    weight = jnp.where(m10, 1 << 10, 1 << 11)
+    return prescale, weight
+
 
 # --- balanced carry / field multiply ---------------------------------------
 
@@ -54,11 +65,17 @@ def _carry(x):
     use one sublane of each (8, 128) int32 vreg — 1/8 of the VPU — so
     a 24-row loop here costs ~8x what a full [24, B] op does (measured
     on v5e: the row-sliced form put the whole kernel at ~126 ms for a
-    16k batch, ~3x the full-utilization prediction).  The (11, 11, 10)
-    size cycle makes the per-row shift a two-way select on i mod 3."""
-    m11 = (lax.broadcasted_iota(jnp.int32, (LIMBS, 1), 0) % 3) != 2
-    c = jnp.where(m11, (x + 1024) >> 11, (x + 512) >> 10)
-    lo = x - jnp.where(m11, c << 11, c << 10)
+    16k batch, ~3x the full-utilization prediction).
+
+    The per-row rounding shift uses the pre-scale trick instead of a
+    two-way select on the (11, 11, 10) size cycle: z = x·m with
+    m = 2^(11-t_i) ∈ {1, 2} makes every row an 11-bit shift, and
+    lo = x - c·2^t_i is a per-row constant multiply.  Bound:
+    |x| ≤ 0.93e9 (the conv output bound), so |z| ≤ 1.86e9 < 2^31 —
+    1.15x headroom on the doubled rows."""
+    prescale, weight = _carry_row_consts()
+    c = (x * prescale + 1024) >> 11
+    lo = x - c * weight
     f = c[LIMBS - 1:] * _FOLD
     fc = (f + 1024) >> 11               # limb 0 is an 11-bit position
     y = lo + jnp.concatenate([f - (fc << 11), c[:LIMBS - 1]], axis=0)
@@ -211,7 +228,7 @@ def _pow_p58(x, pats):
 
 # --- point ops (extended twisted Edwards, limb-major) ----------------------
 
-def _ext_add(p, q, two_d, pats):
+def _ext_add(p, q, two_d, pats, need_t=True):
     """Unified add (complete for a=-1)."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
@@ -223,12 +240,15 @@ def _ext_add(p, q, two_d, pats):
     ff = d - c
     g = d + c
     h = b + a
-    return (_mul(e, ff, pats), _mul(g, h, pats),
-            _mul(ff, g, pats), _mul(e, h, pats))
+    return (_mul(e, ff, pats), _mul(g, h, pats), _mul(ff, g, pats),
+            _mul(e, h, pats) if need_t else None)
 
 
-def _ext_double(p, pats):
-    """dbl-2008-hwcd, a=-1: 4 squarings + 4 products."""
+def _ext_double(p, pats, need_t=True):
+    """dbl-2008-hwcd, a=-1: 4 squarings + 4 products (3 when the
+    caller doesn't need the extended T coordinate — the formula never
+    reads T, so in a run of doublings only the last one, whose output
+    feeds an addition, has to produce it)."""
     _sqr = _make_sqr(pats)
     X1, Y1, Z1, _ = p
     a = _sqr(X1)
@@ -238,6 +258,25 @@ def _ext_double(p, pats):
     g = b - a
     ff = g - c
     h = -(a + b)
+    return (_mul(e, ff, pats), _mul(g, h, pats), _mul(ff, g, pats),
+            _mul(e, h, pats) if need_t else None)
+
+
+def _madd_affine(p, q3, pats):
+    """Mixed add of a projective-extended point and an AFFINE
+    precomputed entry (y-x, y+x, 2d·x·y) with Z2 = 1 — the constant
+    B table ships in this form, saving the Z1·Z2 and 2d·T2 products
+    of the unified add (madd-2008-hwcd shape): 7 field muls vs 9."""
+    X1, Y1, Z1, T1 = p
+    y2mx2, y2px2, dt2 = q3
+    a = _mul(Y1 - X1, y2mx2, pats)
+    b = _mul(Y1 + X1, y2px2, pats)
+    c = _mul(T1, dt2, pats)
+    d = Z1 + Z1                 # magnitude ~2x resting; _mul re-norms
+    e = b - a
+    ff = d - c
+    g = d + c
+    h = b + a
     return (_mul(e, ff, pats), _mul(g, h, pats),
             _mul(ff, g, pats), _mul(e, h, pats))
 
@@ -270,14 +309,15 @@ def _decompress(b, d_col, sqrt_m1, four_p, pats):
 # --- constant tables --------------------------------------------------------
 
 def _build_b_table_cols() -> np.ndarray:
-    """Constant i·B table, [16, 4, 24, 1]: (entry, coord, limb, bcast)."""
+    """Constant i·B table in affine-precomputed form, [16, 3, 24, 1]:
+    (entry, (y-x | y+x | 2d·x·y), limb, bcast) — the shape
+    _madd_affine consumes (entry 0 is the identity: (1, 1, 0))."""
     pts = [(0, 1)] + [ref.scalar_mult(i, ref.B) for i in range(1, 16)]
-    out = np.zeros((16, 4, LIMBS, 1), np.int32)
+    out = np.zeros((16, 3, LIMBS, 1), np.int32)
     for i, (x, y) in enumerate(pts):
-        out[i, 0, :, 0] = f24.to_limbs(x)
-        out[i, 1, :, 0] = f24.to_limbs(y)
-        out[i, 2, :, 0] = f24.to_limbs(1)
-        out[i, 3, :, 0] = f24.to_limbs(x * y % ref.P)
+        out[i, 0, :, 0] = f24.to_limbs((y - x) % ref.P)
+        out[i, 1, :, 0] = f24.to_limbs((y + x) % ref.P)
+        out[i, 2, :, 0] = f24.to_limbs(2 * ref.D * x * y % ref.P)
     return out
 
 
@@ -291,7 +331,7 @@ _CONSTS_NP = np.concatenate([
     f24.FOUR_P_DIGITS.reshape(LIMBS, 1).astype(np.int32),
     f24.PAT_R1.reshape(LIMBS, 1).astype(np.int32),
     f24.PAT_R2.reshape(LIMBS, 1).astype(np.int32),
-    _B_TABLE_NP.reshape(16 * 4 * LIMBS, 1),
+    _B_TABLE_NP.reshape(16 * 3 * LIMBS, 1),
 ], axis=0)
 
 
@@ -308,7 +348,7 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
     four_p = consts_ref[3 * LIMBS:4 * LIMBS]
     pats = (consts_ref[4 * LIMBS:5 * LIMBS],
             consts_ref[5 * LIMBS:6 * LIMBS])
-    b_tab = consts_ref[6 * LIMBS:].reshape(16, 4, LIMBS, 1)
+    b_tab = consts_ref[6 * LIMBS:].reshape(16, 3, LIMBS, 1)
 
     ax, ay, a_ok = _decompress(a_b, d_col, sqrt_m1, four_p, pats)
     rx, ry, r_ok = _decompress(r_b, d_col, sqrt_m1, four_p, pats)
@@ -348,7 +388,7 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     def select_b_table(w):
         coords = []
-        for cix in range(4):
+        for cix in range(3):
             acc = None
             for t in range(16):
                 m = (w == t).astype(jnp.int32)
@@ -358,23 +398,28 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
         return tuple(coords)
 
     def ladder_body(j, acc):
-        for _ in range(4):
-            acc = _ext_double(acc, pats)
+        # only the last doubling's output feeds an addition, so only
+        # it needs the extended T coordinate (3 muls saved each on the
+        # first three)
+        for i in range(4):
+            acc = _ext_double(acc, pats, need_t=(i == 3))
         w = (_WINDOWS - 1) - j
         sw = swin_ref[pl.ds(w, 1)]
         kw = kwin_ref[pl.ds(w, 1)]
-        acc = _ext_add(acc, select_b_table(sw), two_d, pats)
+        acc = _madd_affine(acc, select_b_table(sw), pats)
         acc = _ext_add(acc, select_lane_table(kw), two_d, pats)
         return acc
 
     acc = lax.fori_loop(0, _WINDOWS, ladder_body,
                         (zero, one, one, zero))
 
-    # subtract R, clear cofactor, identity test
+    # subtract R, clear cofactor, identity test — nothing after the
+    # subtraction reads T again
     nrt = _mul(-rx, ry, pats)
-    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d, pats)
+    acc = _ext_add(acc, (-rx, ry, one, nrt), two_d, pats,
+                   need_t=False)
     for _ in range(3):
-        acc = _ext_double(acc, pats)
+        acc = _ext_double(acc, pats, need_t=False)
     X, Y, Z, _T = acc
     ok = _is_zero(X, four_p) & _eq(Y, Z, four_p) & a_ok & r_ok
     ok_ref[:] = jnp.broadcast_to(ok.astype(jnp.int32), (8, B))

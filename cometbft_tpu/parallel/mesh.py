@@ -38,34 +38,36 @@ def make_mesh(n_devices: int | None = None) -> Mesh:
 def _sharded_verify_fn(ndev: int, kernel: str, interpret: bool,
                        block: int):
     """Jitted shard_map'ed batch verify over an ndev mesh; per-shard
-    body is the selected kernel.  Cached per configuration — the jit
-    itself caches per shape."""
+    body is the selected kernel behind the packed uint8 wire layout
+    (a/r [shard,32]u8, s/k [shard,64]u8 — every input shards on the
+    lane axis and the int32 unpack runs per-device).  Cached per
+    configuration — the jit itself caches per shape."""
     mesh = make_mesh(ndev)
+    from ..ops.ed25519_jax import _byte_cols, _win_cols
     if kernel.startswith("pallas"):
         from ..ops.ed25519_jax import _pallas_module
         ep = _pallas_module(kernel)
 
         def body(a, r, s, k):
             return ep.verify_cols(
-                jnp.transpose(a).astype(jnp.int32),
-                jnp.transpose(r).astype(jnp.int32),
-                s, k, interpret=interpret,
+                _byte_cols(a), _byte_cols(r),
+                _win_cols(s), _win_cols(k), interpret=interpret,
                 block=block or ep.BLOCK)
     else:
         def body(a, r, s, k):
-            return _verify_kernel(a, r, s, k)
+            return _verify_kernel(a, r, _win_cols(s), _win_cols(k))
 
     shard = jax.shard_map(
         body,
         mesh=mesh,
         in_specs=(P(BATCH_AXIS), P(BATCH_AXIS),
-                  P(None, BATCH_AXIS), P(None, BATCH_AXIS)),
+                  P(BATCH_AXIS), P(BATCH_AXIS)),
         out_specs=P(BATCH_AXIS),
     )
     return jax.jit(shard)
 
 
-def verify_sharded(a_b, r_b, s_win, k_win, *, ndev: int,
+def verify_sharded(a_b, r_b, s_w8, k_w8, *, ndev: int,
                    kernel: str = "xla", interpret: bool = False,
                    block: int = 0) -> np.ndarray:
     """Data-parallel batch verify over all ndev devices (SURVEY §2.11:
@@ -86,15 +88,11 @@ def verify_sharded(a_b, r_b, s_win, k_win, *, ndev: int,
         pad = m2 - m
         a_b = np.concatenate([a_b, np.zeros((pad, 32), a_b.dtype)])
         r_b = np.concatenate([r_b, np.zeros((pad, 32), r_b.dtype)])
-        s_win = np.concatenate(
-            [s_win, np.zeros((s_win.shape[0], pad), s_win.dtype)],
-            axis=1)
-        k_win = np.concatenate(
-            [k_win, np.zeros((k_win.shape[0], pad), k_win.dtype)],
-            axis=1)
+        s_w8 = np.concatenate([s_w8, np.zeros((pad, 64), s_w8.dtype)])
+        k_w8 = np.concatenate([k_w8, np.zeros((pad, 64), k_w8.dtype)])
     fn = _sharded_verify_fn(ndev, kernel, interpret, block)
     ok = np.asarray(fn(jnp.asarray(a_b), jnp.asarray(r_b),
-                       jnp.asarray(s_win), jnp.asarray(k_win)))
+                       jnp.asarray(s_w8), jnp.asarray(k_w8)))
     return ok[:m]
 
 
@@ -102,9 +100,9 @@ def sharded_verify_tally(mesh: Mesh):
     """Build the jitted multi-chip step: verify signatures sharded over the
     mesh; the collective is a psum of per-shard valid-lane counts.
 
-    Returns fn(a_bytes[n,32]u8, r_bytes[n,32]u8, s_win[64,n]i32,
-               k_win[64,n]i32) -> (ok[n] bool, valid_count i32)
-    (s_win/k_win: 4-bit little-endian scalar windows, ed25519_jax._windows_le).
+    Returns fn(a_bytes[n,32]u8, r_bytes[n,32]u8, s_w8[n,64]u8,
+               k_w8[n,64]u8) -> (ok[n] bool, valid_count i32)
+    (s_w8/k_w8: lane-major 4-bit windows, ed25519_jax._windows_u8).
 
     n must be a multiple of the mesh size.  Voting-power totals are
     aggregated on the host from the exact per-lane mask: validator powers
@@ -113,8 +111,10 @@ def sharded_verify_tally(mesh: Mesh):
     host-side exact tally costs nothing at 10k lanes.
     """
 
+    from ..ops.ed25519_jax import _win_cols
+
     def step(a, r, s, k):
-        ok = _verify_kernel(a, r, s, k)
+        ok = _verify_kernel(a, r, _win_cols(s), _win_cols(k))
         count = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, count
 
@@ -122,7 +122,7 @@ def sharded_verify_tally(mesh: Mesh):
         step,
         mesh=mesh,
         in_specs=(P(BATCH_AXIS), P(BATCH_AXIS),
-                  P(None, BATCH_AXIS), P(None, BATCH_AXIS)),
+                  P(BATCH_AXIS), P(BATCH_AXIS)),
         out_specs=(P(BATCH_AXIS), P()),
     )
     return jax.jit(shard)

@@ -50,6 +50,24 @@ class Store:
     def __init__(self, db: DB):
         self._db = db
         self._lock = threading.RLock()
+        # height -> (last_height_changed, set AS OF height, rolled).
+        # The sparse storage scheme (full set only at change/checkpoint
+        # heights) makes a cold load_validators(h) roll proposer
+        # priorities forward O(h - stored) steps; block application
+        # loads h-1 every height, which is O(h^2) over a run and
+        # starves the event loop on long-lived chains.  Caching the
+        # last few rolled-forward sets makes the sequential pattern
+        # one increment step per height.
+        #
+        # Bit-equality with the cold path: increment(k) applies
+        # rescale+shift ONCE, then k raw steps — so advancing a cached
+        # set by one height must apply rescale+shift only when the
+        # entry is the as-stored base (rolled=False); an already-rolled
+        # entry advances by one RAW step.  Chaining any other way
+        # diverges from the reference's one-shot LoadValidators when
+        # the stored priority spread exceeds the rescale window.
+        self._val_cache: dict[
+            int, tuple[int, ValidatorSet, bool]] = {}
 
     # ------------------------------------------------------------------
     def load(self) -> Optional[State]:
@@ -79,6 +97,7 @@ class Store:
     def bootstrap(self, state: State) -> None:
         """Reference: store.go Bootstrap — used by state sync."""
         with self._lock:
+            self._val_cache.clear()
             height = state.last_block_height + 1
             if height > 1 and state.last_validators is not None and \
                     state.last_validators.size() > 0:
@@ -100,6 +119,7 @@ class Store:
         if height == last_changed or \
                 height % VAL_SET_CHECKPOINT_INTERVAL == 0:
             d["validator_set"] = vals.to_proto()
+        self._val_cache.pop(height, None)   # record is being rewritten
         self._db.set(_validators_key(height),
                      encode(state_pb.VALIDATORS_INFO, d))
 
@@ -113,31 +133,68 @@ class Store:
 
     def load_validators(self, height: int) -> ValidatorSet:
         """Reference: store.go LoadValidators with checkpoint-aware
-        lookback."""
-        raw = self._db.get(_validators_key(height))
-        if raw is None:
-            raise StateStoreError(
-                f"no validator set found for height {height}")
-        info = decode(state_pb.VALIDATORS_INFO, raw)
-        if info.get("validator_set") is not None:
-            return ValidatorSet.from_proto(info["validator_set"])
-        last_changed = info.get("last_height_changed", 0)
-        stored_height = self._last_stored_height_for(height, last_changed)
-        raw2 = self._db.get(_validators_key(stored_height))
-        if raw2 is None:
-            raise StateStoreError(
-                f"validator lookback to {stored_height} failed "
-                f"for height {height}")
-        info2 = decode(state_pb.VALIDATORS_INFO, raw2)
-        if info2.get("validator_set") is None:
-            raise StateStoreError(
-                f"validator set at lookback height {stored_height} "
-                f"is empty")
-        vals = ValidatorSet.from_proto(info2["validator_set"])
-        # roll priorities forward to the requested height
-        if height > stored_height:
-            vals.increment_proposer_priority(height - stored_height)
-        return vals
+        lookback (plus the incremental roll-forward cache above)."""
+        with self._lock:
+            hit = self._val_cache.get(height)
+            if hit is not None:
+                return hit[1].copy()
+            raw = self._db.get(_validators_key(height))
+            if raw is None:
+                raise StateStoreError(
+                    f"no validator set found for height {height}")
+            info = decode(state_pb.VALIDATORS_INFO, raw)
+            if info.get("validator_set") is not None:
+                vals = ValidatorSet.from_proto(info["validator_set"])
+                self._cache_validators(
+                    height, info.get("last_height_changed", height),
+                    vals, rolled=False)
+                return vals
+            last_changed = info.get("last_height_changed", 0)
+            prev = self._val_cache.get(height - 1)
+            if prev is not None and prev[0] == last_changed:
+                # same lineage: one priority step from height-1
+                prev_lc, prev_vals, prev_rolled = prev
+                if prev_rolled:
+                    # already past rescale+shift: raw step only
+                    vals = prev_vals.copy()
+                    vals.advance_proposer_priority_step()
+                else:
+                    vals = prev_vals.copy_increment_proposer_priority(1)
+                self._cache_validators(height, last_changed, vals,
+                                       rolled=True)
+                return vals
+            stored_height = self._last_stored_height_for(
+                height, last_changed)
+            raw2 = self._db.get(_validators_key(stored_height))
+            if raw2 is None:
+                raise StateStoreError(
+                    f"validator lookback to {stored_height} failed "
+                    f"for height {height}")
+            info2 = decode(state_pb.VALIDATORS_INFO, raw2)
+            if info2.get("validator_set") is None:
+                raise StateStoreError(
+                    f"validator set at lookback height {stored_height} "
+                    f"is empty")
+            vals = ValidatorSet.from_proto(info2["validator_set"])
+            # roll priorities forward to the requested height
+            rolled = height > stored_height
+            if rolled:
+                vals.increment_proposer_priority(height - stored_height)
+            self._cache_validators(height, last_changed, vals,
+                                   rolled=rolled)
+            return vals
+
+    def _cache_validators(self, height: int, last_changed: int,
+                          vals: ValidatorSet, *,
+                          rolled: bool) -> None:
+        """Remember the set (own copy); keep the cache to a handful of
+        recent heights — the sequential block-apply pattern only ever
+        needs height-1.  `rolled` records whether increment's
+        rescale+shift prologue has run (see the cache comment)."""
+        self._val_cache[height] = (last_changed, vals.copy(), rolled)
+        if len(self._val_cache) > 8:
+            for h in sorted(self._val_cache)[:-4]:
+                del self._val_cache[h]
 
     # ------------------------------------------------------------------
     def _save_params(self, height: int, params: ConsensusParams,
@@ -216,6 +273,7 @@ class Store:
         lookback targets are deleted); returns number pruned."""
         if from_height <= 0 or to_height <= from_height:
             return 0
+        self._val_cache.clear()
         # heights whose FULL validator records must survive: the lookback
         # targets of to_height and of the evidence threshold (reference:
         # store.go PruneStates keepVals)

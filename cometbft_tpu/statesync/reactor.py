@@ -55,6 +55,8 @@ class StatesyncReactor(Reactor):
         self.metrics.syncing.set(1 if syncer is not None else 0)
         self.app_conns = app_conns
         self.syncer = syncer
+        if syncer is not None:
+            syncer.request_snapshots = self.request_snapshots
         # chunk requests round-robin across peers that offered the
         # snapshot
         self._snapshot_peers: dict[SnapshotKey, list[str]] = {}
@@ -70,6 +72,16 @@ class StatesyncReactor(Reactor):
 
     async def add_peer(self, peer: Peer) -> None:
         if self.syncer is not None:
+            peer.send(SNAPSHOT_CHANNEL,
+                      encode(MESSAGE, {"snapshots_request": {}}))
+
+    def request_snapshots(self) -> None:
+        """Re-poll every peer's snapshot list (Syncer re-discovery
+        hook: advertised snapshots age out on the serving side while
+        chunks are being fetched)."""
+        if self.switch is None:
+            return
+        for peer in list(self.switch.peers.values()):
             peer.send(SNAPSHOT_CHANNEL,
                       encode(MESSAGE, {"snapshots_request": {}}))
 

@@ -161,14 +161,22 @@ class Syncer:
     def __init__(self, app_conns, state_provider: StateProvider,
                  request_chunk,
                  chunk_timeout_s: float = 10.0,
+                 chunk_fetch_rounds: int = 4,
                  chunk_dir: Optional[str] = None,
                  logger: Optional[Logger] = None):
         """request_chunk(snapshot, index) asks some peer for a chunk;
-        results arrive via add_chunk."""
+        results arrive via add_chunk.  chunk_fetch_rounds bounds how
+        many consecutive chunk-timeout rounds with zero progress are
+        tolerated before the snapshot is rejected (reference:
+        syncer.go fetchChunks errTimeout -> SyncAny tries the next
+        snapshot instead of waiting forever on a peer that pruned
+        it)."""
         self.app_conns = app_conns
         self.state_provider = state_provider
         self.request_chunk = request_chunk
+        self.request_snapshots = None   # optional reactor hook
         self.chunk_timeout_s = chunk_timeout_s
+        self.chunk_fetch_rounds = chunk_fetch_rounds
         self.chunk_dir = chunk_dir
         self._owns_chunk_dir = chunk_dir is None
         self.logger = logger if logger is not None else \
@@ -211,6 +219,11 @@ class Syncer:
                         f"{len(self.snapshots)})")
                 self.logger.info("no snapshots yet; rediscovering",
                                  round=rounds)
+                if self.request_snapshots is not None:
+                    # ask peers again — sources prune old snapshots
+                    # and take new ones while we retry (reference:
+                    # reactor.go re-requests on recentSnapshots)
+                    self.request_snapshots()
                 await asyncio.sleep(discovery_time_s)
                 continue
             tried.add(best)
@@ -219,6 +232,8 @@ class Syncer:
             except RejectSnapshotError as e:
                 self.logger.info("snapshot rejected; trying next",
                                  height=best.height, err=str(e))
+                if self.request_snapshots is not None:
+                    self.request_snapshots()
                 continue
 
     def _best_snapshot(self, tried: set) -> Optional[SnapshotKey]:
@@ -258,19 +273,35 @@ class Syncer:
             # applyChunks)
             applied = 0
             requested: set[int] = set()
+            dry_rounds = 0
             while applied < snap.chunks:
                 for i in range(snap.chunks):
                     if not q.has(i) and i not in requested:
                         self.request_chunk(snap, i)
                         requested.add(i)
                 if not q.has(applied):
+                    # clear BEFORE re-checking: a chunk landing between
+                    # a has() miss and the clear would otherwise wipe
+                    # its own wakeup and stall a full chunk_timeout_s
                     q.event.clear()
-                    try:
-                        await asyncio.wait_for(q.event.wait(),
-                                               self.chunk_timeout_s)
-                    except asyncio.TimeoutError:
-                        requested.clear()  # re-request everything missing
+                    if not q.has(applied):
+                        try:
+                            await asyncio.wait_for(
+                                q.event.wait(), self.chunk_timeout_s)
+                            dry_rounds = 0
+                        except asyncio.TimeoutError:
+                            dry_rounds += 1
+                            if dry_rounds >= self.chunk_fetch_rounds:
+                                # the advertising peers cannot serve it
+                                # anymore (pruned / gone) — reject and
+                                # let sync_any pick a newer snapshot
+                                raise RejectSnapshotError(
+                                    "timed out waiting for chunks "
+                                    f"({dry_rounds} rounds)")
+                            # re-request everything missing
+                            requested.clear()
                     continue
+                dry_rounds = 0
                 resp = await \
                     self.app_conns.snapshot.apply_snapshot_chunk(
                         abci.ApplySnapshotChunkRequest(

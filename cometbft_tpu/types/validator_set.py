@@ -136,6 +136,16 @@ class ValidatorSet:
         cp.increment_proposer_priority(times)
         return cp
 
+    def advance_proposer_priority_step(self) -> None:
+        """One raw increment step WITHOUT the rescale+shift prologue —
+        the k-th loop iteration of increment_proposer_priority(k).
+        Chaining increment(1) calls instead would re-run the prologue
+        each step and diverge from a one-shot increment(k) whenever
+        the priority spread exceeds the rescale window; the state
+        store's roll-forward cache uses this to stay bit-identical to
+        the cold LoadValidators path."""
+        self.proposer = self._increment_proposer_priority()
+
     def _increment_proposer_priority(self) -> Validator:
         for v in self.validators:
             v.proposer_priority = safe_add_clip(

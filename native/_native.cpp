@@ -183,19 +183,20 @@ PyObject* ed25519_kscalars(PyObject*, PyObject* arg) {
 }
 
 // ed25519_prep(items, m, b_bytes, identity_bytes) ->
-//   (a_b, r_b, s_win, k_win, pre_bad)
+//   (a_b, r_b, s_w8, k_w8, pre_bad)
 // items: sequence of (pub, msg, sig) byte tuples; m: padded lane
 // count (>= len(items)).  Outputs are numpy-ready buffers in the
-// KERNEL'S layout (no host-side transpose or cast remains):
+// packed uint8 WIRE layout (1 byte per element — the host->device
+// transfer is the e2e bottleneck on a tunneled TPU, and the int32
+// transpose/cast now runs on-device):
 //   a_b, r_b: [m, 32] uint8 (padding lanes = B / identity)
-//   s_win, k_win: [64, m] int32 4-bit windows, window-major
+//   s_w8, k_w8: [m, 64] uint8 4-bit windows, lane-major
 //   pre_bad: [m] uint8 (1 = malformed or non-canonical S)
 // This is the batch verifier's entire host prep: pointers are
-// extracted under the GIL (cheap), then the SHA-512 / window loop and
-// the blocked transpose-to-int32 run GIL-free across hardware
-// threads — the budget (BASELINE: < 5 ms e2e at 10k sigs) leaves
-// < 3 ms for all host work, and single-threaded SHA-512 alone is
-// ~9 ms at 10k.
+// extracted under the GIL (cheap), then the SHA-512 / window loop
+// runs GIL-free across hardware threads — the budget (BASELINE:
+// < 5 ms e2e at 10k sigs) leaves < 3 ms for all host work, and
+// single-threaded SHA-512 alone is ~9 ms at 10k.
 namespace prep {
 
 struct ItemRef {
@@ -332,22 +333,6 @@ void lanes(const ItemRef* refs, Py_ssize_t lo, Py_ssize_t hi,
 #endif
 }
 
-// phase 3 worker: item-major uint8 [m, 64] -> window-major int32
-// [64, m], blocked so reads stay within a few cache lines per tile;
-// columns [lo, hi) of the output (= items lo..hi)
-void transpose_widen(const uint8_t* in8, int32_t* out32,
-                     Py_ssize_t m, Py_ssize_t lo, Py_ssize_t hi) {
-    const Py_ssize_t TILE = 64;
-    for (Py_ssize_t i0 = lo; i0 < hi; i0 += TILE) {
-        Py_ssize_t i1 = i0 + TILE < hi ? i0 + TILE : hi;
-        for (int w = 0; w < 64; w++) {
-            int32_t* orow = out32 + Py_ssize_t(w) * m;
-            for (Py_ssize_t i = i0; i < i1; i++)
-                orow[i] = in8[i * 64 + w];
-        }
-    }
-}
-
 void run_threads(Py_ssize_t n,
                  const std::function<void(Py_ssize_t, Py_ssize_t)>& fn) {
     unsigned hw = std::thread::hardware_concurrency();
@@ -394,9 +379,9 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
     PyObject* a_out = PyBytes_FromStringAndSize(nullptr, m * 32);
     PyObject* r_out = PyBytes_FromStringAndSize(nullptr, m * 32);
     PyObject* sw_out = PyBytes_FromStringAndSize(
-        nullptr, Py_ssize_t(64) * m * 4);
+        nullptr, Py_ssize_t(64) * m);
     PyObject* kw_out = PyBytes_FromStringAndSize(
-        nullptr, Py_ssize_t(64) * m * 4);
+        nullptr, Py_ssize_t(64) * m);
     PyObject* bad_out = PyBytes_FromStringAndSize(nullptr, m);
     if (!a_out || !r_out || !sw_out || !kw_out || !bad_out) {
         Py_XDECREF(a_out); Py_XDECREF(r_out); Py_XDECREF(sw_out);
@@ -405,10 +390,10 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
     }
     uint8_t* a_p = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(a_out));
     uint8_t* r_p = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(r_out));
-    int32_t* sw_p =
-        reinterpret_cast<int32_t*>(PyBytes_AS_STRING(sw_out));
-    int32_t* kw_p =
-        reinterpret_cast<int32_t*>(PyBytes_AS_STRING(kw_out));
+    uint8_t* sw_p =
+        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(sw_out));
+    uint8_t* kw_p =
+        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(kw_out));
     uint8_t* bad_p =
         reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(bad_out));
 
@@ -448,26 +433,21 @@ PyObject* ed25519_prep(PyObject*, PyObject* args) {
         ref.bad = false;
     }
 
-    // phases 2+3 (GIL released): hash/window lanes, then transpose
+    // phase 2 (GIL released): hash/window lanes, straight into the
+    // lane-major uint8 output buffers
     {
-        std::vector<uint8_t> sw8(size_t(64) * size_t(m), 0);
-        std::vector<uint8_t> kw8(size_t(64) * size_t(m), 0);
-        uint8_t* sw8p = sw8.data();
-        uint8_t* kw8p = kw8.data();
         const prep::ItemRef* refp = refs.data();
         Py_BEGIN_ALLOW_THREADS
-        // padding defaults
+        // padding defaults (windows of unwritten lanes must be zero)
+        std::memset(sw_p, 0, size_t(64) * size_t(m));
+        std::memset(kw_p, 0, size_t(64) * size_t(m));
         for (Py_ssize_t i = 0; i < m; i++) {
             std::memcpy(a_p + i * 32, b_bytes, 32);
             std::memcpy(r_p + i * 32, id_bytes, 32);
             bad_p[i] = 0;
         }
         prep::run_threads(n, [&](Py_ssize_t lo, Py_ssize_t hi) {
-            prep::lanes(refp, lo, hi, a_p, r_p, sw8p, kw8p, bad_p);
-        });
-        prep::run_threads(m, [&](Py_ssize_t lo, Py_ssize_t hi) {
-            prep::transpose_widen(sw8p, sw_p, m, lo, hi);
-            prep::transpose_widen(kw8p, kw_p, m, lo, hi);
+            prep::lanes(refp, lo, hi, a_p, r_p, sw_p, kw_p, bad_p);
         });
         Py_END_ALLOW_THREADS
     }

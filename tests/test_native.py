@@ -105,8 +105,8 @@ class TestEd25519Prep:
             8, b"b" * 32, b"i" * 32)
         a_b, r_b, s_win, k_win, bad = out
         assert bad[0] == 1 and bad[1] == 1 and bad[3] == 1
-        # s_win is window-major int32 since the threaded prep rewrite
-        assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64 * 4
+        # s_win is lane-major uint8 since the packed-wire rewrite
+        assert len(a_b) == 8 * 32 and len(s_win) == 8 * 64
 
 
 class TestSha512AndKScalars:

@@ -184,8 +184,8 @@ class TestShardedTally:
             k_raw[i] = np.frombuffer(k.to_bytes(32, "little"), np.uint8)
             golden.append(ref.verify(pub, msg, sig))
         ok, count = step(jnp.asarray(a), jnp.asarray(r),
-                         jnp.asarray(ej._windows_le(s_raw)),
-                         jnp.asarray(ej._windows_le(k_raw)))
+                         jnp.asarray(ej._windows_u8(s_raw)),
+                         jnp.asarray(ej._windows_u8(k_raw)))
         assert list(np.asarray(ok)) == golden
         assert int(count) == sum(golden)
 

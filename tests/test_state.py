@@ -233,3 +233,77 @@ class TestTxResultsHash:
         assert tx_results_hash(r1) == tx_results_hash(r2)
         r3 = [abci.ExecTxResult(code=1, data=b"x")]
         assert tx_results_hash(r1) != tx_results_hash(r3)
+
+
+class TestValidatorLoadCache:
+    """The store's roll-forward cache must be BIT-IDENTICAL to a cold
+    LoadValidators (reference: store.go LoadValidators does one
+    increment_proposer_priority(height - stored) call; chained
+    single-step increments re-run the rescale prologue and diverge
+    when the stored priority spread exceeds the rescale window)."""
+
+    @staticmethod
+    def _store_with_pointers(vals, last_changed, upto):
+        from cometbft_tpu.state.store import (
+            Store, _validators_key, state_pb)
+        from cometbft_tpu.wire.proto import encode
+        st = Store(MemDB())
+        st._db.set(_validators_key(last_changed),
+                   encode(state_pb.VALIDATORS_INFO,
+                          {"last_height_changed": last_changed,
+                           "validator_set": vals.to_proto()}))
+        for h in range(last_changed + 1, upto + 1):
+            st._db.set(_validators_key(h),
+                       encode(state_pb.VALIDATORS_INFO,
+                              {"last_height_changed": last_changed}))
+        return st
+
+    def _check(self, powers, priorities, upto=40):
+        from cometbft_tpu.types.validator_set import (
+            Validator, ValidatorSet)
+
+        keys = [ed25519.gen_priv_key().pub_key()
+                for _ in powers]
+
+        def mk():
+            vs = ValidatorSet([
+                Validator(address=k.address(), pub_key=k,
+                          voting_power=p, proposer_priority=pr)
+                for k, (p, pr) in zip(keys,
+                                      zip(powers, priorities))])
+            return vs
+
+        warm = self._store_with_pointers(mk(), 1, upto)
+        cold = self._store_with_pointers(mk(), 1, upto)
+        for h in range(1, upto + 1):           # sequential (cached)
+            got = warm.load_validators(h)
+            cold._val_cache.clear()            # force the cold path
+            want = cold.load_validators(h)
+            assert [v.proposer_priority for v in got.validators] == \
+                [v.proposer_priority for v in want.validators], \
+                f"divergence at height {h}"
+            assert got.get_proposer().address == \
+                want.get_proposer().address
+
+    def test_plain_priorities(self):
+        self._check([100, 200, 300], [0, 0, 0])
+
+    def test_spread_exceeding_rescale_window(self):
+        # priority spread > 2x total power forces the rescale
+        # prologue to matter (the adversarial case for chained
+        # increments)
+        self._check([10 ** 9, 10, 1000, 1000, 10 ** 9],
+                    [5 * 10 ** 9, -5 * 10 ** 9, 0, 17, -3])
+
+    def test_cache_invalidated_on_rewrite(self):
+        from cometbft_tpu.types.validator_set import (
+            Validator, ValidatorSet)
+        k = ed25519.gen_priv_key().pub_key()
+        vs = ValidatorSet([Validator(address=k.address(), pub_key=k,
+                                     voting_power=5,
+                                     proposer_priority=0)])
+        st = self._store_with_pointers(vs, 1, 10)
+        st.load_validators(7)
+        assert 7 in st._val_cache
+        st._save_validators(7, vs, 7)          # record rewritten
+        assert 7 not in st._val_cache

@@ -54,7 +54,12 @@ class TestStatesync:
                 genesis_time=Timestamp(1700000000, 0),
                 validators=[GenesisValidator(
                     address=b"", pub_key=pv.get_pub_key(), power=10)])
+            # realistic block cadence: unthrottled, this in-memory
+            # chain commits ~150 blocks/s and the app's bounded
+            # snapshot window (5) turns over faster than a chunk
+            # round-trip can complete
             src_app = KVStoreApplication(snapshot_interval=4)
+            src_app.next_block_delay_ns = 100_000_000
             src_conns = AppConns(src_app)
             src_ss, src_bs = Store(MemDB()), BlockStore(MemDB())
             state = make_genesis_state(doc)
@@ -94,7 +99,8 @@ class TestStatesync:
 
             dst_switch = Switch(NodeKey.generate(), doc.chain_id,
                                 listen_addr="127.0.0.1:0")
-            syncer = Syncer(dst_conns, sp, request_chunk=None)
+            syncer = Syncer(dst_conns, sp, request_chunk=None,
+                            chunk_timeout_s=2.0)
             dst_reactor = StatesyncReactor(dst_conns, syncer=syncer)
             syncer.request_chunk = dst_reactor.request_chunk
             dst_switch.add_reactor(dst_reactor)
