@@ -13,6 +13,7 @@ config key from BASELINE.json's north star). Backends:
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from . import ed25519
@@ -20,6 +21,21 @@ from .keys import BatchVerifier, PubKey
 
 _backend: Optional[str] = None
 _auto_probe: Optional[str] = None   # cached auto-detection result
+_probe_thread: Optional[threading.Thread] = None
+_probe_result: Optional[str] = None
+
+
+def _platform_probe() -> None:
+    """Resolve the default JAX backend in a daemon thread: device
+    init can block for minutes on a pooled/tunneled TPU, and a node
+    must not hang its first CheckTx on that."""
+    global _probe_result
+    try:
+        import jax
+        _probe_result = \
+            "tpu" if jax.default_backend() == "tpu" else "cpu"
+    except Exception:
+        _probe_result = "cpu"
 
 
 def set_backend(name: str) -> None:
@@ -42,12 +58,25 @@ def get_backend() -> str:
         if env != "auto":
             raise ValueError(
                 f"COMETBFT_TPU_CRYPTO_BACKEND={env!r}: expected tpu|cpu|auto")
+    # auto: the kernel path only pays off on an actual TPU — on a
+    # CPU-only box the XLA kernel is orders of magnitude slower than
+    # the OpenSSL loop, so importability of jax is NOT the signal;
+    # the resolved platform is
+    global _auto_probe, _probe_thread
     if _auto_probe is None:
-        try:
-            from ..ops import ed25519_jax  # noqa: F401
-            _auto_probe = "tpu"
-        except Exception:
-            _auto_probe = "cpu"
+        if _probe_thread is None:
+            _probe_thread = threading.Thread(
+                target=_platform_probe, daemon=True)
+            _probe_thread.start()
+        # grace period only: the CPU path serves correctly while a
+        # slow device claim resolves in the background — blocking a
+        # node's first commit verification on the pool would invert
+        # the probe's purpose
+        _probe_thread.join(timeout=float(os.environ.get(
+            "COMETBFT_TPU_PROBE_TIMEOUT", "2")))
+        if _probe_result is None:
+            return "cpu"    # probe unresolved; retry next call
+        _auto_probe = _probe_result
     return _auto_probe
 
 

@@ -42,34 +42,6 @@ def _path(kernel: str, m: int) -> str:
     return os.path.join(ARTIFACT_DIR, f"ed25519_{kernel}_{m}.jaxexport")
 
 
-def _host_tag() -> str:
-    """CPU feature fingerprint, same idea as crypto/_native_loader.py:
-    flags that change XLA:CPU codegen (avx512, amx, …)."""
-    try:
-        with open("/proc/cpuinfo") as f:
-            for line in f:
-                if line.startswith("flags"):
-                    return " ".join(sorted(line.split(":", 1)[1].split()))
-    except OSError:
-        pass
-    import platform
-    return platform.machine()
-
-
-@functools.lru_cache(maxsize=None)
-def _host_tag_matches() -> bool:
-    """True when the committed artifacts were generated on a host with
-    this machine's CPU feature set.  CPU-platform executables
-    deserialized across feature boundaries can SIGILL (XLA:CPU AOT
-    feature-mismatch warnings in the r3 dryrun log); TPU programs are
-    host-independent and never need this gate."""
-    try:
-        with open(os.path.join(ARTIFACT_DIR, "HOST")) as f:
-            return f.read().strip() == _host_tag()
-    except OSError:
-        return False
-
-
 @functools.lru_cache(maxsize=None)
 def load(kernel: str, m: int):
     """Deserialized exported kernel for (kernel, lane count), or None
@@ -99,9 +71,7 @@ def call(kernel: str, a, r, s_w8, k_w8):
     import jax
     platform = jax.default_backend()
     if platform not in exp.platforms:
-        return None
-    if platform == "cpu" and not _host_tag_matches():
-        return None
+        return None     # artifacts are TPU-only; CPU uses live jit
     try:
         return exp.call(a, r, s_w8, k_w8)
     except Exception:
@@ -110,8 +80,8 @@ def call(kernel: str, a, r, s_w8, k_w8):
 
 def generate(xla_buckets=None, pallas_buckets=None,
              out_dir: Optional[str] = None) -> list[str]:
-    """Export + serialize every bucketed kernel for the TPU (and, for
-    the portable xla kernel, CPU) platforms.  Runs on any host."""
+    """Export + serialize every bucketed kernel for the TPU platform.
+    Runs on any host (lowering doesn't need the device)."""
     import jax
 
     # lowering happens per TARGET platform regardless of the local
@@ -132,11 +102,15 @@ def generate(xla_buckets=None, pallas_buckets=None,
     os.makedirs(out_dir, exist_ok=True)
     written = []
 
+    # TPU-only: a serialized XLA:CPU executable is pinned to the
+    # generating host's CPU features (SIGILL risk across hosts, and
+    # measured far slower than the live-jit path even on the same
+    # host); CPU runs jit + the persistent compile cache instead.
     for m in xla_buckets:
         a = jnp.asarray(np.zeros((m, 32), np.uint8))
         w8 = jnp.asarray(np.zeros((m, 64), np.uint8))
         exp = export.export(ej._jit_verify_packed,
-                            platforms=["tpu", "cpu"])(a, a, w8, w8)
+                            platforms=["tpu"])(a, a, w8, w8)
         p = os.path.join(out_dir, f"ed25519_xla_{m}.jaxexport")
         with open(p, "wb") as f:
             f.write(exp.serialize())
@@ -156,8 +130,6 @@ def generate(xla_buckets=None, pallas_buckets=None,
         written.append(p)
         print(f"exported pallas m={m}: {os.path.getsize(p)} bytes",
               file=sys.stderr)
-    with open(os.path.join(out_dir, "HOST"), "w") as f:
-        f.write(_host_tag())
     return written
 
 

@@ -256,13 +256,6 @@ def _windows_u8(scalars: np.ndarray) -> np.ndarray:
     return win
 
 
-def _windows_le(scalars: np.ndarray) -> np.ndarray:
-    """[m, 32] uint8 scalars -> [64, m] int32 window-major windows
-    (the kernels' device layout; kept for tests and device-only
-    benchmarks that bypass the packed transfer path)."""
-    return np.ascontiguousarray(_windows_u8(scalars).T).astype(np.int32)
-
-
 def _win_cols(w8):
     """Device-side: [m, 64] uint8 lane-major windows -> [64, m] int32."""
     return jnp.transpose(w8).astype(jnp.int32)

@@ -321,15 +321,17 @@ class TestAOTArtifacts:
         (VERDICT r2 #1; regenerate: python -m cometbft_tpu.ops.aot)."""
         from cometbft_tpu.ops import aot
 
-        for m in aot._xla_buckets():
-            exp = aot.load("xla", m)
-            assert exp is not None, f"missing xla artifact m={m}"
-            assert "tpu" in exp.platforms
-            assert "cpu" in exp.platforms
-        for m in aot._pallas_buckets():
-            exp = aot.load("pallas", m)
-            assert exp is not None, f"missing pallas artifact m={m}"
-            assert exp.platforms == ("tpu",)
+        for kernel, buckets in (("xla", aot._xla_buckets()),
+                                ("pallas", aot._pallas_buckets())):
+            for m in buckets:
+                exp = aot.load(kernel, m)
+                assert exp is not None, \
+                    f"missing {kernel} artifact m={m}"
+                # TPU-only: serialized XLA:CPU executables are pinned
+                # to the generating host's CPU features (SIGILL risk)
+                # and measured slower than live jit; CPU uses jit +
+                # the persistent compile cache
+                assert exp.platforms == ("tpu",)
 
 
 class TestPallasMultiBlock:
