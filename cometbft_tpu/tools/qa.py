@@ -137,28 +137,40 @@ def _ghost_validators(n: int) -> list[GenesisValidator]:
     return out
 
 
-async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
-                 ghosts: int = 90,
-                 rates: tuple = (25, 50, 100, 200),
-                 window_s: float = 15.0) -> QAReport:
-    from ..abci.kvstore import KVStoreApplication
-    from ..db import new_db
-    from ..node.node import Node
-    from ..rpc.client import HTTPClient
-    from . import loadtime
-    from .manifest import Relay, RelaySpec, start_relay
+def _link_port(zones: dict, relay_specs: list, a: str, b: str,
+               target_port: int) -> int:
+    """Port for a->b traffic: direct when same zone, else through a
+    latency relay matching the zone pair (manifest.py pattern)."""
+    from .manifest import RelaySpec
+    za, zb = zones.get(a, ZONES[0]), zones.get(b, ZONES[0])
+    key = f"{za}:{zb}" if f"{za}:{zb}" in ZONE_LATENCY_MS \
+        else f"{zb}:{za}"
+    ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
+    if ms == 0:
+        return target_port
+    port = _free_port()
+    relay_specs.append(RelaySpec(
+        port=port, target_host="127.0.0.1",
+        target_port=target_port, delay_s=ms / 1000.0))
+    return port
 
-    report = QAReport()
+
+def _setup_net(outdir: str, n_validators: int, n_full: int,
+               ghosts: int, report: "QAReport"):
+    """Everything both QA modes share before boot: per-node homes and
+    keys, the mixed-key genesis with ghost validators, the full-mesh
+    topology with inter-zone latency relays.
+
+    Returns (names, zones, cfgs, joiner_cfg, node_ids, p2p_port,
+    relay_specs); cfgs have persistent_peers filled in."""
     names = [f"validator{i:02d}" for i in range(n_validators)] + \
             [f"full{i:02d}" for i in range(n_full)]
     zones = {name: ZONES[i % len(ZONES)]
              for i, name in enumerate(names)}
-
     cfgs = {name: _mk_cfg(outdir, name, zones[name])
             for name in names}
     joiner_cfg = _mk_cfg(outdir, "joiner", ZONES[0])
 
-    # genesis: live validators + ghost validators, mixed key types
     pvs = {}
     for name in names + ["joiner"]:
         cfg = cfgs.get(name, joiner_cfg)
@@ -179,37 +191,79 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
     report.validators_live = n_validators
     report.nodes = len(names) + 1
 
-    # topology: each node dials every "later" node, through a latency
-    # relay when the zones differ (manifest.py setup pattern)
     node_ids = {}
     for name in names + ["joiner"]:
         cfg = cfgs.get(name, joiner_cfg)
         doc.save_as(cfg.base.path(cfg.base.genesis_file))
         node_ids[name] = NodeKey.load_or_gen(
             cfg.base.path(cfg.base.node_key_file)).id
-    relay_specs: list[RelaySpec] = []
 
-    def link_port(a: str, b: str, target_port: int) -> int:
-        za, zb = zones.get(a, ZONES[0]), zones.get(b, ZONES[0])
-        key = f"{za}:{zb}" if f"{za}:{zb}" in ZONE_LATENCY_MS \
-            else f"{zb}:{za}"
-        ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
-        if ms == 0:
-            return target_port
-        port = _free_port()
-        relay_specs.append(RelaySpec(
-            port=port, target_host="127.0.0.1",
-            target_port=target_port, delay_s=ms / 1000.0))
-        return port
-
+    relay_specs: list = []
     p2p_port = {name: int(cfgs[name].p2p.laddr.rsplit(":", 1)[1])
                 for name in names}
     for i, name in enumerate(names):
         peers = []
         for other in names[i + 1:]:
-            peers.append(f"{node_ids[other]}@127.0.0.1:"
-                         f"{link_port(name, other, p2p_port[other])}")
+            peers.append(
+                f"{node_ids[other]}@127.0.0.1:"
+                f"{_link_port(zones, relay_specs, name, other, p2p_port[other])}")
         cfgs[name].p2p.persistent_peers = ",".join(peers)
+    return names, zones, cfgs, joiner_cfg, node_ids, p2p_port, \
+        relay_specs
+
+
+def _note_saturation(report: "QAReport", w: "WindowResult",
+                     rate: float) -> None:
+    """Saturation rule (one place): the highest offered rate whose
+    committed throughput still tracks >= 80% of it."""
+    if w.tx_per_s >= 0.8 * rate:
+        report.saturation_rate = rate
+
+
+def _configure_joiner(joiner_cfg: Config, endpoints: list,
+                      trust_height: int, trust_hash: str,
+                      node_ids: dict, p2p_port: dict,
+                      names: list) -> None:
+    """Statesync late-joiner config (one place): light-client trust
+    anchored 8 blocks back, first two nodes as RPC providers, first
+    four as peers."""
+    joiner_cfg.statesync.enable = True
+    joiner_cfg.statesync.rpc_servers = [endpoints[0], endpoints[1]]
+    joiner_cfg.statesync.trust_height = trust_height
+    joiner_cfg.statesync.trust_hash = trust_hash
+    joiner_cfg.statesync.discovery_time_ns = int(2e9)
+    joiner_cfg.p2p.persistent_peers = ",".join(
+        f"{node_ids[n]}@127.0.0.1:{p2p_port[n]}"
+        for n in names[:4])
+
+
+def _record_intervals(report: "QAReport", secs: list) -> None:
+    """Block-interval stats (benchmark.go:15-24) from a sorted list
+    of block timestamps in seconds."""
+    intervals = [b - a for a, b in zip(secs, secs[1:])]
+    if intervals:
+        report.block_interval_avg_s = statistics.mean(intervals)
+        report.block_interval_std_s = (
+            statistics.pstdev(intervals)
+            if len(intervals) > 1 else 0.0)
+        report.block_interval_min_s = min(intervals)
+        report.block_interval_max_s = max(intervals)
+
+
+async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
+                 ghosts: int = 90,
+                 rates: tuple = (25, 50, 100, 200),
+                 window_s: float = 15.0) -> QAReport:
+    from ..abci.kvstore import KVStoreApplication
+    from ..db import new_db
+    from ..node.node import Node
+    from ..rpc.client import HTTPClient
+    from . import loadtime
+    from .manifest import Relay, start_relay
+
+    report = QAReport()
+    names, zones, cfgs, joiner_cfg, node_ids, p2p_port, relay_specs = \
+        _setup_net(outdir, n_validators, n_full, ghosts, report)
 
     nodes: dict[str, Node] = {}
     relays: list[Relay] = []
@@ -266,10 +320,7 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                         committed=w.committed,
                         tx_s=round(w.tx_per_s, 1),
                         p50=round(w.latency_p50_s, 3))
-            # saturation: committed tx/s stops tracking the offered
-            # rate (< 80% of it) or stops growing
-            if w.tx_per_s >= 0.8 * rate:
-                report.saturation_rate = rate
+            _note_saturation(report, w, rate)
 
             if wi == 1:
                 # --- perturbation between windows: kill/restart one
@@ -295,15 +346,9 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
         cli = HTTPClient(endpoints[0], timeout=30.0)
         th = max(1, ref.height - 8)
         blk = await cli.call("block", height=str(th))
-        joiner_cfg.statesync.enable = True
-        joiner_cfg.statesync.rpc_servers = [endpoints[0],
-                                            endpoints[1]]
-        joiner_cfg.statesync.trust_height = th
-        joiner_cfg.statesync.trust_hash = blk["block_id"]["hash"]
-        joiner_cfg.statesync.discovery_time_ns = int(2e9)
-        joiner_cfg.p2p.persistent_peers = ",".join(
-            f"{node_ids[n]}@127.0.0.1:{p2p_port[n]}"
-            for n in names[:4])
+        _configure_joiner(joiner_cfg, endpoints, th,
+                          blk["block_id"]["hash"], node_ids,
+                          p2p_port, names)
         app = KVStoreApplication(
             db=new_db("app", "memdb", joiner_cfg.base.path("data")),
             snapshot_interval=5)
@@ -323,14 +368,7 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
             meta = ref.block_store.load_block_meta(h)
             if meta is not None:
                 times.append(meta.header.time.unix_ns() / 1e9)
-        intervals = [b - a for a, b in zip(times, times[1:])]
-        if intervals:
-            report.block_interval_avg_s = statistics.mean(intervals)
-            report.block_interval_std_s = (
-                statistics.pstdev(intervals)
-                if len(intervals) > 1 else 0.0)
-            report.block_interval_min_s = min(intervals)
-            report.block_interval_max_s = max(intervals)
+        _record_intervals(report, times)
 
         # --- invariants ---------------------------------------------
         for h in range(1, report.final_height + 1):
@@ -535,66 +573,12 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
     """
     from ..rpc.client import HTTPClient
     from . import loadtime
-    from .manifest import Relay, RelaySpec, start_relay
+    from .manifest import Relay, start_relay
 
     report = QAReport()
-    names = [f"validator{i:02d}" for i in range(n_validators)] + \
-            [f"full{i:02d}" for i in range(n_full)]
-    zones = {name: ZONES[i % len(ZONES)]
-             for i, name in enumerate(names)}
-    cfgs = {name: _mk_cfg(outdir, name, zones[name])
-            for name in names}
-    joiner_cfg = _mk_cfg(outdir, "joiner", ZONES[0])
-
-    pvs = {}
-    for name in names + ["joiner"]:
-        cfg = cfgs.get(name, joiner_cfg)
-        pvs[name] = FilePV.generate(
-            cfg.base.path(cfg.base.priv_validator_key_file),
-            cfg.base.path(cfg.base.priv_validator_state_file))
-        NodeKey.load_or_gen(cfg.base.path(cfg.base.node_key_file))
-    vals = [GenesisValidator(address=b"",
-                             pub_key=pvs[n].get_pub_key(), power=100)
-            for n in names[:n_validators]]
-    vals += _ghost_validators(ghosts)
-    doc = GenesisDoc(chain_id="qa-net", genesis_time=Timestamp.now(),
-                     validators=vals)
-    doc.consensus_params.validator.pub_key_types = [
-        "ed25519", "secp256k1"]
-    doc.consensus_params.feature.pbts_enable_height = 1
-    report.validators_total = len(vals)
-    report.validators_live = n_validators
-    report.nodes = len(names) + 1
-
-    node_ids = {}
-    for name in names + ["joiner"]:
-        cfg = cfgs.get(name, joiner_cfg)
-        doc.save_as(cfg.base.path(cfg.base.genesis_file))
-        node_ids[name] = NodeKey.load_or_gen(
-            cfg.base.path(cfg.base.node_key_file)).id
-    relay_specs: list[RelaySpec] = []
-
-    def link_port(a: str, b: str, target_port: int) -> int:
-        za, zb = zones.get(a, ZONES[0]), zones.get(b, ZONES[0])
-        key = f"{za}:{zb}" if f"{za}:{zb}" in ZONE_LATENCY_MS \
-            else f"{zb}:{za}"
-        ms = ZONE_LATENCY_MS.get(key, 0) if za != zb else 0
-        if ms == 0:
-            return target_port
-        port = _free_port()
-        relay_specs.append(RelaySpec(
-            port=port, target_host="127.0.0.1",
-            target_port=target_port, delay_s=ms / 1000.0))
-        return port
-
-    p2p_port = {name: int(cfgs[name].p2p.laddr.rsplit(":", 1)[1])
-                for name in names}
-    for i, name in enumerate(names):
-        peers = []
-        for other in names[i + 1:]:
-            peers.append(f"{node_ids[other]}@127.0.0.1:"
-                         f"{link_port(name, other, p2p_port[other])}")
-        cfgs[name].p2p.persistent_peers = ",".join(peers)
+    names, zones, cfgs, joiner_cfg, node_ids, p2p_port, relay_specs = \
+        _setup_net(outdir, n_validators, n_full, ghosts, report)
+    for name in names:
         _write_node_overrides(cfgs[name])
 
     rpc_ep = {name: "http://" + cfgs[name].rpc.laddr[len("tcp://"):]
@@ -678,8 +662,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 rss_max_mb=round(w.rss_max_mb, 1),
                 cpu_pct=round(w.cpu_total_pct, 1),
                 mempool_max=w.mempool_max)
-            if w.tx_per_s >= 0.8 * rate:
-                report.saturation_rate = rate
+            _note_saturation(report, w, rate)
 
             if wi == 1:
                 # kill -9 + restart one validator (reference:
@@ -688,7 +671,8 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 victim = names[n_validators - 1]
                 report.perturbation = f"{victim}:kill9-restart"
                 procs[victim].kill()
-                procs[victim].wait(timeout=30)
+                await asyncio.to_thread(procs[victim].wait,
+                                        timeout=30)
                 await asyncio.sleep(0.5)
                 procs[victim] = _spawn_node(cfgs[victim].base.home)
                 sampler.track(victim, procs[victim])
@@ -705,15 +689,9 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
         cli = HTTPClient(endpoints[0], timeout=30.0)
         th = max(1, await _rpc_height(endpoints[0]) - 8)
         blk = await cli.call("block", height=str(th))
-        joiner_cfg.statesync.enable = True
-        joiner_cfg.statesync.rpc_servers = [endpoints[0],
-                                            endpoints[1]]
-        joiner_cfg.statesync.trust_height = th
-        joiner_cfg.statesync.trust_hash = blk["block_id"]["hash"]
-        joiner_cfg.statesync.discovery_time_ns = int(2e9)
-        joiner_cfg.p2p.persistent_peers = ",".join(
-            f"{node_ids[n]}@127.0.0.1:{p2p_port[n]}"
-            for n in names[:4])
+        _configure_joiner(joiner_cfg, endpoints, th,
+                          blk["block_id"]["hash"], node_ids,
+                          p2p_port, names)
         _write_node_overrides(joiner_cfg)
         target = await _rpc_height(endpoints[0])
         procs["joiner"] = _spawn_node(joiner_cfg.base.home)
@@ -748,15 +726,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             from ..types.timestamp import Timestamp
             return Timestamp.from_rfc3339(ts).unix_ns() / 1e9
 
-        secs = [_parse_ns(t) for _, t in times]
-        intervals = [b - a for a, b in zip(secs, secs[1:])]
-        if intervals:
-            report.block_interval_avg_s = statistics.mean(intervals)
-            report.block_interval_std_s = (
-                statistics.pstdev(intervals)
-                if len(intervals) > 1 else 0.0)
-            report.block_interval_min_s = min(intervals)
-            report.block_interval_max_s = max(intervals)
+        _record_intervals(report, [_parse_ns(t) for _, t in times])
 
         # --- invariants over RPC (sampled heights) ------------------
         check_eps = [rpc_ep[n] for n in names] + [joiner_ep]
@@ -785,7 +755,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 pass
         for proc in procs.values():
             try:
-                proc.wait(timeout=15)
+                await asyncio.to_thread(proc.wait, timeout=15)
             except Exception:
                 try:
                     proc.kill()
