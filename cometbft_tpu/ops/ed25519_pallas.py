@@ -13,9 +13,16 @@ analysis).  The limb convolution drops from 1024 slab MACs (32x8-bit
 kernel, kept as ed25519_pallas8.py behind COMETBFT_TPU_KERNEL=pallas8)
 to 576, and the off-grid x2 corrections are separable by residue
 class, so each of the 24 slab MACs just picks one of three pre-scaled
-copies of the multiplier.  Worst-case accumulator (both operands
-carry-normalized, exact per-position bound) is 0.93e9 < 2^31 with
-2.3x headroom.
+copies of the multiplier.
+
+Round-4 carry discipline: conv inputs that are already resting values
+(_norm outputs, pre-balanced constants) skip the input carry pass
+entirely; sums/differences of resting values and raw byte digits get
+exactly one pass, applied once per value even when it feeds several
+products.  The exact per-position worst case (field24.conv_bound over
+the resting fixed point, re-derived in tests/test_field24.py) is a
+1.474e9 conv accumulator and 1.744e9 carry pre-scale — both < 2^31.
+This removes ~60% of the input carry passes (~10% of kernel ops).
 
 Inputs are identical to the byte kernel: [32, B] byte columns for
 A and R, [64, B] nibble windows for s and k — the host prep and the
@@ -70,9 +77,11 @@ def _carry(x):
     The per-row rounding shift uses the pre-scale trick instead of a
     two-way select on the (11, 11, 10) size cycle: z = x·m with
     m = 2^(11-t_i) ∈ {1, 2} makes every row an 11-bit shift, and
-    lo = x - c·2^t_i is a per-row constant multiply.  Bound:
-    |x| ≤ 0.93e9 (the conv output bound), so |z| ≤ 1.86e9 < 2^31 —
-    1.15x headroom on the doubled rows."""
+    lo = x - c·2^t_i is a per-row constant multiply.  Bound: under the
+    relaxed carry discipline (resting operands enter the conv without
+    an input pass) the exact per-position worst case of |x·m| is
+    1.744e9 < 2^31 — 1.23x headroom (field24.conv_bound/resting_bound;
+    re-derived in tests/test_field24.py)."""
     prescale, weight = _carry_row_consts()
     c = (x * prescale + 1024) >> 11
     lo = x - c * weight
@@ -88,16 +97,31 @@ def _norm(x, passes):
     return x
 
 
-def _mul(a, b, pats):
-    """Field multiply, limb-major.  Each input gets one normalizing
-    pass (resting bound ~1030/515 per position); the 24-slab
-    convolution then stays under 0.93e9 < 2^31 (see field24.py)."""
-    return _mul_nn(_carry(a), _carry(b), pats)
+def _mul(a, b, pats, ca=1, cb=1):
+    """Field multiply, limb-major.  ca/cb = input carry passes (0 or
+    1) under the relaxed magnitude discipline (round 4):
+
+      * ca=0 — operand is a RESTING value (a _norm(.., 2) output, a
+        pre-balanced constant, or resting + O(1)).  With both operands
+        resting, the exact worst-case conv accumulator is 1.474e9 and
+        the carry pass's x*prescale peaks at 1.744e9 < 2^31 (1.23x
+        headroom) — field24.conv_bound/resting_bound compute this and
+        tests/test_field24.py re-derives it.
+      * ca=1 — operand is a sum/difference of up to 4 resting values
+        (or raw byte digits); one balanced pass brings it under ~1100
+        per limb.  That is NOT elementwise below resting (resting
+        limbs cycle down to ~543), so safety comes from the directly
+        computed bounds conv(once, R) and conv(once, once) < 2^31 and
+        from the closure property carry²(conv(once, once)) ≤ R —
+        both asserted by tests/test_field24.py, not from domination
+        by the resting case.
+
+    Default (1,1) is the always-safe round-3 behavior."""
+    return _mul_nn(_norm(a, ca), _norm(b, cb), pats)
 
 
 def _mul_nn(a, b, pats):
-    """Multiply of already-normalized operands (used by _sqr to avoid
-    re-normalizing the shared input twice)."""
+    """Multiply of operands already inside the resting bound."""
     pat1, pat2 = pats
     v0 = b
     v1 = b * pat1
@@ -115,14 +139,19 @@ def _mul_nn(a, b, pats):
 
 
 def _make_sqr(pats):
-    def _sqr(a):
-        a = _carry(a)
+    def _sqr(a, ca=0):
+        """Square; ca=1 when the input is a sum or raw byte digits
+        (same classes as _mul's ca)."""
+        a = _norm(a, ca)
         return _mul_nn(a, a, pats)
     return _sqr
 
 
-def _mul_const(x, c):
-    return _norm(x * c, 2)
+def _mul_const(x, c, passes=2):
+    """x*c normalized.  passes=1 suffices when the result only feeds
+    sums that are themselves carried before entering a conv (one
+    balanced pass from 2R lands under ~1100 per limb)."""
+    return _norm(x * c, passes)
 
 
 # --- canonical / comparisons (limb-major) ----------------------------------
@@ -213,35 +242,39 @@ def _pow_p58(x, pats):
 
     x2 = _sqr(x)
     t = _sqr(_sqr(x2))
-    z9 = _mul(x, t, pats)
-    z11 = _mul(x2, z9, pats)
-    z_5_0 = _mul(z9, _sqr(z11), pats)
-    z_10_0 = _mul(pow2k(z_5_0, 5), z_5_0, pats)
-    z_20_0 = _mul(pow2k(z_10_0, 10), z_10_0, pats)
-    z_40_0 = _mul(pow2k(z_20_0, 20), z_20_0, pats)
-    z_50_0 = _mul(pow2k(z_40_0, 10), z_10_0, pats)
-    z_100_0 = _mul(pow2k(z_50_0, 50), z_50_0, pats)
-    z_200_0 = _mul(pow2k(z_100_0, 100), z_100_0, pats)
-    z_250_0 = _mul(pow2k(z_200_0, 50), z_50_0, pats)
-    return _mul(x, pow2k(z_250_0, 2), pats)
+    z9 = _mul(x, t, pats, 0, 0)
+    z11 = _mul(x2, z9, pats, 0, 0)
+    z_5_0 = _mul(z9, _sqr(z11), pats, 0, 0)
+    z_10_0 = _mul(pow2k(z_5_0, 5), z_5_0, pats, 0, 0)
+    z_20_0 = _mul(pow2k(z_10_0, 10), z_10_0, pats, 0, 0)
+    z_40_0 = _mul(pow2k(z_20_0, 20), z_20_0, pats, 0, 0)
+    z_50_0 = _mul(pow2k(z_40_0, 10), z_10_0, pats, 0, 0)
+    z_100_0 = _mul(pow2k(z_50_0, 50), z_50_0, pats, 0, 0)
+    z_200_0 = _mul(pow2k(z_100_0, 100), z_100_0, pats, 0, 0)
+    z_250_0 = _mul(pow2k(z_200_0, 50), z_50_0, pats, 0, 0)
+    return _mul(x, pow2k(z_250_0, 2), pats, 0, 0)
 
 
 # --- point ops (extended twisted Edwards, limb-major) ----------------------
 
 def _ext_add(p, q, two_d, pats, need_t=True):
-    """Unified add (complete for a=-1)."""
+    """Unified add (complete for a=-1).  Carry discipline: inputs are
+    resting (point coords are _norm outputs; two_d is pre-balanced),
+    sums get exactly one pass, each carried once even when used by two
+    products — 8 input passes total vs 18 under the uniform rule."""
     X1, Y1, Z1, T1 = p
     X2, Y2, Z2, T2 = q
-    a = _mul(Y1 - X1, Y2 - X2, pats)
+    a = _mul(Y1 - X1, Y2 - X2, pats)            # 2R x 2R -> 1+1
     b = _mul(Y1 + X1, Y2 + X2, pats)
-    c = _mul(_mul(T1, T2, pats), two_d, pats)
-    d = _mul_const(_mul(Z1, Z2, pats), 2)
-    e = b - a
-    ff = d - c
-    g = d + c
-    h = b + a
-    return (_mul(e, ff, pats), _mul(g, h, pats), _mul(ff, g, pats),
-            _mul(e, h, pats) if need_t else None)
+    c = _mul(_mul(T1, T2, pats, 0, 0), two_d, pats, 0, 0)
+    d = _mul_const(_mul(Z1, Z2, pats, 0, 0), 2, passes=1)
+    e = _carry(b - a)
+    ff = _carry(d - c)
+    g = _carry(d + c)
+    h = _carry(b + a)
+    return (_mul(e, ff, pats, 0, 0), _mul(g, h, pats, 0, 0),
+            _mul(ff, g, pats, 0, 0),
+            _mul(e, h, pats, 0, 0) if need_t else None)
 
 
 def _ext_double(p, pats, need_t=True):
@@ -253,13 +286,15 @@ def _ext_double(p, pats, need_t=True):
     X1, Y1, Z1, _ = p
     a = _sqr(X1)
     b = _sqr(Y1)
-    c = _mul_const(_sqr(Z1), 2)
-    e = _sqr(X1 + Y1) - a - b
-    g = b - a
-    ff = g - c
-    h = -(a + b)
-    return (_mul(e, ff, pats), _mul(g, h, pats), _mul(ff, g, pats),
-            _mul(e, h, pats) if need_t else None)
+    c = _mul_const(_sqr(Z1), 2, passes=1)
+    e = _carry(_sqr(X1 + Y1, ca=1) - a - b)     # 3R -> one pass
+    g = b - a                                   # 2R
+    ff = _carry(g - c)                          # ~2.5R -> one pass
+    g = _carry(g)
+    h = _carry(-(a + b))
+    return (_mul(e, ff, pats, 0, 0), _mul(g, h, pats, 0, 0),
+            _mul(ff, g, pats, 0, 0),
+            _mul(e, h, pats, 0, 0) if need_t else None)
 
 
 def _madd_affine(p, q3, pats):
@@ -269,16 +304,16 @@ def _madd_affine(p, q3, pats):
     of the unified add (madd-2008-hwcd shape): 7 field muls vs 9."""
     X1, Y1, Z1, T1 = p
     y2mx2, y2px2, dt2 = q3
-    a = _mul(Y1 - X1, y2mx2, pats)
-    b = _mul(Y1 + X1, y2px2, pats)
-    c = _mul(T1, dt2, pats)
-    d = Z1 + Z1                 # magnitude ~2x resting; _mul re-norms
-    e = b - a
-    ff = d - c
-    g = d + c
-    h = b + a
-    return (_mul(e, ff, pats), _mul(g, h, pats),
-            _mul(ff, g, pats), _mul(e, h, pats))
+    a = _mul(Y1 - X1, y2mx2, pats, 1, 0)        # table is pre-balanced
+    b = _mul(Y1 + X1, y2px2, pats, 1, 0)
+    c = _mul(T1, dt2, pats, 0, 0)
+    d = Z1 + Z1                                 # 2R; sums below carry
+    e = _carry(b - a)
+    ff = _carry(d - c)
+    g = _carry(d + c)
+    h = _carry(b + a)
+    return (_mul(e, ff, pats, 0, 0), _mul(g, h, pats, 0, 0),
+            _mul(ff, g, pats, 0, 0), _mul(e, h, pats, 0, 0))
 
 
 def _decompress(b, d_col, sqrt_m1, four_p, pats):
@@ -289,17 +324,17 @@ def _decompress(b, d_col, sqrt_m1, four_p, pats):
     one = jnp.concatenate(
         [jnp.ones_like(y[0:1]), jnp.zeros_like(y[1:])], axis=0)
     _sqr = _make_sqr(pats)
-    yy = _sqr(y)
-    u = yy - one
-    v = _mul(yy, d_col, pats) + one
-    v3 = _mul(_sqr(v), v, pats)
-    v7 = _mul(_sqr(v3), v, pats)
-    x = _mul(_mul(u, v3, pats), _pow_p58(_mul(u, v7, pats), pats),
-             pats)
-    vxx = _mul(v, _sqr(x), pats)
+    yy = _sqr(y, ca=1)              # y is raw byte digits -> one pass
+    u = yy - one                    # resting + O(1)
+    v = _mul(yy, d_col, pats, 0, 0) + one
+    v3 = _mul(_sqr(v), v, pats, 0, 0)
+    v7 = _mul(_sqr(v3), v, pats, 0, 0)
+    x = _mul(_mul(u, v3, pats, 0, 0),
+             _pow_p58(_mul(u, v7, pats, 0, 0), pats), pats, 0, 0)
+    vxx = _mul(v, _sqr(x), pats, 0, 0)
     ok_direct = _eq(vxx, u, four_p)
     ok_flip = _eq(vxx, -u, four_p)
-    x = jnp.where(ok_flip, _mul(x, sqrt_m1, pats), x)
+    x = jnp.where(ok_flip, _mul(x, sqrt_m1, pats, 0, 0), x)
     valid = ok_direct | ok_flip
     wrong_sign = _parity(x, four_p) != sign
     x = jnp.where(wrong_sign, -x, x)
@@ -315,19 +350,23 @@ def _build_b_table_cols() -> np.ndarray:
     pts = [(0, 1)] + [ref.scalar_mult(i, ref.B) for i in range(1, 16)]
     out = np.zeros((16, 3, LIMBS, 1), np.int32)
     for i, (x, y) in enumerate(pts):
-        out[i, 0, :, 0] = f24.to_limbs((y - x) % ref.P)
-        out[i, 1, :, 0] = f24.to_limbs((y + x) % ref.P)
-        out[i, 2, :, 0] = f24.to_limbs(2 * ref.D * x * y % ref.P)
+        out[i, 0, :, 0] = f24.balance(f24.to_limbs((y - x) % ref.P))
+        out[i, 1, :, 0] = f24.balance(f24.to_limbs((y + x) % ref.P))
+        out[i, 2, :, 0] = f24.balance(
+            f24.to_limbs(2 * ref.D * x * y % ref.P))
     return out
 
 
 _B_TABLE_NP = _build_b_table_cols()
 
-# packed constants: D, 2D, sqrt(-1), 4p, pat1, pat2, then the B table
+# packed constants: D, 2D, sqrt(-1), 4p, pat1, pat2, then the B table.
+# Field-element constants ship pre-balanced (one host-side carry) so
+# they can enter the conv without a device-side input pass; 4p stays
+# raw — _canonical's unsigned sweep depends on its exact digit rows.
 _CONSTS_NP = np.concatenate([
-    f24.to_limbs(ref.D).reshape(LIMBS, 1).astype(np.int32),
-    f24.to_limbs(2 * ref.D % ref.P).reshape(LIMBS, 1).astype(np.int32),
-    f24.to_limbs(ref.SQRT_M1).reshape(LIMBS, 1).astype(np.int32),
+    f24.balance(f24.to_limbs(ref.D)).reshape(LIMBS, 1),
+    f24.balance(f24.to_limbs(2 * ref.D % ref.P)).reshape(LIMBS, 1),
+    f24.balance(f24.to_limbs(ref.SQRT_M1)).reshape(LIMBS, 1),
     f24.FOUR_P_DIGITS.reshape(LIMBS, 1).astype(np.int32),
     f24.PAT_R1.reshape(LIMBS, 1).astype(np.int32),
     f24.PAT_R2.reshape(LIMBS, 1).astype(np.int32),
@@ -358,7 +397,7 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     # -A in extended coords
     nax, nay = -ax, ay
-    nat = _mul(nax, nay, pats)
+    nat = _mul(nax, nay, pats, 0, 0)
 
     # per-lane table of i·(-A), i=0..15, in VMEM scratch
     # tab layout: [16, 4*LIMBS, B]
@@ -377,25 +416,27 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     lax.fori_loop(1, 15, build_body, 0)
 
+    def _where_tree(w, rows):
+        """16-entry select as a binary where-tree over the window's 4
+        index bits: 15 selects instead of 16 multiplies + 15 adds (the
+        masked-sum form), ~2x fewer VPU ops.  Selected bounds are the
+        max of the entries (no arithmetic on the values)."""
+        bit = 1
+        while len(rows) > 1:
+            cond = (w & bit) != 0
+            rows = [jnp.where(cond, rows[i + 1], rows[i])
+                    for i in range(0, len(rows), 2)]
+            bit <<= 1
+        return rows[0]
+
     def select_lane_table(w):
-        acc = None
-        for t in range(16):
-            m = (w == t).astype(jnp.int32)
-            term = tab_ref[t] * m
-            acc = term if acc is None else acc + term
+        acc = _where_tree(w, [tab_ref[t] for t in range(16)])
         return (acc[0:LIMBS], acc[LIMBS:2 * LIMBS],
                 acc[2 * LIMBS:3 * LIMBS], acc[3 * LIMBS:])
 
     def select_b_table(w):
-        coords = []
-        for cix in range(3):
-            acc = None
-            for t in range(16):
-                m = (w == t).astype(jnp.int32)
-                term = b_tab[t, cix] * m
-                acc = term if acc is None else acc + term
-            coords.append(acc)
-        return tuple(coords)
+        return tuple(_where_tree(w, [b_tab[t, cix] for t in range(16)])
+                     for cix in range(3))
 
     def ladder_body(j, acc):
         # only the last doubling's output feeds an addition, so only
@@ -415,7 +456,7 @@ def _kernel(a_ref, r_ref, swin_ref, kwin_ref, consts_ref, ok_ref,
 
     # subtract R, clear cofactor, identity test — nothing after the
     # subtraction reads T again
-    nrt = _mul(-rx, ry, pats)
+    nrt = _mul(-rx, ry, pats, 0, 0)
     acc = _ext_add(acc, (-rx, ry, one, nrt), two_d, pats,
                    need_t=False)
     for _ in range(3):

@@ -83,7 +83,9 @@ FOUR_P_DIGITS = 2 * TWO_P_DIGITS              # redundant, limbs < 2^12
 def carry(x: np.ndarray) -> np.ndarray:
     """One balanced parallel carry pass (golden model of the kernel's
     _carry): round-to-nearest split per position, top carry folds at
-    38.  x: [..., 24] int64-safe."""
+    38 into limb 0 and is immediately split again (fold-settle, same
+    as the kernel) so limb 0 keeps its resting bound.
+    x: [..., 24] int64-safe."""
     x = np.asarray(x, np.int64)
     c = np.empty_like(x)
     lo = np.empty_like(x)
@@ -95,7 +97,10 @@ def carry(x: np.ndarray) -> np.ndarray:
         lo[..., i] = x[..., i] - (ci << t)
     out = lo.copy()
     out[..., 1:] += c[..., :-1]
-    out[..., 0] += FOLD * c[..., -1]
+    f = FOLD * c[..., -1]
+    fc = (f + 1024) >> 11
+    out[..., 0] += f - (fc << 11)
+    out[..., 1] += fc
     return out
 
 
@@ -117,6 +122,81 @@ def mul(a: np.ndarray, b: np.ndarray) -> np.ndarray:
             acc[..., k % LIMBS] += term
     assert np.abs(acc).max() < 2**31, "int32 accumulator overflow"
     return carry(carry(acc))
+
+
+def balance(x) -> np.ndarray:
+    """Host-side: one balanced carry pass over digit rows, int32 out.
+    Used to pre-balance the kernel's constant tables so they can enter
+    the limb convolution without a device-side carry (raw canonical
+    digits reach 2^t - 1 ≈ 2x the balanced bound, which would push the
+    worst-case conv accumulator past int32 — see conv_bound)."""
+    return carry(np.asarray(x, np.int64)).astype(np.int32)
+
+
+# --- exact magnitude-bound propagation (the kernel's overflow proof) -------
+#
+# The Pallas kernel (ed25519_pallas.py) skips input-normalizing carry
+# passes wherever the operands' worst-case magnitudes keep the conv
+# accumulator (and the carry pass's x*prescale) inside int32.  These
+# functions compute those worst cases EXACTLY (python ints, no float),
+# and tests/test_field24.py re-derives the kernel's bound claims from
+# them — the discipline is proven, not estimated.
+
+_PRESCALE = [2 if i % 3 == 2 else 1 for i in range(LIMBS)]
+
+
+def carry_bound(bx) -> list:
+    """Per-limb worst-case |out| after one kernel _carry pass given
+    per-limb |x| <= bx (mirrors ed25519_pallas._carry exactly)."""
+    bx = [int(v) for v in bx]
+    c, lo = [], []
+    for i in range(LIMBS):
+        t = SIZES[i]
+        m = 1 << (11 - t)
+        c.append(max((bx[i] * m + 1024) >> 11,
+                     (bx[i] * m - 1024 + 2047) >> 11))
+        lo.append(1 << (t - 1))
+    f = c[LIMBS - 1] * FOLD
+    fc = (f + 1024) >> 11
+    out = [lo[0] + min(1024, f), lo[1] + fc + c[0]]
+    for i in range(2, LIMBS):
+        out.append(lo[i] + c[i - 1])
+    return out
+
+
+def conv_bound(ba, bb) -> list:
+    """Per-position worst-case |accumulator| of the kernel's 24-slab
+    convolution (pattern x2 factors + 38-fold) for operands bounded by
+    ba/bb per limb."""
+    ba = [int(v) for v in ba]
+    bb = [int(v) for v in bb]
+    acc = [0] * LIMBS
+    for i in range(LIMBS):
+        for j in range(LIMBS):
+            pat = 2 if (i % 3) + (j % 3) >= 3 else 1
+            term = ba[i] * bb[j] * pat
+            if i + j >= LIMBS:
+                term *= FOLD
+            acc[(i + j) % LIMBS] += term
+    return acc
+
+
+def prescaled_max(bx) -> int:
+    """max over limbs of |x|*prescale — the quantity the kernel's
+    _carry computes before its 11-bit shift; must stay < 2^31."""
+    return max(int(v) * p for v, p in zip(bx, _PRESCALE))
+
+
+def resting_bound() -> list:
+    """Fixed point of bound -> carry(carry(conv(bound, bound))): the
+    worst-case per-limb magnitude of any _norm(.., 2) output when conv
+    operands are themselves resting values (the relaxed discipline's
+    steady state)."""
+    b = [1 << (t - 1) for t in SIZES]
+    for _ in range(12):
+        nxt = carry_bound(carry_bound(conv_bound(b, b)))
+        b = [max(a, c) for a, c in zip(nxt, b)]
+    return b
 
 
 def bytes_to_limbs(b: np.ndarray) -> np.ndarray:

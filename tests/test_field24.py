@@ -92,6 +92,17 @@ class TestArithmetic:
         assert f24.from_limbs(r) == \
             f24.from_limbs(worst) * f24.from_limbs(-worst) % f24.P
 
+    def test_balance_preserves_value_and_bounds(self):
+        random.seed(4)
+        for _ in range(50):
+            x = random.randrange(f24.P)
+            b = f24.balance(f24.to_limbs(x))
+            assert b.dtype == np.int32
+            assert f24.from_limbs(b) == x
+            # balanced: inside the one-pass bound the kernel assumes
+            # for pre-balanced constants
+            assert np.abs(b).max() <= 1062
+
     def test_bytes_to_limbs_exact(self):
         random.seed(3)
         for _ in range(100):
@@ -101,3 +112,69 @@ class TestArithmetic:
             val = sum(int(v) << f24.OFFSETS[i]
                       for i, v in enumerate(digits))
             assert val == x
+
+
+class TestCarryDiscipline:
+    """Re-derive the relaxed carry discipline's overflow proof (the
+    kernel's round-4 claim that resting conv operands need no input
+    pass).  Everything here is exact integer worst-case propagation —
+    if a kernel change moves a bound past int32, this fails."""
+
+    INT32 = 2**31
+
+    def test_resting_fixed_point_exists(self):
+        r = f24.resting_bound()
+        # applying another conv+2-carry round must not grow the bound
+        nxt = f24.carry_bound(f24.carry_bound(f24.conv_bound(r, r)))
+        assert all(n <= b for n, b in zip(nxt, r))
+        assert max(r) == 2048          # limb 0, fold landing slot
+
+    def test_resting_conv_and_carry_stay_int32(self):
+        r = f24.resting_bound()
+        cb = f24.conv_bound(r, r)
+        assert max(cb) < self.INT32                    # accumulator
+        assert f24.prescaled_max(cb) < self.INT32      # carry pre-scale
+        # the headroom the kernel docstring quotes
+        assert f24.prescaled_max(cb) < 1.75e9
+
+    def test_sum_operands_need_exactly_one_pass(self):
+        r = f24.resting_bound()
+        for k in (2, 3, 4):
+            lazy = [k * v for v in r]
+            # unpassed: over int32 — the pass is NOT optional
+            assert f24.prescaled_max(f24.conv_bound(lazy, r)) >= self.INT32
+            # one balanced pass: safe, even against another carried sum
+            once = f24.carry_bound(lazy)
+            assert f24.prescaled_max(f24.conv_bound(once, r)) < self.INT32
+            assert f24.prescaled_max(f24.conv_bound(once, once)) < self.INT32
+
+    def test_once_carried_products_settle_to_resting(self):
+        # closure: every ca=0 annotation downstream of a mul of
+        # once-carried sums relies on the product re-entering the
+        # resting class after the standard two output passes —
+        # elementwise, not just max-wise
+        r = f24.resting_bound()
+        for j in (2, 3, 4):
+            for k in (2, 3, 4):
+                oj = f24.carry_bound([j * v for v in r])
+                ok = f24.carry_bound([k * v for v in r])
+                out = f24.carry_bound(f24.carry_bound(
+                    f24.conv_bound(oj, ok)))
+                assert all(o <= b for o, b in zip(out, r)), (j, k)
+
+    def test_constant_tables_must_be_balanced(self):
+        r = f24.resting_bound()
+        raw = [(1 << t) - 1 for t in f24.SIZES]        # canonical digits
+        assert f24.prescaled_max(f24.conv_bound(r, raw)) >= self.INT32
+        bal = f24.carry_bound(raw)
+        assert f24.prescaled_max(f24.conv_bound(r, bal)) < self.INT32
+
+    def test_carry_bound_is_sound_on_samples(self):
+        rng = np.random.default_rng(7)
+        for _ in range(200):
+            bx = rng.integers(1, 2**28, size=24)
+            worst = f24.carry_bound(bx)
+            for sign in (1, -1):
+                got = f24.carry(sign * bx)
+                assert (np.abs(got) <= np.array(
+                    [int(v) for v in worst])).all()
