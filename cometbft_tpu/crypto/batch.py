@@ -80,13 +80,24 @@ def get_backend() -> str:
     return _auto_probe
 
 
+# bls12381.KEY_TYPE, spelled locally so this module does not import
+# the (native-backed) bls12381 stack at process start; asserted equal
+# in tests/test_batch_grouped.py
+_BLS_KEY_TYPE = "bls12_381"
+
+
 def supports_batch_verifier(pub_key: PubKey) -> bool:
-    """Only ed25519 supports batching (reference: batch.go:21)."""
-    return pub_key.type() == ed25519.KEY_TYPE
+    """ed25519 (reference: batch.go:21) and — beyond the reference,
+    which drives blst strictly per-signature — bls12381 via the
+    random-linear-combination pairings-product verifier."""
+    return pub_key.type() in (ed25519.KEY_TYPE, _BLS_KEY_TYPE)
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     """Reference: batch.go:10 — errors for unsupported key types."""
+    if pub_key.type() == _BLS_KEY_TYPE:
+        from . import bls12381
+        return bls12381.Bls12381BatchVerifier()
     if pub_key.type() != ed25519.KEY_TYPE:
         raise ValueError(f"batch verification unsupported for {pub_key.type()}")
     if get_backend() == "tpu":

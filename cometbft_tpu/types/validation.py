@@ -12,6 +12,12 @@ The batch path dispatches through crypto.batch.create_batch_verifier, which
 routes ed25519 batches to the TPU kernel (ops/ed25519_jax.py): one padded
 device batch verifies every signature and the voting-power tally is a masked
 segment-sum in the same XLA program.
+
+Beyond the reference: MIXED-key commits — where the reference falls back to
+per-signature verification outright — run through _verify_commit_grouped,
+which batches each key-type group separately (ed25519 → TPU kernel,
+bls12381 → one RLC pairings product) and verifies the rest inline, with
+verdicts identical to the per-signature path.
 """
 from __future__ import annotations
 
@@ -52,6 +58,26 @@ def _should_batch_verify(vals: ValidatorSet, commit: Commit) -> bool:
             vals.all_keys_have_same_type())
 
 
+def _should_group_verify(vals: ValidatorSet, commit: Commit) -> bool:
+    """Mixed-key commits: batch per key-type group when any batchable
+    type appears at least twice.  The reference disables batching
+    entirely for mixed sets (types/validation.go:15-21 +
+    AllKeysHaveSameType); grouping recovers the batch win for the
+    dominant key types while unsupported ones verify inline."""
+    if len(commit.signatures) < BATCH_VERIFY_THRESHOLD:
+        return False
+    counts: dict[str, int] = {}
+    for val in vals.validators:
+        if val.pub_key is None:
+            continue
+        if crypto_batch.supports_batch_verifier(val.pub_key):
+            kt = val.pub_key.type()
+            counts[kt] = counts.get(kt, 0) + 1
+            if counts[kt] >= 2:
+                return True
+    return False
+
+
 def _verify_basic_vals_and_commit(vals: ValidatorSet, commit: Commit,
                                   height: int, block_id: BlockID) -> None:
     if vals is None:
@@ -83,6 +109,10 @@ def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
         _verify_commit_batch(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=True, look_up_by_index=True, cache=cache)
+    elif _should_group_verify(vals, commit):
+        _verify_commit_grouped(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=True, look_up_by_index=True, cache=cache)
     else:
         _verify_commit_single(
             chain_id, vals, commit, voting_power_needed, ignore, count,
@@ -102,6 +132,11 @@ def verify_commit_light(chain_id: str, vals: ValidatorSet,
     count = lambda c: True  # noqa: E731
     if _should_batch_verify(vals, commit):
         _verify_commit_batch(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=True, cache=cache)
+    elif _should_group_verify(vals, commit):
+        _verify_commit_grouped(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=count_all_signatures,
             look_up_by_index=True, cache=cache)
@@ -137,32 +172,56 @@ def verify_commit_light_trusting(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=count_all_signatures,
             look_up_by_index=False, cache=cache)
+    elif _should_group_verify(vals, commit):
+        _verify_commit_grouped(
+            chain_id, vals, commit, voting_power_needed, ignore, count,
+            count_all_signatures=count_all_signatures,
+            look_up_by_index=False, cache=cache)
     else:
         _verify_commit_single(
             chain_id, vals, commit, voting_power_needed, ignore, count,
             count_all_signatures=count_all_signatures,
             look_up_by_index=False, cache=cache)
 
-
 # ---------------------------------------------------------------------------
 
 
-def _verify_commit_batch(
+def _walk_commit(
         chain_id: str, vals: ValidatorSet, commit: Commit,
         voting_power_needed: int,
         ignore_sig: Callable[[CommitSig], bool],
         count_sig: Callable[[CommitSig], bool],
         count_all_signatures: bool, look_up_by_index: bool,
-        cache: Optional[SignatureCache]) -> None:
-    """Reference: verifyCommitBatch (:265)."""
-    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
-    seen_vals: dict[int, int] = {}
-    batch_sig_idxs: list[int] = []
-    tallied = 0
+        cache: Optional[SignatureCache], strict: bool,
+        handle: Callable) -> int:
+    """The signature walk shared by the three verification paths
+    (single / batch / grouped): ignore filter, optional structural
+    validation, by-index or by-address validator lookup with
+    double-vote detection, cache short-circuit, voting-power tally
+    with the early exit.  Returns the tallied power.
 
+    handle(idx, val, sign_bytes, commit_sig) is called for every
+    signature the cache does not satisfy — it verifies inline
+    (raising VerificationError) or defers into a batch verifier;
+    returning False stops the walk (the grouped path uses this to
+    reconcile an inline failure against its deferred groups before
+    reporting, so the LOWEST failing index is named either way).
+
+    strict adds commit_sig.validate_basic() and the nil-pubkey check
+    (the per-signature path's behavior); the same-type batch path
+    omits them, mirroring the reference's verifyCommitBatch.
+    """
+    seen_vals: dict[int, int] = {}
+    tallied = 0
     for idx, commit_sig in enumerate(commit.signatures):
         if ignore_sig(commit_sig):
             continue
+        if strict:
+            try:
+                commit_sig.validate_basic()
+            except CommitError as e:
+                raise VerificationError(
+                    f"invalid signature at index {idx}: {e}") from e
         if look_up_by_index:
             val = vals.validators[idx]
         else:
@@ -175,87 +234,7 @@ def _verify_commit_batch(
                     f"double vote from {val} "
                     f"({seen_vals[val_idx]} and {idx})")
             seen_vals[val_idx] = idx
-
-        vote_sign_bytes = commit.vote_sign_bytes(chain_id, idx)
-
-        cache_hit = False
-        if cache is not None:
-            cv = cache.get(commit_sig.signature)
-            cache_hit = (cv is not None and
-                         cv.validator_address == val.pub_key.address() and
-                         cv.vote_sign_bytes == vote_sign_bytes)
-        if not cache_hit:
-            bv.add(val.pub_key, vote_sign_bytes, commit_sig.signature)
-            batch_sig_idxs.append(idx)
-
-        if count_sig(commit_sig):
-            tallied += val.voting_power
-        if not count_all_signatures and tallied > voting_power_needed:
-            break
-
-    if tallied <= voting_power_needed:
-        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
-
-    if not batch_sig_idxs:
-        return  # everything was cached
-
-    ok, valid_sigs = bv.verify()
-    if ok:
-        if cache is not None:
-            for i in range(len(valid_sigs)):
-                idx = batch_sig_idxs[i]
-                sig = commit.signatures[idx]
-                cache.add(sig.signature, SignatureCacheValue(
-                    sig.validator_address,
-                    commit.vote_sign_bytes(chain_id, idx)))
-        return
-
-    # find and report the first invalid signature
-    for i, sig_ok in enumerate(valid_sigs):
-        idx = batch_sig_idxs[i]
-        sig = commit.signatures[idx]
-        if not sig_ok:
-            raise VerificationError(
-                f"wrong signature (#{idx}): {sig.signature.hex().upper()}")
-        if cache is not None:
-            cache.add(sig.signature, SignatureCacheValue(
-                sig.validator_address,
-                commit.vote_sign_bytes(chain_id, idx)))
-    raise VerificationError(
-        "BUG: batch verification failed with no invalid signatures")
-
-
-def _verify_commit_single(
-        chain_id: str, vals: ValidatorSet, commit: Commit,
-        voting_power_needed: int,
-        ignore_sig: Callable[[CommitSig], bool],
-        count_sig: Callable[[CommitSig], bool],
-        count_all_signatures: bool, look_up_by_index: bool,
-        cache: Optional[SignatureCache]) -> None:
-    """Reference: verifyCommitSingle (:413)."""
-    seen_vals: dict[int, int] = {}
-    tallied = 0
-    for idx, commit_sig in enumerate(commit.signatures):
-        if ignore_sig(commit_sig):
-            continue
-        try:
-            commit_sig.validate_basic()
-        except CommitError as e:
-            raise VerificationError(
-                f"invalid signature at index {idx}: {e}") from e
-        if look_up_by_index:
-            val = vals.validators[idx]
-        else:
-            val_idx, val = vals.get_by_address(
-                commit_sig.validator_address)
-            if val is None:
-                continue
-            if val_idx in seen_vals:
-                raise VerificationError(
-                    f"double vote from {val} "
-                    f"({seen_vals[val_idx]} and {idx})")
-            seen_vals[val_idx] = idx
-        if val.pub_key is None:
+        if strict and val.pub_key is None:
             raise VerificationError(
                 f"validator {val} has a nil PubKey at index {idx}")
 
@@ -268,19 +247,192 @@ def _verify_commit_single(
                          cv.validator_address == val.pub_key.address() and
                          cv.vote_sign_bytes == vote_sign_bytes)
         if not cache_hit:
-            if not val.pub_key.verify_signature(vote_sign_bytes,
-                                                commit_sig.signature):
-                raise VerificationError(
-                    f"wrong signature (#{idx}): "
-                    f"{commit_sig.signature.hex().upper()}")
-            if cache is not None:
-                cache.add(commit_sig.signature, SignatureCacheValue(
-                    val.pub_key.address(), vote_sign_bytes))
+            if handle(idx, val, vote_sign_bytes, commit_sig) is False:
+                break
 
         if count_sig(commit_sig):
             tallied += val.voting_power
         if not count_all_signatures and tallied > voting_power_needed:
-            return
+            break
+    return tallied
+
+
+def _verify_commit_batch(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        voting_power_needed: int,
+        ignore_sig: Callable[[CommitSig], bool],
+        count_sig: Callable[[CommitSig], bool],
+        count_all_signatures: bool, look_up_by_index: bool,
+        cache: Optional[SignatureCache]) -> None:
+    """Reference: verifyCommitBatch (:265) — including its ordering:
+    the voting-power threshold is judged before the deferred batch
+    runs.  Cache entries record the VERIFIED key's address, never
+    commit_sig.validator_address: in by-index mode that field is
+    attacker-controlled, and caching it would let one validator's
+    signature poison the cache under another validator's address
+    (canonical vote sign bytes exclude address/index, so a later
+    by-index lookup in the other validator's slot would hit)."""
+    bv = crypto_batch.create_batch_verifier(vals.get_proposer().pub_key)
+    entries: list[tuple[int, bytes, bytes]] = []
+
+    def handle(idx, val, sign_bytes, commit_sig):
+        try:
+            bv.add(val.pub_key, sign_bytes, commit_sig.signature)
+        except ValueError as e:
+            # malformed (e.g. wrong-length) signature the structural
+            # checks let through — the reference returns Add's error
+            # here; surface it as the usual wrong-signature verdict
+            raise VerificationError(
+                f"wrong signature (#{idx}): "
+                f"{commit_sig.signature.hex().upper()}") from e
+        entries.append((idx, val.pub_key.address(), sign_bytes))
+
+    tallied = _walk_commit(
+        chain_id, vals, commit, voting_power_needed, ignore_sig,
+        count_sig, count_all_signatures, look_up_by_index, cache,
+        strict=False, handle=handle)
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+    if not entries:
+        return  # everything was cached
+
+    ok, valid_sigs = bv.verify()
+    if ok:
+        if cache is not None:
+            for idx, addr, sign_bytes in entries:
+                cache.add(commit.signatures[idx].signature,
+                          SignatureCacheValue(addr, sign_bytes))
+        return
+
+    # find and report the first invalid signature
+    for sig_ok, (idx, addr, sign_bytes) in zip(valid_sigs, entries):
+        sig = commit.signatures[idx]
+        if not sig_ok:
+            raise VerificationError(
+                f"wrong signature (#{idx}): {sig.signature.hex().upper()}")
+        if cache is not None:
+            cache.add(sig.signature,
+                      SignatureCacheValue(addr, sign_bytes))
+    raise VerificationError(
+        "BUG: batch verification failed with no invalid signatures")
+
+
+def _verify_commit_grouped(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        voting_power_needed: int,
+        ignore_sig: Callable[[CommitSig], bool],
+        count_sig: Callable[[CommitSig], bool],
+        count_all_signatures: bool, look_up_by_index: bool,
+        cache: Optional[SignatureCache]) -> None:
+    """Mixed-key commit verification with per-key-type batch groups
+    (TPU-native extension; see _should_group_verify).  Walk semantics
+    match _verify_commit_single (strict structural checks, cache,
+    early threshold exit); batchable signatures defer into one
+    verifier per key type, unsupported ones verify inline.  Verdict
+    parity with the single path: any invalid signature raises
+    VerificationError naming the LOWEST failing commit index — an
+    inline failure stops the walk and is reconciled against the
+    deferred groups before reporting — and does so before the
+    voting-power threshold is judged, as inline verification would.
+    """
+    # key type -> (verifier, [(idx, key address, sign bytes)])
+    groups: dict[str, tuple] = {}
+    inline_bad: Optional[int] = None
+
+    def handle(idx, val, sign_bytes, commit_sig):
+        nonlocal inline_bad
+        if crypto_batch.supports_batch_verifier(val.pub_key):
+            kt = val.pub_key.type()
+            entry = groups.get(kt)
+            if entry is None:
+                entry = (crypto_batch.create_batch_verifier(val.pub_key),
+                         [])
+                groups[kt] = entry
+            try:
+                entry[0].add(val.pub_key, sign_bytes,
+                             commit_sig.signature)
+            except ValueError:
+                # malformed signature the structural checks let
+                # through (e.g. wrong length): same verdict as a
+                # failed inline verify, reconciled for lowest index
+                inline_bad = idx
+                return False
+            entry[1].append((idx, val.pub_key.address(), sign_bytes))
+            return None
+        if not val.pub_key.verify_signature(sign_bytes,
+                                            commit_sig.signature):
+            inline_bad = idx
+            return False        # stop: reconcile vs deferred groups
+        if cache is not None:
+            cache.add(commit_sig.signature, SignatureCacheValue(
+                val.pub_key.address(), sign_bytes))
+        return None
+
+    tallied = _walk_commit(
+        chain_id, vals, commit, voting_power_needed, ignore_sig,
+        count_sig, count_all_signatures, look_up_by_index, cache,
+        strict=True, handle=handle)
+
+    first_bad: Optional[int] = inline_bad
+    for bv, entries in groups.values():
+        if not entries:
+            continue
+        ok, valid_sigs = bv.verify()
+        if ok:
+            if cache is not None:
+                for idx, addr, sign_bytes in entries:
+                    cache.add(commit.signatures[idx].signature,
+                              SignatureCacheValue(addr, sign_bytes))
+            continue
+        group_bad = [entries[i][0] for i, sig_ok in enumerate(valid_sigs)
+                     if not sig_ok]
+        if not group_bad:
+            raise VerificationError(
+                "BUG: batch verification failed with no invalid "
+                "signatures")
+        if cache is not None:
+            bad_set = set(group_bad)
+            for idx, addr, sign_bytes in entries:
+                if idx not in bad_set:
+                    cache.add(commit.signatures[idx].signature,
+                              SignatureCacheValue(addr, sign_bytes))
+        if first_bad is None or group_bad[0] < first_bad:
+            first_bad = group_bad[0]
+    if first_bad is not None:
+        sig = commit.signatures[first_bad]
+        raise VerificationError(
+            f"wrong signature (#{first_bad}): "
+            f"{sig.signature.hex().upper()}")
+
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+
+def _verify_commit_single(
+        chain_id: str, vals: ValidatorSet, commit: Commit,
+        voting_power_needed: int,
+        ignore_sig: Callable[[CommitSig], bool],
+        count_sig: Callable[[CommitSig], bool],
+        count_all_signatures: bool, look_up_by_index: bool,
+        cache: Optional[SignatureCache]) -> None:
+    """Reference: verifyCommitSingle (:413)."""
+
+    def handle(idx, val, sign_bytes, commit_sig):
+        if not val.pub_key.verify_signature(sign_bytes,
+                                            commit_sig.signature):
+            raise VerificationError(
+                f"wrong signature (#{idx}): "
+                f"{commit_sig.signature.hex().upper()}")
+        if cache is not None:
+            cache.add(commit_sig.signature, SignatureCacheValue(
+                val.pub_key.address(), sign_bytes))
+
+    tallied = _walk_commit(
+        chain_id, vals, commit, voting_power_needed, ignore_sig,
+        count_sig, count_all_signatures, look_up_by_index, cache,
+        strict=True, handle=handle)
 
     if tallied <= voting_power_needed:
         raise NotEnoughVotingPowerError(tallied, voting_power_needed)

@@ -1,0 +1,236 @@
+"""BLS batch verification + per-key-type grouped commit verification.
+
+The reference batches only ed25519 and only when ALL validators share
+one key type (crypto/batch/batch.go:21, types/validation.go:15-21).
+This framework adds (a) a bls12381 batch verifier — one random-linear-
+combination pairings product, n+1 Miller loops sharing a single final
+exponentiation (crypto/bls12381.py Bls12381BatchVerifier) — and (b) a
+grouped commit path that batches each key-type group of a MIXED
+validator set (types/validation.py _verify_commit_grouped).  Verdict
+parity with the per-signature path is what these tests pin.
+"""
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import bls12381, ed25519, secp256k1
+from cometbft_tpu.types import canonical
+from cometbft_tpu.types.block_id import BlockID
+from cometbft_tpu.types.commit import (
+    BLOCK_ID_FLAG_COMMIT, Commit, CommitSig)
+from cometbft_tpu.types.part_set import PartSetHeader
+from cometbft_tpu.types.signature_cache import SignatureCache
+from cometbft_tpu.types.timestamp import Timestamp
+from cometbft_tpu.types.validation import (
+    VerificationError, _should_group_verify, verify_commit,
+    verify_commit_light_trusting, Fraction)
+from cometbft_tpu.types.validator import Validator
+from cometbft_tpu.types.validator_set import ValidatorSet
+from cometbft_tpu.types.vote import Vote
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def _bls_keys(n):
+    return [bls12381.gen_priv_key_from_secret(b"grouped-%d" % i)
+            for i in range(n)]
+
+
+class TestBlsBatchVerifier:
+    def test_all_valid(self):
+        privs = _bls_keys(3)
+        bv = bls12381.Bls12381BatchVerifier()
+        for i, p in enumerate(privs):
+            msg = b"vote %d" % i
+            bv.add(p.pub_key(), msg, p.sign(msg))
+        ok, mask = bv.verify()
+        assert ok and mask == [True, True, True]
+
+    def test_flags_exactly_the_bad_signature(self):
+        privs = _bls_keys(3)
+        bv = bls12381.Bls12381BatchVerifier()
+        for i, p in enumerate(privs):
+            msg = b"vote %d" % i
+            sig = p.sign(msg)
+            if i == 1:
+                msg = b"forged"      # signature over a different msg
+            bv.add(p.pub_key(), msg, sig)
+        ok, mask = bv.verify()
+        assert not ok and mask == [True, False, True]
+
+    def test_garbage_signature_bytes(self):
+        privs = _bls_keys(2)
+        bv = bls12381.Bls12381BatchVerifier()
+        bv.add(privs[0].pub_key(), b"m0", privs[0].sign(b"m0"))
+        bv.add(privs[1].pub_key(), b"m1", b"\xff" * 96)
+        ok, mask = bv.verify()
+        assert not ok and mask == [True, False]
+
+    def test_single_item_and_empty(self):
+        bv = bls12381.Bls12381BatchVerifier()
+        assert bv.verify() == (False, [])
+        p = _bls_keys(1)[0]
+        bv.add(p.pub_key(), b"solo", p.sign(b"solo"))
+        assert bv.verify() == (True, [True])
+
+    def test_dispatch_creates_bls_verifier(self):
+        pk = _bls_keys(1)[0].pub_key()
+        assert crypto_batch.supports_batch_verifier(pk)
+        bv = crypto_batch.create_batch_verifier(pk)
+        assert isinstance(bv, bls12381.Bls12381BatchVerifier)
+        # the locally spelled tag must track the real one
+        assert crypto_batch._BLS_KEY_TYPE == bls12381.KEY_TYPE
+
+
+def _mixed_commit(n_ed=3, n_bls=2, n_secp=1, chain_id="grouped-chain",
+                  height=7, corrupt=None):
+    privs = ([ed25519.gen_priv_key() for _ in range(n_ed)] +
+             _bls_keys(n_bls) +
+             [secp256k1.gen_priv_key() for _ in range(n_secp)])
+    vals = [Validator.new(p.pub_key(), 10) for p in privs]
+    pairs = sorted(zip(vals, privs),
+                   key=lambda vp: (-vp[0].voting_power, vp[0].address))
+    vset = ValidatorSet([p[0] for p in pairs])
+    privs = [p[1] for p in pairs]
+    block_id = BlockID(hash=b"\x77" * 32,
+                       part_set_header=PartSetHeader(1, b"\x88" * 32))
+    sigs = []
+    for i, (val, priv) in enumerate(zip(vset.validators, privs)):
+        ts = Timestamp(1700000100 + i, 0)
+        v = Vote(type=canonical.PRECOMMIT_TYPE, height=height, round=0,
+                 block_id=block_id, timestamp=ts,
+                 validator_address=val.address, validator_index=i)
+        sig = priv.sign(v.sign_bytes(chain_id))
+        if corrupt is not None and i == corrupt:
+            sig = bytes([sig[0] ^ 0x01]) + sig[1:]
+        sigs.append(CommitSig(block_id_flag=BLOCK_ID_FLAG_COMMIT,
+                              validator_address=val.address,
+                              timestamp=ts, signature=sig))
+    commit = Commit(height=height, round=0, block_id=block_id,
+                    signatures=sigs)
+    return chain_id, vset, block_id, height, commit
+
+
+class TestGroupedCommitVerify:
+    def test_gate_engages_only_for_mixed_with_batchable_pair(self):
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        assert not vset.all_keys_have_same_type()
+        assert _should_group_verify(vset, commit)
+        # all-secp set: nothing batchable
+        _, vset2, _, _, commit2 = _mixed_commit(n_ed=0, n_bls=0, n_secp=4)
+        assert not _should_group_verify(vset2, commit2)
+
+    def test_mixed_commit_verifies(self):
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        verify_commit(chain_id, vset, bid, h, commit)
+
+    @pytest.mark.parametrize("corrupt", [0, 2, 4, 5])
+    def test_corrupt_signature_rejected_with_exact_index(self, corrupt):
+        chain_id, vset, bid, h, commit = _mixed_commit(corrupt=corrupt)
+        with pytest.raises(VerificationError) as ei:
+            verify_commit(chain_id, vset, bid, h, commit)
+        assert f"#{corrupt}" in str(ei.value)
+
+    def test_lowest_failing_index_across_inline_and_deferred(self):
+        # verdict parity: a deferred (batchable) bad signature at a
+        # lower index must win over an inline (secp) failure at a
+        # higher one, and vice versa — the single path reports the
+        # first failure in walk order
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        types = [v.pub_key.type() for v in vset.validators]
+        deferred_idx = min(i for i, t in enumerate(types)
+                           if t != "secp256k1")
+        inline_idx = types.index("secp256k1")
+        sigs = list(commit.signatures)
+        for i in (deferred_idx, inline_idx):
+            s = sigs[i]
+            sigs[i] = CommitSig(
+                block_id_flag=s.block_id_flag,
+                validator_address=s.validator_address,
+                timestamp=s.timestamp,
+                signature=bytes([s.signature[0] ^ 1]) + s.signature[1:])
+        bad_commit = Commit(height=h, round=0, block_id=bid,
+                            signatures=sigs)
+        with pytest.raises(VerificationError) as ei:
+            verify_commit(chain_id, vset, bid, h, bad_commit)
+        assert f"#{min(deferred_idx, inline_idx)}" in str(ei.value)
+
+    def test_cache_populated_and_reused(self):
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        cache = SignatureCache()
+        verify_commit(chain_id, vset, bid, h, commit, cache=cache)
+        assert len(cache) == len(commit.signatures)
+        # second run: everything cached, still verifies
+        verify_commit(chain_id, vset, bid, h, commit, cache=cache)
+
+    def test_light_trusting_mixed(self):
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        verify_commit_light_trusting(
+            chain_id, vset, commit, Fraction(1, 3))
+
+    def test_cache_records_verified_key_address_not_commit_field(self):
+        # regression (review finding): in by-index mode the commit's
+        # validator_address field is attacker-controlled; caching it
+        # would let validator A's signature populate a cache entry
+        # under validator B's address (sign bytes exclude address, so
+        # a later by-index check in B's slot would hit and tally B's
+        # power without B signing).
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        spoof_to = vset.validators[3].address
+        s = commit.signatures[0]
+        commit.signatures[0] = CommitSig(
+            block_id_flag=s.block_id_flag,
+            validator_address=spoof_to,       # lie about the signer
+            timestamp=s.timestamp, signature=s.signature)
+        cache = SignatureCache()
+        verify_commit(chain_id, vset, bid, h, commit, cache=cache)
+        cv = cache.get(s.signature)
+        assert cv is not None
+        # cached under the key that actually verified (validator 0)
+        assert cv.validator_address == \
+            vset.validators[0].pub_key.address()
+        assert cv.validator_address != spoof_to
+
+    def test_forged_sig_reported_even_without_quorum(self):
+        # regression (review finding): verdict parity with the single
+        # path requires wrong-signature to surface before the
+        # voting-power threshold is judged
+        chain_id, vset, bid, h, commit = _mixed_commit(corrupt=1)
+        # drop most signatures to absent so power is insufficient too
+        for i in range(3, len(commit.signatures)):
+            commit.signatures[i] = CommitSig.absent()
+        with pytest.raises(VerificationError) as ei:
+            verify_commit(chain_id, vset, bid, h, commit)
+        assert "wrong signature" in str(ei.value)
+
+    def test_wrong_length_signature_is_verification_error(self):
+        # regression (review finding): a 32-byte "signature" passes
+        # CommitSig.validate_basic (<= max size) but BatchVerifier.add
+        # raises ValueError; that must surface as the usual
+        # wrong-signature VerificationError, not escape as ValueError
+        chain_id, vset, bid, h, commit = _mixed_commit()
+        types = [v.pub_key.type() for v in vset.validators]
+        idx = types.index("ed25519")
+        s = commit.signatures[idx]
+        commit.signatures[idx] = CommitSig(
+            block_id_flag=s.block_id_flag,
+            validator_address=s.validator_address,
+            timestamp=s.timestamp, signature=b"\x01" * 32)
+        with pytest.raises(VerificationError) as ei:
+            verify_commit(chain_id, vset, bid, h, commit)
+        assert f"#{idx}" in str(ei.value)
+
+    def test_all_bls_set_routes_through_plain_batch(self):
+        # same-type BLS sets now pass the _should_batch_verify gate
+        chain_id, vset, bid, h, commit = _mixed_commit(
+            n_ed=0, n_bls=4, n_secp=0)
+        assert vset.all_keys_have_same_type()
+        verify_commit(chain_id, vset, bid, h, commit)
+        _, vset2, bid2, h2, commit2 = _mixed_commit(
+            n_ed=0, n_bls=4, n_secp=0, corrupt=1)
+        with pytest.raises(VerificationError):
+            verify_commit("grouped-chain", vset2, bid2, h2, commit2)
