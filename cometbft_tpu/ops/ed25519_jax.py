@@ -34,21 +34,31 @@ _CACHE_CONFIGURED = False
 
 
 def enable_compilation_cache() -> None:
-    """Point JAX's persistent compilation cache at a repo-local directory
-    so the kernel compiles once per bucket shape per machine, not once per
-    process.  Called lazily on first kernel use; a cache dir already
-    configured by the embedding application wins.  Override the location
-    with COMETBFT_TPU_JAX_CACHE."""
+    """Point JAX's persistent compilation cache at a repo-local,
+    host-feature-keyed directory so the kernel compiles once per
+    bucket shape per machine, not once per process — and a cache
+    carried to a different machine is simply not found rather than
+    replayed with mismatched CPU features.  Called lazily on first
+    kernel use; a cache dir already configured by the embedding
+    application wins.  Override the location with
+    COMETBFT_TPU_JAX_CACHE."""
     global _CACHE_CONFIGURED
     if _CACHE_CONFIGURED:
         return
     _CACHE_CONFIGURED = True
     if jax.config.jax_compilation_cache_dir:
         return
-    cache_dir = os.environ.get(
-        "COMETBFT_TPU_JAX_CACHE",
-        os.path.join(os.path.dirname(os.path.dirname(
-            os.path.dirname(os.path.abspath(__file__)))), ".jax_cache"))
+    cache_dir = os.environ.get("COMETBFT_TPU_JAX_CACHE")
+    if not cache_dir:
+        # keyed by the CPU-feature fingerprint (shared with the
+        # -march=native module loader): serialized XLA:CPU
+        # executables are pinned to the compiling host's features,
+        # and this tree persists across hosts between rounds
+        from ..crypto._native_loader import _host_tag
+        cache_dir = os.path.join(
+            os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+            ".jax_cache", _host_tag()[:12])
     jax.config.update("jax_compilation_cache_dir", cache_dir)
     jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
 

@@ -234,7 +234,12 @@ def _walk_commit(
                     f"double vote from {val} "
                     f"({seen_vals[val_idx]} and {idx})")
             seen_vals[val_idx] = idx
-        if strict and val.pub_key is None:
+        if val.pub_key is None:
+            # unconditional (not strict-gated): the same-type gate
+            # skips nil-pubkey validators, so a nil key CAN reach the
+            # batch path, where BatchVerifier.add would raise
+            # TypeError and the cache probe below would crash — the
+            # reference's batch path rejects via Add's error return
             raise VerificationError(
                 f"validator {val} has a nil PubKey at index {idx}")
 
@@ -278,7 +283,7 @@ def _verify_commit_batch(
     def handle(idx, val, sign_bytes, commit_sig):
         try:
             bv.add(val.pub_key, sign_bytes, commit_sig.signature)
-        except ValueError as e:
+        except (ValueError, TypeError) as e:
             # malformed (e.g. wrong-length) signature the structural
             # checks let through — the reference returns Add's error
             # here; surface it as the usual wrong-signature verdict
@@ -353,7 +358,7 @@ def _verify_commit_grouped(
             try:
                 entry[0].add(val.pub_key, sign_bytes,
                              commit_sig.signature)
-            except ValueError:
+            except (ValueError, TypeError):
                 # malformed signature the structural checks let
                 # through (e.g. wrong length): same verdict as a
                 # failed inline verify, reconciled for lowest index

@@ -224,6 +224,20 @@ class TestGroupedCommitVerify:
             verify_commit(chain_id, vset, bid, h, commit)
         assert f"#{idx}" in str(ei.value)
 
+    def test_nil_pubkey_rejected_not_crash(self):
+        # regression (review finding): the same-type gate skips
+        # nil-pubkey validators, so a nil key reaches the batch path;
+        # it must reject with VerificationError, not escape as
+        # TypeError from BatchVerifier.add
+        chain_id, vset, bid, h, commit = _mixed_commit(
+            n_ed=4, n_bls=0, n_secp=0)
+        assert vset.all_keys_have_same_type()
+        vset.validators[2].pub_key = None
+        assert vset.all_keys_have_same_type()   # gate still passes
+        with pytest.raises(VerificationError) as ei:
+            verify_commit(chain_id, vset, bid, h, commit)
+        assert "nil PubKey" in str(ei.value)
+
     def test_all_bls_set_routes_through_plain_batch(self):
         # same-type BLS sets now pass the _should_batch_verify gate
         chain_id, vset, bid, h, commit = _mixed_commit(
