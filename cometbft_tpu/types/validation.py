@@ -207,9 +207,10 @@ def _walk_commit(
     reconcile an inline failure against its deferred groups before
     reporting, so the LOWEST failing index is named either way).
 
-    strict adds commit_sig.validate_basic() and the nil-pubkey check
-    (the per-signature path's behavior); the same-type batch path
-    omits them, mirroring the reference's verifyCommitBatch.
+    strict adds commit_sig.validate_basic() (the per-signature path's
+    behavior); the same-type batch path omits it, mirroring the
+    reference's verifyCommitBatch.  The nil-pubkey check is
+    UNCONDITIONAL on every path — see the comment at the raise.
     """
     seen_vals: dict[int, int] = {}
     tallied = 0

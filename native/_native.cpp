@@ -22,6 +22,7 @@
 #include "sha256.hpp"
 #include "sha512.hpp"
 #include "sha512_mb.hpp"
+#include "ed25519_msm.hpp"
 #include "bls12381.hpp"
 
 namespace {
@@ -699,6 +700,77 @@ PyObject* sha256_one(PyObject*, PyObject* arg) {
         reinterpret_cast<const char*>(out), 32);
 }
 
+// ed25519_batch_verify(items, z) -> int
+// items: sequence of (pub, msg, sig) byte tuples; z: 16*len(items)
+// random bytes (one 128-bit randomizer per item, bit 0 forced odd in
+// C).  Returns 1 iff the RLC batch equation holds for every item
+// (ZIP-215 semantics); 0 on any malformed input or batch reject —
+// the caller falls back to the per-signature path for the mask.
+// The CPU analog of the reference's voi batch verifier
+// (crypto/ed25519/ed25519.go:189-222); see ed25519_msm.hpp.
+PyObject* ed25519_batch_verify(PyObject*, PyObject* args) {
+    PyObject* seq_in;
+    const char* z_bytes;
+    Py_ssize_t z_len;
+    if (!PyArg_ParseTuple(args, "Oy#", &seq_in, &z_bytes, &z_len))
+        return nullptr;
+    PyObject* fast = PySequence_Fast(seq_in, "expected a sequence");
+    if (!fast) return nullptr;
+    Py_ssize_t n = PySequence_Fast_GET_SIZE(fast);
+    if (z_len != n * 16) {
+        Py_DECREF(fast);
+        PyErr_SetString(PyExc_ValueError,
+                        "need 16 randomizer bytes per item");
+        return nullptr;
+    }
+    std::vector<ed25519_msm::BatchItem> items;
+    items.reserve(size_t(n));
+    std::vector<PyObject*> fits;
+    fits.reserve(size_t(n));
+    bool shape_ok = true;
+    for (Py_ssize_t i = 0; i < n && shape_ok; i++) {
+        PyObject* it = PySequence_Fast_GET_ITEM(fast, i);
+        PyObject* fit = PySequence_Fast(it, "item must be a tuple");
+        if (!fit || PySequence_Fast_GET_SIZE(fit) != 3) {
+            PyErr_Clear();
+            Py_XDECREF(fit);
+            shape_ok = false;
+            break;
+        }
+        fits.push_back(fit);
+        char *pub, *msg, *sig;
+        Py_ssize_t publen, msglen, siglen;
+        if (PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fit, 0),
+                                    &pub, &publen) < 0 ||
+            PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fit, 1),
+                                    &msg, &msglen) < 0 ||
+            PyBytes_AsStringAndSize(PySequence_Fast_GET_ITEM(fit, 2),
+                                    &sig, &siglen) < 0) {
+            PyErr_Clear();
+            shape_ok = false;
+            break;
+        }
+        if (publen != 32 || siglen != 64) {
+            shape_ok = false;
+            break;
+        }
+        items.push_back(ed25519_msm::BatchItem{
+            reinterpret_cast<uint8_t*>(pub),
+            reinterpret_cast<uint8_t*>(msg), size_t(msglen),
+            reinterpret_cast<uint8_t*>(sig)});
+    }
+    int ok = 0;
+    if (shape_ok) {
+        const uint8_t* z = reinterpret_cast<const uint8_t*>(z_bytes);
+        Py_BEGIN_ALLOW_THREADS
+        ok = ed25519_msm::batch_verify(items, z);
+        Py_END_ALLOW_THREADS
+    }
+    for (PyObject* fit : fits) Py_DECREF(fit);
+    Py_DECREF(fast);
+    return PyLong_FromLong(ok);
+}
+
 PyMethodDef kMethods[] = {
     {"merkle_root", merkle_root, METH_O,
      "RFC-6962/CometBFT merkle root of a sequence of bytes"},
@@ -710,6 +782,8 @@ PyMethodDef kMethods[] = {
      "concatenated SHA-512 digests of a sequence of bytes"},
     {"ed25519_kscalars", ed25519_kscalars, METH_O,
      "concatenated SHA-512(item) mod L scalars (32B LE each)"},
+    {"ed25519_batch_verify", ed25519_batch_verify, METH_VARARGS,
+     "RLC batch verification of (pub, msg, sig) items (ZIP-215)"},
     {"ed25519_prep", ed25519_prep, METH_VARARGS,
      "full batch-verify host prep: (items, m, B, identity) -> "
      "(a_b, r_b, s_win, k_win, pre_bad)"},

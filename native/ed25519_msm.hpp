@@ -1,0 +1,536 @@
+// ed25519 batch verification on the host CPU: random-linear-combination
+// batch equation + Pippenger multi-scalar multiplication.
+//
+// This is the CPU analog of the reference's batch verifier
+// (crypto/ed25519/ed25519.go:189-222 — curve25519-voi accumulates
+// (pk, msg, sig) triples and verifies them in one multi-exponentiation
+// with per-signature randomizers).  The engine's TPU kernel carries the
+// same equation on-device; this path serves CPU-only hosts and the
+// per-signature OpenSSL loop becomes the fallback that names invalid
+// entries after a batch reject.
+//
+//   accept  iff  [8]( -(sum z_i s_i mod L)·B + sum z_i·R_i
+//                      + sum (z_i k_i mod L)·A_i ) == identity
+//
+// with fresh odd 128-bit z_i, k_i = SHA-512(R||A||msg) mod L, and
+// ZIP-215 semantics throughout: permissive A/R decoding (y >= p
+// accepted, x=0 with sign=1 accepted), canonical-S required, cofactor
+// cleared by the trailing three doublings.  Differentially tested
+// against the pure-Python golden model (crypto/_ed25519_ref.py
+// batch_verify) in tests/test_native.py.
+//
+// Field arithmetic: 5x51-bit limbs in uint64, products via unsigned
+// __int128 (the standard radix-51 representation).  The unified
+// twisted-Edwards addition is COMPLETE for ed25519 (a = -1 is a square
+// mod p, d is non-square), so bucket accumulation never needs case
+// analysis even for torsion or small-order inputs.
+#pragma once
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+#include "sha512.hpp"
+
+namespace ed25519_msm {
+
+typedef unsigned __int128 u128;
+
+// ---------------------------------------------------------------- fe
+
+struct fe {
+    uint64_t v[5];      // radix 2^51
+};
+
+static const uint64_t MASK51 = (uint64_t(1) << 51) - 1;
+
+inline fe fe_zero() { return fe{{0, 0, 0, 0, 0}}; }
+inline fe fe_one() { return fe{{1, 0, 0, 0, 0}}; }
+
+inline fe fe_add(const fe& a, const fe& b) {
+    fe r;
+    for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
+    return r;
+}
+
+// a - b + 8p, so the result is nonnegative for any b with limbs
+// < 2^54 - 152 (the laziest value the point formulas produce is
+// ~2^53.1).  8p limb-wise: limb0 = 2^54 - 152, others 2^54 - 8.
+inline fe fe_sub(const fe& a, const fe& b) {
+    fe r;
+    r.v[0] = a.v[0] + 0x3FFFFFFFFFFF68ull - b.v[0];
+    r.v[1] = a.v[1] + 0x3FFFFFFFFFFFF8ull - b.v[1];
+    r.v[2] = a.v[2] + 0x3FFFFFFFFFFFF8ull - b.v[2];
+    r.v[3] = a.v[3] + 0x3FFFFFFFFFFFF8ull - b.v[3];
+    r.v[4] = a.v[4] + 0x3FFFFFFFFFFFF8ull - b.v[4];
+    return r;
+}
+
+// one carry sweep: limbs -> < 2^52 (top folds at 19)
+inline void fe_carry(fe& a) {
+    uint64_t c;
+    for (int i = 0; i < 4; i++) {
+        c = a.v[i] >> 51;
+        a.v[i] &= MASK51;
+        a.v[i + 1] += c;
+    }
+    c = a.v[4] >> 51;
+    a.v[4] &= MASK51;
+    a.v[0] += c * 19;
+}
+
+inline fe fe_mul(const fe& a, const fe& b) {
+    u128 t0, t1, t2, t3, t4;
+    uint64_t a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3],
+             a4 = a.v[4];
+    uint64_t b0 = b.v[0], b1 = b.v[1], b2 = b.v[2], b3 = b.v[3],
+             b4 = b.v[4];
+    uint64_t b1_19 = b1 * 19, b2_19 = b2 * 19, b3_19 = b3 * 19,
+             b4_19 = b4 * 19;
+    t0 = (u128)a0 * b0 + (u128)a1 * b4_19 + (u128)a2 * b3_19 +
+         (u128)a3 * b2_19 + (u128)a4 * b1_19;
+    t1 = (u128)a0 * b1 + (u128)a1 * b0 + (u128)a2 * b4_19 +
+         (u128)a3 * b3_19 + (u128)a4 * b2_19;
+    t2 = (u128)a0 * b2 + (u128)a1 * b1 + (u128)a2 * b0 +
+         (u128)a3 * b4_19 + (u128)a4 * b3_19;
+    t3 = (u128)a0 * b3 + (u128)a1 * b2 + (u128)a2 * b1 +
+         (u128)a3 * b0 + (u128)a4 * b4_19;
+    t4 = (u128)a0 * b4 + (u128)a1 * b3 + (u128)a2 * b2 +
+         (u128)a3 * b1 + (u128)a4 * b0;
+    // u128 carry chain: lazy inputs reach ~2^55.3 per limb, so the
+    // column sums stay under 5 * 2^55.3 * (19 * 2^55.3) ~ 2^117.2 and
+    // the top carry times 19 can exceed 2^64 — keep it wide
+    fe r;
+    u128 c;
+    r.v[0] = (uint64_t)t0 & MASK51; c = t0 >> 51;
+    t1 += c;
+    r.v[1] = (uint64_t)t1 & MASK51; c = t1 >> 51;
+    t2 += c;
+    r.v[2] = (uint64_t)t2 & MASK51; c = t2 >> 51;
+    t3 += c;
+    r.v[3] = (uint64_t)t3 & MASK51; c = t3 >> 51;
+    t4 += c;
+    r.v[4] = (uint64_t)t4 & MASK51; c = t4 >> 51;
+    u128 f = c * 19 + r.v[0];
+    r.v[0] = (uint64_t)f & MASK51;
+    r.v[1] += (uint64_t)(f >> 51);
+    return r;
+}
+
+inline fe fe_sq(const fe& a) { return fe_mul(a, a); }
+
+// canonical little-endian bytes (fully reduced mod p)
+inline void fe_tobytes(const fe& a, uint8_t out[32]) {
+    fe t = a;
+    fe_carry(t);
+    fe_carry(t);
+    // now t < 2^52 + eps per limb and the value is < 2*p + small;
+    // subtract p while >= p (at most twice)
+    for (int pass = 0; pass < 2; pass++) {
+        // compare against p = 2^255 - 19 top-down
+        static const uint64_t P[5] = {
+            MASK51 - 18, MASK51, MASK51, MASK51, MASK51};
+        bool ge = true;
+        for (int i = 4; i >= 0; i--) {
+            if (t.v[i] > P[i]) { ge = true; break; }
+            if (t.v[i] < P[i]) { ge = false; break; }
+        }
+        if (!ge) break;
+        // t -= p  (borrow-propagating)
+        uint64_t borrow = 0;
+        for (int i = 0; i < 5; i++) {
+            uint64_t sub = P[i] + borrow;
+            if (t.v[i] >= sub) {
+                t.v[i] -= sub;
+                borrow = 0;
+            } else {
+                t.v[i] = t.v[i] + (uint64_t(1) << 51) - sub;
+                borrow = 1;
+            }
+        }
+    }
+    uint64_t buf[4];
+    buf[0] = t.v[0] | (t.v[1] << 51);
+    buf[1] = (t.v[1] >> 13) | (t.v[2] << 38);
+    buf[2] = (t.v[2] >> 26) | (t.v[3] << 25);
+    buf[3] = (t.v[3] >> 39) | (t.v[4] << 12);
+    std::memcpy(out, buf, 32);
+}
+
+// 255-bit little-endian load (bit 255 must be masked by the caller)
+inline fe fe_frombytes(const uint8_t in[32]) {
+    uint64_t buf[4];
+    std::memcpy(buf, in, 32);
+    fe r;
+    r.v[0] = buf[0] & MASK51;
+    r.v[1] = ((buf[0] >> 51) | (buf[1] << 13)) & MASK51;
+    r.v[2] = ((buf[1] >> 38) | (buf[2] << 26)) & MASK51;
+    r.v[3] = ((buf[2] >> 25) | (buf[3] << 39)) & MASK51;
+    r.v[4] = (buf[3] >> 12) & MASK51;
+    return r;
+}
+
+inline bool fe_is_zero(const fe& a) {
+    uint8_t b[32];
+    fe_tobytes(a, b);
+    uint8_t acc = 0;
+    for (int i = 0; i < 32; i++) acc |= b[i];
+    return acc == 0;
+}
+
+inline bool fe_eq(const fe& a, const fe& b) {
+    return fe_is_zero(fe_sub(a, b));
+}
+
+inline fe fe_neg(const fe& a) { return fe_sub(fe_zero(), a); }
+
+inline bool fe_parity(const fe& a) {
+    uint8_t b[32];
+    fe_tobytes(a, b);
+    return b[0] & 1;
+}
+
+// a^(2^k) in place
+inline fe fe_pow2k(fe a, int k) {
+    while (k--) a = fe_sq(a);
+    return a;
+}
+
+// a^((p-5)/8) = a^(2^252 - 3): the sqrt-chain core
+inline fe fe_pow22523(const fe& a) {
+    fe x2 = fe_sq(a);                       // 2
+    fe x4 = fe_sq(x2);                      // 4
+    fe x8 = fe_sq(x4);                      // 8
+    fe z9 = fe_mul(a, x8);                  // 9
+    fe z11 = fe_mul(x2, z9);                // 11
+    fe z22 = fe_sq(z11);                    // 22
+    fe z_5_0 = fe_mul(z9, z22);             // 2^5 - 2^0
+    fe z_10_0 = fe_mul(fe_pow2k(z_5_0, 5), z_5_0);
+    fe z_20_0 = fe_mul(fe_pow2k(z_10_0, 10), z_10_0);
+    fe z_40_0 = fe_mul(fe_pow2k(z_20_0, 20), z_20_0);
+    fe z_50_0 = fe_mul(fe_pow2k(z_40_0, 10), z_10_0);
+    fe z_100_0 = fe_mul(fe_pow2k(z_50_0, 50), z_50_0);
+    fe z_200_0 = fe_mul(fe_pow2k(z_100_0, 100), z_100_0);
+    fe z_250_0 = fe_mul(fe_pow2k(z_200_0, 50), z_50_0);
+    return fe_mul(fe_pow2k(z_250_0, 2), a); // 2^252 - 3
+}
+
+// ---------------------------------------------------------------- ge
+
+struct ge {              // extended twisted Edwards (a = -1)
+    fe X, Y, Z, T;
+};
+
+// d and sqrt(-1) constants (little-endian canonical byte form)
+static const uint8_t D_BYTES[32] = {
+    0xa3, 0x78, 0x59, 0x13, 0xca, 0x4d, 0xeb, 0x75,
+    0xab, 0xd8, 0x41, 0x41, 0x4d, 0x0a, 0x70, 0x00,
+    0x98, 0xe8, 0x79, 0x77, 0x79, 0x40, 0xc7, 0x8c,
+    0x73, 0xfe, 0x6f, 0x2b, 0xee, 0x6c, 0x03, 0x52};
+static const uint8_t SQRTM1_BYTES[32] = {
+    0xb0, 0xa0, 0x0e, 0x4a, 0x27, 0x1b, 0xee, 0xc4,
+    0x78, 0xe4, 0x2f, 0xad, 0x06, 0x18, 0x43, 0x2f,
+    0xa7, 0xd7, 0xfb, 0x3d, 0x99, 0x00, 0x4d, 0x2b,
+    0x0b, 0xdf, 0xc1, 0x4f, 0x80, 0x24, 0x83, 0x2b};
+// compressed basepoint: y = 4/5, sign 0
+static const uint8_t B_BYTES[32] = {
+    0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+
+inline ge ge_identity() {
+    return ge{fe_zero(), fe_one(), fe_one(), fe_zero()};
+}
+
+inline const fe& fe_d2() {
+    static const fe d2 = fe_add(fe_frombytes(D_BYTES),
+                                fe_frombytes(D_BYTES));
+    return d2;
+}
+
+// unified extended addition (complete for a = -1, d non-square)
+inline ge ge_add(const ge& p, const ge& q) {
+    const fe& d2 = fe_d2();
+    fe a = fe_mul(fe_sub(p.Y, p.X), fe_sub(q.Y, q.X));
+    fe b = fe_mul(fe_add(p.Y, p.X), fe_add(q.Y, q.X));
+    fe c = fe_mul(fe_mul(p.T, q.T), d2);
+    fe dd = fe_add(fe_mul(p.Z, q.Z), fe_mul(p.Z, q.Z));
+    fe e = fe_sub(b, a);
+    fe f = fe_sub(dd, c);
+    fe g = fe_add(dd, c);
+    fe h = fe_add(b, a);
+    return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+inline ge ge_double(const ge& p) {
+    fe a = fe_sq(p.X);
+    fe b = fe_sq(p.Y);
+    fe zz = fe_sq(p.Z);
+    fe c = fe_add(zz, zz);
+    fe e = fe_sub(fe_sub(fe_sq(fe_add(p.X, p.Y)), a), b);
+    fe g = fe_sub(b, a);
+    fe f = fe_sub(g, c);
+    fe h = fe_neg(fe_add(a, b));
+    return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+// ZIP-215 permissive decompression: accepts y >= p and x = 0 with
+// sign = 1; rejects only encodings with no curve point.
+inline bool ge_decompress(const uint8_t s[32], ge* out) {
+    uint8_t yb[32];
+    std::memcpy(yb, s, 32);
+    int sign = yb[31] >> 7;
+    yb[31] &= 0x7F;
+    fe y = fe_frombytes(yb);
+    fe yy = fe_sq(y);
+    fe u = fe_sub(yy, fe_one());
+    fe v = fe_add(fe_mul(yy, fe_frombytes(D_BYTES)), fe_one());
+    // x = u v^3 (u v^7)^((p-5)/8)
+    fe v3 = fe_mul(fe_sq(v), v);
+    fe v7 = fe_mul(fe_sq(v3), v);
+    fe x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)));
+    fe vxx = fe_mul(v, fe_sq(x));
+    // vxx == -u is tested as vxx + u == 0: u is a lazy sub result
+    // whose limbs exceed fe_sub's 8p subtrahend bias, so fe_neg(u)
+    // would underflow
+    if (!fe_eq(vxx, u)) {
+        if (fe_is_zero(fe_add(vxx, u))) {
+            x = fe_mul(x, fe_frombytes(SQRTM1_BYTES));
+        } else {
+            return false;
+        }
+    }
+    if ((int)fe_parity(x) != sign) x = fe_neg(x);
+    out->X = x;
+    out->Y = y;
+    out->Z = fe_one();
+    out->T = fe_mul(x, y);
+    return true;
+}
+
+// [8]p == identity?  (three doublings, then X == 0 && Y == Z)
+inline bool ge_is_identity_cofactored(ge p) {
+    p = ge_double(ge_double(ge_double(p)));
+    return fe_is_zero(p.X) && fe_eq(p.Y, p.Z);
+}
+
+// ------------------------------------------------------------ scalars
+
+// 256x256 -> 512-bit product (little-endian bytes), then mod L via the
+// existing sha512::reduce_mod_l 512-bit reducer
+inline void sc_mul(const uint8_t a[32], const uint8_t b[32],
+                   uint8_t out[32]) {
+    uint64_t al[4], bl[4];
+    std::memcpy(al, a, 32);
+    std::memcpy(bl, b, 32);
+    uint64_t prod[8] = {0};
+    for (int i = 0; i < 4; i++) {
+        u128 carry = 0;
+        for (int j = 0; j < 4; j++) {
+            u128 t = (u128)al[i] * bl[j] + prod[i + j] + carry;
+            prod[i + j] = (uint64_t)t;
+            carry = t >> 64;
+        }
+        prod[i + 4] = (uint64_t)carry;
+    }
+    uint8_t wide[64];
+    std::memcpy(wide, prod, 64);
+    sha512::reduce_mod_l(wide, out);
+}
+
+// L little-endian
+static const uint8_t L_BYTES[32] = {
+    0xed, 0xd3, 0xf5, 0x5c, 0x1a, 0x63, 0x12, 0x58,
+    0xd6, 0x9c, 0xf7, 0xa2, 0xde, 0xf9, 0xde, 0x14,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00,
+    0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x00, 0x10};
+
+// out = (a + b) mod L  (a, b < L)
+inline void sc_add(const uint8_t a[32], const uint8_t b[32],
+                   uint8_t out[32]) {
+    uint64_t al[4], bl[4], ll[4], r[4];
+    std::memcpy(al, a, 32);
+    std::memcpy(bl, b, 32);
+    std::memcpy(ll, L_BYTES, 32);
+    unsigned char carry = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)al[i] + bl[i] + carry;
+        r[i] = (uint64_t)t;
+        carry = (unsigned char)(t >> 64);
+    }
+    // subtract L if >= L
+    bool ge = carry != 0;
+    if (!ge) {
+        ge = true;
+        for (int i = 3; i >= 0; i--) {
+            if (r[i] > ll[i]) { ge = true; break; }
+            if (r[i] < ll[i]) { ge = false; break; }
+        }
+    }
+    if (ge) {
+        unsigned char borrow = 0;
+        for (int i = 0; i < 4; i++) {
+            u128 t = (u128)r[i] - ll[i] - borrow;
+            r[i] = (uint64_t)t;
+            borrow = (unsigned char)((t >> 64) & 1);
+        }
+    }
+    std::memcpy(out, r, 32);
+}
+
+// out = (L - a) mod L   (a < L)
+inline void sc_neg(const uint8_t a[32], uint8_t out[32]) {
+    bool zero = true;
+    for (int i = 0; i < 32; i++)
+        if (a[i]) { zero = false; break; }
+    if (zero) {
+        std::memset(out, 0, 32);
+        return;
+    }
+    uint64_t al[4], ll[4], r[4];
+    std::memcpy(al, a, 32);
+    std::memcpy(ll, L_BYTES, 32);
+    unsigned char borrow = 0;
+    for (int i = 0; i < 4; i++) {
+        u128 t = (u128)ll[i] - al[i] - borrow;
+        r[i] = (uint64_t)t;
+        borrow = (unsigned char)((t >> 64) & 1);
+    }
+    std::memcpy(out, r, 32);
+}
+
+// s < L check (canonical S, ZIP-215 requirement)
+inline bool sc_is_canonical(const uint8_t s[32]) {
+    for (int i = 31; i >= 0; i--) {
+        if (s[i] < L_BYTES[i]) return true;
+        if (s[i] > L_BYTES[i]) return false;
+    }
+    return false;   // s == L
+}
+
+// ------------------------------------------------------------ msm
+
+// c-bit digit w of a 256-bit little-endian scalar
+inline uint32_t sc_digit(const uint8_t s[32], int c, int w) {
+    int bit = c * w;
+    int byte = bit >> 3, off = bit & 7;
+    uint64_t chunk = 0;
+    int avail = 32 - byte;
+    std::memcpy(&chunk, s + byte, avail >= 8 ? 8 : avail);
+    return (uint32_t)((chunk >> off) & ((uint64_t(1) << c) - 1));
+}
+
+// Pippenger bucket MSM over (points, 256-bit scalars); window width
+// adapts to n so small batches skip the bucket-sweep fixed cost.
+inline ge msm(const std::vector<ge>& pts,
+              const std::vector<const uint8_t*>& scalars) {
+    size_t n = pts.size();
+    int c = n < 8 ? 4 : n < 64 ? 6 : n < 512 ? 8 : n < 4096 ? 10 : 12;
+    int windows = (256 + c - 1) / c;
+    size_t nbuckets = size_t(1) << c;
+    std::vector<ge> bucket(nbuckets);
+    std::vector<uint8_t> used(nbuckets);
+    ge acc = ge_identity();
+    for (int w = windows - 1; w >= 0; w--) {
+        if (w != windows - 1)
+            for (int k = 0; k < c; k++) acc = ge_double(acc);
+        std::memset(used.data(), 0, nbuckets);
+        for (size_t i = 0; i < n; i++) {
+            uint32_t d = sc_digit(scalars[i], c, w);
+            if (!d) continue;
+            if (used[d]) {
+                bucket[d] = ge_add(bucket[d], pts[i]);
+            } else {
+                bucket[d] = pts[i];
+                used[d] = 1;
+            }
+        }
+        // bucket sweep: sum_b b * bucket[b] via the running-sum trick;
+        // the adds before the first occupied bucket are skipped (the
+        // running sum is still the identity there)
+        ge running = ge_identity();
+        ge sum = ge_identity();
+        bool run_any = false, sum_any = false;
+        for (size_t b = nbuckets - 1; b >= 1; b--) {
+            if (used[b]) {
+                running = run_any ? ge_add(running, bucket[b])
+                                  : bucket[b];
+                run_any = true;
+            }
+            if (run_any) {
+                sum = sum_any ? ge_add(sum, running) : running;
+                sum_any = true;
+            }
+        }
+        if (sum_any) acc = ge_add(acc, sum);
+    }
+    return acc;
+}
+
+// ------------------------------------------------------- batch verify
+
+struct BatchItem {
+    const uint8_t* pub;      // 32
+    const uint8_t* msg;
+    size_t msglen;
+    const uint8_t* sig;      // 64
+};
+
+// 1 = batch equation holds (all signatures valid with overwhelming
+// probability); 0 = reject (caller falls back per-signature).
+// z: 16 bytes per item (random; bit 0 is forced to 1 here).
+inline int batch_verify(const std::vector<BatchItem>& items,
+                        const uint8_t* z) {
+    size_t n = items.size();
+    if (n == 0) return 1;
+    std::vector<ge> pts;
+    std::vector<std::vector<uint8_t>> scal;
+    pts.reserve(2 * n + 1);
+    scal.reserve(2 * n + 1);
+    uint8_t s_sum[32] = {0};
+    uint8_t digest[64], k[32], zs[32], zk[32];
+    for (size_t i = 0; i < n; i++) {
+        const BatchItem& it = items[i];
+        if (!sc_is_canonical(it.sig + 32)) return 0;
+        ge A, R;
+        if (!ge_decompress(it.pub, &A)) return 0;
+        if (!ge_decompress(it.sig, &R)) return 0;
+        // z_i as a 32-byte scalar, low bit forced odd
+        uint8_t zi[32] = {0};
+        std::memcpy(zi, z + 16 * i, 16);
+        zi[0] |= 1;
+        // k_i = SHA-512(R || A || msg) mod L
+        sha512::Ctx c;
+        sha512::init(&c);
+        sha512::update(&c, it.sig, 32);
+        sha512::update(&c, it.pub, 32);
+        sha512::update(&c, it.msg, it.msglen);
+        sha512::final(&c, digest);
+        sha512::reduce_mod_l(digest, k);
+        // s_sum += z_i * s_i;  A coefficient = z_i * k_i
+        uint8_t si[32];
+        std::memcpy(si, it.sig + 32, 32);
+        sc_mul(zi, si, zs);
+        sc_add(s_sum, zs, s_sum);
+        sc_mul(zi, k, zk);
+        pts.push_back(R);
+        scal.emplace_back(zi, zi + 32);
+        pts.push_back(A);
+        scal.emplace_back(zk, zk + 32);
+    }
+    ge Bp;
+    ge_decompress(B_BYTES, &Bp);
+    uint8_t neg_s[32];
+    sc_neg(s_sum, neg_s);
+    pts.push_back(Bp);
+    scal.emplace_back(neg_s, neg_s + 32);
+
+    std::vector<const uint8_t*> sp;
+    sp.reserve(scal.size());
+    for (auto& v : scal) sp.push_back(v.data());
+    ge r = msm(pts, sp);
+    return ge_is_identity_cofactored(r) ? 1 : 0;
+}
+
+}  // namespace ed25519_msm

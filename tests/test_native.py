@@ -269,3 +269,94 @@ class TestPrepParityVariedLengths:
         for name, a, b in zip(("a_b", "r_b", "s_win", "k_win",
                                "pre_bad"), native_out, python_out):
             assert np.array_equal(a, b), f"{name} differs"
+
+
+class TestEd25519BatchMsm:
+    """RLC batch verification (native/ed25519_msm.hpp) vs the golden
+    model's batch_verify — the CPU analog of the reference's voi
+    batch verifier (crypto/ed25519/ed25519.go:189-222)."""
+
+    @staticmethod
+    def _valid(i, msg=None):
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        seed = bytes([i % 256, i // 256 % 256]) + secrets.token_bytes(30)
+        pub = ref.public_key(seed)
+        m = msg if msg is not None else b"batch-msg-%d" % i
+        return (pub, m, ref.sign(seed, m))
+
+    def _check(self, items):
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        mod = _native()
+        if not hasattr(mod, "ed25519_batch_verify"):
+            pytest.skip("module predates ed25519_batch_verify")
+        z = secrets.token_bytes(16 * len(items))
+        got = bool(mod.ed25519_batch_verify(items, z))
+        want_ok, want_mask = ref.batch_verify(items)
+        assert got == want_ok, (got, want_ok, want_mask)
+        return got
+
+    @pytest.mark.parametrize("n", [2, 3, 7, 33, 200])
+    def test_valid_batches_accept(self, n):
+        assert self._check([self._valid(i) for i in range(n)])
+
+    def test_corrupted_signature_rejects(self):
+        items = [self._valid(i) for i in range(9)]
+        pub, msg, sig = items[4]
+        items[4] = (pub, msg, sig[:7] + bytes([sig[7] ^ 1]) + sig[8:])
+        assert not self._check(items)
+
+    def test_wrong_message_rejects(self):
+        items = [self._valid(i) for i in range(5)]
+        pub, _, sig = items[0]
+        items[0] = (pub, b"forged", sig)
+        assert not self._check(items)
+
+    def test_non_canonical_s_rejects(self):
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        items = [self._valid(i) for i in range(3)]
+        pub, msg, sig = items[1]
+        s = int.from_bytes(sig[32:], "little") + ref.L
+        items[1] = (pub, msg, sig[:32] + s.to_bytes(32, "little"))
+        assert not self._check(items)
+
+    def test_zip215_small_order_and_non_canonical_y(self):
+        # A = order-4 point (y=0), R = non-canonical identity
+        # encoding (y = p+1): S=0 signatures over any message verify
+        # under ZIP-215 (cofactored) — the native path must agree
+        # with the golden model on these
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        a_small = bytes(32)                      # y=0, sign 0
+        r_nc = (ref.P + 1).to_bytes(32, "little")
+        corner = (a_small, b"whatever", r_nc + bytes(32))
+        assert ref.verify(*corner)               # golden ZIP-215 accept
+        items = [self._valid(0), corner, self._valid(2)]
+        assert self._check(items)
+
+    def test_off_curve_pubkey_rejects_batch(self):
+        # an encoding with no curve point: batch returns 0 and the
+        # per-signature fallback produces the mask
+        items = [self._valid(0), self._valid(1)]
+        bad_pub = bytes([2]) + bytes(30) + bytes([0])
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        if ref.decompress(bad_pub) is not None:
+            pytest.skip("encoding unexpectedly valid")
+        items.append((bad_pub, b"m", items[0][2]))
+        assert not self._check(items)
+
+    def test_cpu_batch_verifier_uses_native_and_keeps_mask_contract(self):
+        from cometbft_tpu.crypto import ed25519
+        privs = [ed25519.gen_priv_key() for _ in range(6)]
+        bv = ed25519.CpuBatchVerifier()
+        for i, p in enumerate(privs):
+            bv.add(p.pub_key(), b"m%d" % i, p.sign(b"m%d" % i))
+        ok, mask = bv.verify()
+        assert ok and mask == [True] * 6
+        bv2 = ed25519.CpuBatchVerifier()
+        for i, p in enumerate(privs):
+            sig = p.sign(b"m%d" % i)
+            if i == 2:
+                sig = bytes([sig[0] ^ 4]) + sig[1:]
+            bv2.add(p.pub_key(), b"m%d" % i, sig)
+        ok, mask = bv2.verify()
+        assert not ok
+        assert mask == [True, True, False, True, True, True]
