@@ -762,8 +762,12 @@ PyObject* ed25519_batch_verify(PyObject*, PyObject* args) {
     int ok = 0;
     if (shape_ok) {
         const uint8_t* z = reinterpret_cast<const uint8_t*>(z_bytes);
+        int nt = 0;
+        const char* env = getenv("COMETBFT_TPU_MSM_THREADS");
+        if (env && *env) nt = atoi(env);
+        if (nt <= 0) nt = ed25519_msm::default_threads();
         Py_BEGIN_ALLOW_THREADS
-        ok = ed25519_msm::batch_verify(items, z);
+        ok = ed25519_msm::batch_verify(items, z, nt);
         Py_END_ALLOW_THREADS
     }
     for (PyObject* fit : fits) Py_DECREF(fit);

@@ -26,8 +26,11 @@
 // analysis even for torsion or small-order inputs.
 #pragma once
 
+#include <array>
+#include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <thread>
 #include <vector>
 
 #include "sha512.hpp"
@@ -423,9 +426,7 @@ inline uint32_t sc_digit(const uint8_t s[32], int c, int w) {
 
 // Pippenger bucket MSM over (points, 256-bit scalars); window width
 // adapts to n so small batches skip the bucket-sweep fixed cost.
-inline ge msm(const std::vector<ge>& pts,
-              const std::vector<const uint8_t*>& scalars) {
-    size_t n = pts.size();
+inline ge msm(const ge* pts, const uint8_t (*scalars)[32], size_t n) {
     int c = n < 8 ? 4 : n < 64 ? 6 : n < 512 ? 8 : n < 4096 ? 10 : 12;
     int windows = (256 + c - 1) / c;
     size_t nbuckets = size_t(1) << c;
@@ -477,60 +478,151 @@ struct BatchItem {
     const uint8_t* sig;      // 64
 };
 
+// thread-count default shared with the binding: hardware concurrency
+// clamped to 8 (the same clamp the prep pipeline uses)
+inline int default_threads() {
+    unsigned hw = std::thread::hardware_concurrency();
+    return hw > 8 ? 8 : (hw ? int(hw) : 1);
+}
+
+// Fan a [0, n) range out over up to nt threads (>= min_per items
+// each).  Worker exceptions are caught and reported via the return
+// value (false = some worker failed); a failed thread SPAWN runs that
+// chunk inline instead.  fn must only write disjoint state per index.
+template <typename F>
+inline bool fan_out(size_t n, size_t min_per, int nt, const F& fn) {
+    if (nt > 1 && n / size_t(nt) < min_per)
+        nt = int(n / min_per ? n / min_per : 1);
+    if (nt > 16) nt = 16;
+    if (nt <= 1) {
+        fn(size_t(0), n);
+        return true;
+    }
+    std::atomic<bool> failed(false);
+    auto body = [&](size_t lo, size_t hi) {
+        try {
+            fn(lo, hi);
+        } catch (...) {
+            failed.store(true);
+        }
+    };
+    std::vector<std::thread> ts;
+    size_t chunk = (n + size_t(nt) - 1) / size_t(nt);
+    for (int t = 0; t < nt; t++) {
+        size_t lo = size_t(t) * chunk;
+        size_t hi = lo + chunk < n ? lo + chunk : n;
+        if (lo >= hi) break;
+        try {
+            ts.emplace_back(body, lo, hi);
+        } catch (...) {
+            body(lo, hi);       // spawn failed: run inline
+        }
+    }
+    for (auto& th : ts) th.join();
+    return !failed.load();
+}
+
 // 1 = batch equation holds (all signatures valid with overwhelming
-// probability); 0 = reject (caller falls back per-signature).
-// z: 16 bytes per item (random; bit 0 is forced to 1 here).
-inline int batch_verify(const std::vector<BatchItem>& items,
-                        const uint8_t* z) {
+// probability); 0 = reject or malformed input (caller falls back
+// per-signature).  z: 16 bytes per item (random; bit 0 forced odd).
+// nthreads <= 1 runs serial; otherwise the per-item preparation
+// (decompress + SHA-512 + scalar muls) and the MSM both fan out over
+// range chunks, each MSM thread computing a partial result that is
+// combined with plain group additions — the GIL is already released
+// by the binding, so worker threads scale on multi-core hosts.
+// Never throws: any internal failure (allocation, worker exception)
+// retries serially, and a top-level failure rejects the batch, which
+// just routes the caller to the per-signature path.
+inline int batch_verify_inner(const std::vector<BatchItem>& items,
+                              const uint8_t* z, int nthreads) {
     size_t n = items.size();
     if (n == 0) return 1;
-    std::vector<ge> pts;
-    std::vector<std::vector<uint8_t>> scal;
-    pts.reserve(2 * n + 1);
-    scal.reserve(2 * n + 1);
-    uint8_t s_sum[32] = {0};
-    uint8_t digest[64], k[32], zs[32], zk[32];
-    for (size_t i = 0; i < n; i++) {
-        const BatchItem& it = items[i];
-        if (!sc_is_canonical(it.sig + 32)) return 0;
-        ge A, R;
-        if (!ge_decompress(it.pub, &A)) return 0;
-        if (!ge_decompress(it.sig, &R)) return 0;
-        // z_i as a 32-byte scalar, low bit forced odd
-        uint8_t zi[32] = {0};
-        std::memcpy(zi, z + 16 * i, 16);
-        zi[0] |= 1;
-        // k_i = SHA-512(R || A || msg) mod L
-        sha512::Ctx c;
-        sha512::init(&c);
-        sha512::update(&c, it.sig, 32);
-        sha512::update(&c, it.pub, 32);
-        sha512::update(&c, it.msg, it.msglen);
-        sha512::final(&c, digest);
-        sha512::reduce_mod_l(digest, k);
-        // s_sum += z_i * s_i;  A coefficient = z_i * k_i
-        uint8_t si[32];
-        std::memcpy(si, it.sig + 32, 32);
-        sc_mul(zi, si, zs);
-        sc_add(s_sum, zs, s_sum);
-        sc_mul(zi, k, zk);
-        pts.push_back(R);
-        scal.emplace_back(zi, zi + 32);
-        pts.push_back(A);
-        scal.emplace_back(zk, zk + 32);
+    size_t total = 2 * n + 1;
+    std::vector<ge> pts(total);
+    std::vector<uint8_t> scal(total * 32);      // 32 bytes per point
+    std::vector<std::array<uint8_t, 32>> zs(n); // z_i * s_i
+    std::vector<uint8_t> bad(n, 0);
+
+    auto prepare = [&](size_t lo, size_t hi) {
+        uint8_t digest[64], k[32], zk[32], si[32];
+        for (size_t i = lo; i < hi; i++) {
+            const BatchItem& it = items[i];
+            ge A, R;
+            if (!sc_is_canonical(it.sig + 32) ||
+                !ge_decompress(it.pub, &A) ||
+                !ge_decompress(it.sig, &R)) {
+                bad[i] = 1;
+                continue;
+            }
+            uint8_t zi[32] = {0};
+            std::memcpy(zi, z + 16 * i, 16);
+            zi[0] |= 1;
+            // k_i = SHA-512(R || A || msg) mod L
+            sha512::Ctx c;
+            sha512::init(&c);
+            sha512::update(&c, it.sig, 32);
+            sha512::update(&c, it.pub, 32);
+            sha512::update(&c, it.msg, it.msglen);
+            sha512::final(&c, digest);
+            sha512::reduce_mod_l(digest, k);
+            std::memcpy(si, it.sig + 32, 32);
+            sc_mul(zi, si, zs[i].data());
+            sc_mul(zi, k, zk);
+            pts[2 * i] = R;
+            std::memcpy(&scal[(2 * i) * 32], zi, 32);
+            pts[2 * i + 1] = A;
+            std::memcpy(&scal[(2 * i + 1) * 32], zk, 32);
+        }
+    };
+    if (!fan_out(n, 32, nthreads, prepare)) {
+        if (nthreads > 1)
+            return batch_verify_inner(items, z, 1);
+        return 0;
     }
+    for (size_t i = 0; i < n; i++)
+        if (bad[i]) return 0;
+
+    uint8_t s_sum[32] = {0};
+    for (size_t i = 0; i < n; i++)
+        sc_add(s_sum, zs[i].data(), s_sum);
     ge Bp;
     ge_decompress(B_BYTES, &Bp);
     uint8_t neg_s[32];
     sc_neg(s_sum, neg_s);
-    pts.push_back(Bp);
-    scal.emplace_back(neg_s, neg_s + 32);
+    pts[2 * n] = Bp;
+    std::memcpy(&scal[(2 * n) * 32], neg_s, 32);
 
-    std::vector<const uint8_t*> sp;
-    sp.reserve(scal.size());
-    for (auto& v : scal) sp.push_back(v.data());
-    ge r = msm(pts, sp);
+    auto scal_at = [&](size_t i) {
+        return reinterpret_cast<const uint8_t(*)[32]>(&scal[i * 32]);
+    };
+    int nt = nthreads;
+    if (nt > 1 && total / size_t(nt) < 128) nt = 1;
+    if (nt <= 1)
+        return ge_is_identity_cofactored(
+                   msm(pts.data(), scal_at(0), total))
+                   ? 1
+                   : 0;
+    size_t npart = size_t(nt);
+    std::vector<ge> part(npart, ge_identity());
+    bool ok = fan_out(total, 128, nt, [&](size_t lo, size_t hi) {
+        // which chunk is this? derive from lo (chunks are uniform)
+        size_t chunk = (total + npart - 1) / npart;
+        part[lo / chunk] = msm(pts.data() + lo, scal_at(lo), hi - lo);
+    });
+    if (!ok)
+        return batch_verify_inner(items, z, 1);
+    ge r = part[0];
+    for (size_t t = 1; t < npart; t++) r = ge_add(r, part[t]);
     return ge_is_identity_cofactored(r) ? 1 : 0;
+}
+
+inline int batch_verify(const std::vector<BatchItem>& items,
+                        const uint8_t* z, int nthreads = 1) {
+    try {
+        return batch_verify_inner(items, z, nthreads);
+    } catch (...) {
+        return 0;       // reject -> caller's per-signature fallback
+    }
 }
 
 }  // namespace ed25519_msm
