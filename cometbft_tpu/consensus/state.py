@@ -30,6 +30,7 @@ from ..types.part_set import PartSet, PartSetError, PartSetHeader
 from ..types.priv_validator import PrivValidator
 from ..types.proposal import Proposal
 from ..types.timestamp import Timestamp
+from ..types import vote as vote_mod
 from ..types.vote import Vote, VoteError
 from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
 from ..wire import pb, decode
@@ -149,12 +150,32 @@ class ConsensusState:
                 # items are ready, which would starve every other task
                 # (peers, RPC, watchers) on a busy chain
                 await asyncio.sleep(0)
-                kind, msg, peer_id = await self._input_queue.get()
-                if kind == "timeout":
-                    await self._handle_timeout(msg)
-                else:
-                    await self._handle_msg(msg, peer_id,
-                                           internal=(kind == "internal"))
+                first = await self._input_queue.get()
+                # burst drain: batch-pre-verify the signatures of every
+                # queued vote in one shot (TPU kernel / native MSM by
+                # key type), then process the burst serially in the
+                # exact arrival order — the state machine sees the same
+                # sequence as unbatched processing, but vote storms pay
+                # one batched verification instead of per-vote ones
+                burst = [first]
+                while len(burst) < 256:
+                    try:
+                        burst.append(self._input_queue.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
+                if len(burst) > 1:
+                    self._preverify_burst(burst)
+                for i, (kind, msg, peer_id) in enumerate(burst):
+                    if i:
+                        # keep the old per-message fairness yield: the
+                        # handlers have no guaranteed suspension point,
+                        # and a 256-message stretch would starve peers
+                        await asyncio.sleep(0)
+                    if kind == "timeout":
+                        await self._handle_timeout(msg)
+                    else:
+                        await self._handle_msg(
+                            msg, peer_id, internal=(kind == "internal"))
             except asyncio.CancelledError:
                 raise
             except Exception:
@@ -164,6 +185,49 @@ class ConsensusState:
                                   exc_info=True)
                 self.wal.flush_and_sync()
                 raise
+
+    def _preverify_burst(self, burst) -> None:
+        """Collect the signatures of queued VoteMessages for the
+        CURRENT height's validator set and batch-verify them into the
+        verified-triple memo (types/vote.py) — the tally-path batching
+        the reference leaves per-vote (SURVEY: vote_set.go:219-236).
+        Purely advisory: lookup failures or invalid signatures are
+        left for the serial path, whose verdicts do not change."""
+        entries = []
+        for kind, msg, _peer in burst:
+            if kind == "timeout" or not isinstance(msg, VoteMessage):
+                continue
+            vote = msg.vote
+            if vote is None or vote.height != self.rs.height:
+                continue
+            vals = self.rs.validators
+            if (vals is None or vote.validator_index < 0 or
+                    vote.validator_index >= vals.size()):
+                continue
+            val = vals.validators[vote.validator_index]
+            if (val.pub_key is None or
+                    val.pub_key.address() != vote.validator_address):
+                continue
+            try:
+                entries.append((val.pub_key,
+                                vote.sign_bytes(self.sm_state.chain_id),
+                                vote.signature))
+                if (vote.type == canonical.PRECOMMIT_TYPE and
+                        not vote.block_id.is_nil() and
+                        vote.extension_signature and
+                        vote.non_rp_extension_signature):
+                    entries.append((
+                        val.pub_key,
+                        vote.extension_sign_bytes(self.sm_state.chain_id),
+                        vote.extension_signature))
+                    entries.append((
+                        val.pub_key,
+                        vote.non_rp_extension_sign_bytes(),
+                        vote.non_rp_extension_signature))
+            except Exception:
+                continue
+        if len(entries) >= 2:
+            vote_mod.preverify_signatures(entries)
 
     async def _handle_msg(self, msg, peer_id: str, internal: bool) -> None:
         # WAL-before-process (reference: state.go:886 handleMsg; internal

@@ -34,6 +34,81 @@ class InvalidSignatureError(VoteError):
     pass
 
 
+# ---------------------------------------------------------------------------
+# Verified-signature memo + burst pre-verification — the tally-path
+# batching the reference leaves on the table (SURVEY: vote_set.go:219-236
+# verifies per vote inside AddVote).  The consensus receive loop drains
+# whatever vote messages are queued, batch-verifies their signatures
+# through the grouped batch machinery (TPU kernel / native MSM / RLC
+# pairings product by key type), and memoizes the VALID triples; the
+# serial state-machine processing then hits the memo instead of paying
+# a per-signature verify.  Only positives are cached (a valid
+# (pubkey, message, signature) triple is valid forever), the memo is
+# bounded, and processing order is unchanged — determinism and verdicts
+# are identical to the unbatched path.
+
+from collections import OrderedDict as _OrderedDict
+
+_VERIFIED: "_OrderedDict[tuple[bytes, bytes, bytes], None]" = \
+    _OrderedDict()
+_VERIFIED_MAX = 8192
+
+
+def _memo_add(key: tuple[bytes, bytes, bytes]) -> None:
+    _VERIFIED[key] = None
+    if len(_VERIFIED) > _VERIFIED_MAX:
+        _VERIFIED.popitem(last=False)
+
+
+def checked_verify(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
+    """pub_key.verify_signature with the verified-triple memo."""
+    key = (pub_key.bytes(), bytes(msg), bytes(sig))
+    if key in _VERIFIED:
+        _VERIFIED.move_to_end(key)
+        return True
+    ok = pub_key.verify_signature(msg, sig)
+    if ok:
+        _memo_add(key)
+    return ok
+
+
+def preverify_signatures(entries) -> None:
+    """Batch-verify (pub_key, msg, sig) triples and memoize the valid
+    ones.  Never raises and proves nothing on its own: entries that
+    fail (or whose key type cannot batch) are simply left for the
+    caller's serial path to verify and reject with its own errors."""
+    from ..crypto import batch as crypto_batch
+
+    groups: dict[str, tuple] = {}
+    for pub_key, msg, sig in entries:
+        key = (pub_key.bytes(), bytes(msg), bytes(sig))
+        if key in _VERIFIED:
+            continue
+        try:
+            if not crypto_batch.supports_batch_verifier(pub_key):
+                continue
+            kt = pub_key.type()
+            entry = groups.get(kt)
+            if entry is None:
+                entry = (crypto_batch.create_batch_verifier(pub_key),
+                         [])
+                groups[kt] = entry
+            entry[0].add(pub_key, key[1], key[2])
+            entry[1].append(key)
+        except Exception:
+            continue        # malformed: the serial path will reject
+    for bv, keys in groups.values():
+        if len(keys) < 2:
+            continue
+        try:
+            ok, mask = bv.verify()
+        except Exception:
+            continue
+        for key, good in zip(keys, mask):
+            if good:
+                _memo_add(key)
+
+
 @dataclass
 class Vote:
     type: int = canonical.UNKNOWN_TYPE
@@ -73,8 +148,8 @@ class Vote:
         if pub_key.address() != self.validator_address:
             raise InvalidSignatureError(
                 "vote validator address does not match pubkey")
-        if not pub_key.verify_signature(self.sign_bytes(chain_id),
-                                        self.signature):
+        if not checked_verify(pub_key, self.sign_bytes(chain_id),
+                              self.signature):
             raise InvalidSignatureError("invalid vote signature")
 
     def verify(self, chain_id: str, pub_key: PubKey) -> None:
@@ -99,11 +174,13 @@ class Vote:
         if not self.extension_signature or \
                 not self.non_rp_extension_signature:
             raise InvalidSignatureError("vote extension signature missing")
-        if not pub_key.verify_signature(self.extension_sign_bytes(chain_id),
-                                        self.extension_signature):
+        if not checked_verify(pub_key,
+                              self.extension_sign_bytes(chain_id),
+                              self.extension_signature):
             raise InvalidSignatureError("invalid vote extension signature")
-        if not pub_key.verify_signature(self.non_rp_extension_sign_bytes(),
-                                        self.non_rp_extension_signature):
+        if not checked_verify(pub_key,
+                              self.non_rp_extension_sign_bytes(),
+                              self.non_rp_extension_signature):
             raise InvalidSignatureError(
                 "invalid non-RP vote extension signature")
 

@@ -766,6 +766,7 @@ PyObject* ed25519_batch_verify(PyObject*, PyObject* args) {
         const char* env = getenv("COMETBFT_TPU_MSM_THREADS");
         if (env && *env) nt = atoi(env);
         if (nt <= 0) nt = ed25519_msm::default_threads();
+        if (nt > 16) nt = 16;       // fan_out's clamp; part[] sizing
         Py_BEGIN_ALLOW_THREADS
         ok = ed25519_msm::batch_verify(items, z, nt);
         Py_END_ALLOW_THREADS

@@ -488,20 +488,21 @@ inline int default_threads() {
 // Fan a [0, n) range out over up to nt threads (>= min_per items
 // each).  Worker exceptions are caught and reported via the return
 // value (false = some worker failed); a failed thread SPAWN runs that
-// chunk inline instead.  fn must only write disjoint state per index.
+// chunk inline instead.  fn(tid, lo, hi) must only write state that
+// is disjoint per index range (or per tid).
 template <typename F>
 inline bool fan_out(size_t n, size_t min_per, int nt, const F& fn) {
     if (nt > 1 && n / size_t(nt) < min_per)
         nt = int(n / min_per ? n / min_per : 1);
     if (nt > 16) nt = 16;
     if (nt <= 1) {
-        fn(size_t(0), n);
+        fn(0, size_t(0), n);
         return true;
     }
     std::atomic<bool> failed(false);
-    auto body = [&](size_t lo, size_t hi) {
+    auto body = [&](int tid, size_t lo, size_t hi) {
         try {
-            fn(lo, hi);
+            fn(tid, lo, hi);
         } catch (...) {
             failed.store(true);
         }
@@ -513,9 +514,9 @@ inline bool fan_out(size_t n, size_t min_per, int nt, const F& fn) {
         size_t hi = lo + chunk < n ? lo + chunk : n;
         if (lo >= hi) break;
         try {
-            ts.emplace_back(body, lo, hi);
+            ts.emplace_back(body, t, lo, hi);
         } catch (...) {
-            body(lo, hi);       // spawn failed: run inline
+            body(t, lo, hi);    // spawn failed: run inline
         }
     }
     for (auto& th : ts) th.join();
@@ -543,7 +544,7 @@ inline int batch_verify_inner(const std::vector<BatchItem>& items,
     std::vector<std::array<uint8_t, 32>> zs(n); // z_i * s_i
     std::vector<uint8_t> bad(n, 0);
 
-    auto prepare = [&](size_t lo, size_t hi) {
+    auto prepare = [&](int, size_t lo, size_t hi) {
         uint8_t digest[64], k[32], zk[32], si[32];
         for (size_t i = lo; i < hi; i++) {
             const BatchItem& it = items[i];
@@ -604,10 +605,9 @@ inline int batch_verify_inner(const std::vector<BatchItem>& items,
                    : 0;
     size_t npart = size_t(nt);
     std::vector<ge> part(npart, ge_identity());
-    bool ok = fan_out(total, 128, nt, [&](size_t lo, size_t hi) {
-        // which chunk is this? derive from lo (chunks are uniform)
-        size_t chunk = (total + npart - 1) / npart;
-        part[lo / chunk] = msm(pts.data() + lo, scal_at(lo), hi - lo);
+    bool ok = fan_out(total, 128, nt,
+                      [&](int tid, size_t lo, size_t hi) {
+        part[size_t(tid)] = msm(pts.data() + lo, scal_at(lo), hi - lo);
     });
     if (!ok)
         return batch_verify_inner(items, z, 1);

@@ -248,3 +248,62 @@ class TestGroupedCommitVerify:
             n_ed=0, n_bls=4, n_secp=0, corrupt=1)
         with pytest.raises(VerificationError):
             verify_commit("grouped-chain", vset2, bid2, h2, commit2)
+
+
+class TestVotePreverification:
+    """Verified-triple memo + burst pre-verification (types/vote.py):
+    the tally-path batching of SURVEY §2.1 (vote_set.go:219-236 is
+    per-vote in the reference)."""
+
+    def test_checked_verify_memoizes_only_positives(self, monkeypatch):
+        from cometbft_tpu.types import vote as vote_mod
+        vote_mod._VERIFIED.clear()
+        priv = ed25519.gen_priv_key()
+        pub = priv.pub_key()
+        sig = priv.sign(b"memo-me")
+        calls = {"n": 0}
+        real = type(pub).verify_signature
+
+        def counting(self, msg, s):
+            calls["n"] += 1
+            return real(self, msg, s)
+
+        monkeypatch.setattr(type(pub), "verify_signature", counting)
+        assert vote_mod.checked_verify(pub, b"memo-me", sig)
+        assert vote_mod.checked_verify(pub, b"memo-me", sig)
+        assert calls["n"] == 1          # second hit served by the memo
+        assert not vote_mod.checked_verify(pub, b"other", sig)
+        assert not vote_mod.checked_verify(pub, b"other", sig)
+        assert calls["n"] == 3          # negatives are never cached
+
+    def test_preverify_fills_memo_by_key_type_groups(self, monkeypatch):
+        from cometbft_tpu.types import vote as vote_mod
+        vote_mod._VERIFIED.clear()
+        eds = [ed25519.gen_priv_key() for _ in range(3)]
+        bls = _bls_keys(2)
+        entries = []
+        for i, p in enumerate(eds + bls):
+            msg = b"pre-%d" % i
+            sig = p.sign(msg)
+            if i == 1:
+                sig = bytes([sig[0] ^ 2]) + sig[1:]     # corrupt one
+            entries.append((p.pub_key(), msg, sig))
+        vote_mod.preverify_signatures(entries)
+        # all valid entries memoized; the corrupted one is not
+        for i, (pk, msg, sig) in enumerate(entries):
+            key = (pk.bytes(), msg, sig)
+            assert (key in vote_mod._VERIFIED) == (i != 1)
+        # and a subsequent vote-style verify of a memoized triple does
+        # not call verify_signature again
+        pk, msg, sig = entries[0]
+        def boom(self, *a):
+            raise AssertionError("memo miss")
+        monkeypatch.setattr(type(pk), "verify_signature", boom)
+        assert vote_mod.checked_verify(pk, msg, sig)
+
+    def test_memo_is_bounded(self):
+        from cometbft_tpu.types import vote as vote_mod
+        vote_mod._VERIFIED.clear()
+        for i in range(vote_mod._VERIFIED_MAX + 50):
+            vote_mod._memo_add((b"p%d" % i, b"m", b"s"))
+        assert len(vote_mod._VERIFIED) == vote_mod._VERIFIED_MAX

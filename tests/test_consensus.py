@@ -183,3 +183,41 @@ class TestFourValidators:
             flags = [s.for_block() for s in b2.last_commit.signatures]
             assert flags.count(True) >= 3
         run(go())
+
+
+class TestBurstPreverification:
+    def test_preverify_burst_fills_memo_from_real_votes(self):
+        """Drive _preverify_burst through a real ConsensusState with
+        votes signed for its current height: the verified-triple memo
+        must fill (regression: a bad attribute lookup once made the
+        whole pre-verification a silently-swallowed no-op)."""
+        from cometbft_tpu.types import vote as vote_mod
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block_id import BlockID
+
+        doc, pvs = _make_genesis(4)
+        cs, app, _ = _make_node(doc, pvs[0])
+        vote_mod._VERIFIED.clear()
+        vals = cs.rs.validators
+        burst = []
+        for i, pv in enumerate(pvs):
+            val_idx, val = vals.get_by_address(
+                pv.get_pub_key().address())
+            v = Vote(type=canonical.PREVOTE_TYPE, height=cs.rs.height,
+                     round=0, block_id=BlockID(),
+                     timestamp=Timestamp(1700000001 + i, 0),
+                     validator_address=val.address,
+                     validator_index=val_idx)
+            sig_bytes = v.sign_bytes(cs.sm_state.chain_id)
+            v.signature = pv.priv_key.sign(sig_bytes)
+            burst.append(("peer", VoteMessage(vote=v), f"n{i}"))
+        cs._preverify_burst(burst)
+        assert len(vote_mod._VERIFIED) == len(pvs), \
+            "burst pre-verification produced no memo entries"
+        for _, msg, _ in burst:
+            v = msg.vote
+            val = vals.validators[v.validator_index]
+            key = (val.pub_key.bytes(),
+                   v.sign_bytes(cs.sm_state.chain_id), v.signature)
+            assert key in vote_mod._VERIFIED
