@@ -93,6 +93,43 @@ def supports_batch_verifier(pub_key: PubKey) -> bool:
     return pub_key.type() in (ed25519.KEY_TYPE, _BLS_KEY_TYPE)
 
 
+def batch_verify_by_type(entries) -> list:
+    """Best-effort batch verification of (pub_key, msg, sig) triples
+    grouped by key type.  Returns a per-entry list: True/False for
+    entries a batch verifier judged, None for entries it could not
+    (unsupported key type, malformed input, singleton group, verifier
+    error) — callers treat None as "verify it yourself".  Never
+    raises.  (types/validation.py's grouped commit path keeps its own
+    walk because it must interleave caching and lowest-failing-index
+    error semantics; this helper serves advisory callers like the
+    vote-burst pre-verification.)"""
+    out = [None] * len(entries)
+    groups: dict[str, tuple] = {}
+    for i, (pub_key, msg, sig) in enumerate(entries):
+        try:
+            if not supports_batch_verifier(pub_key):
+                continue
+            kt = pub_key.type()
+            entry = groups.get(kt)
+            if entry is None:
+                entry = (create_batch_verifier(pub_key), [])
+                groups[kt] = entry
+            entry[0].add(pub_key, msg, sig)
+            entry[1].append(i)
+        except Exception:
+            continue
+    for bv, idxs in groups.values():
+        if len(idxs) < 2:
+            continue
+        try:
+            _, mask = bv.verify()
+        except Exception:
+            continue
+        for i, good in zip(idxs, mask):
+            out[i] = bool(good)
+    return out
+
+
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     """Reference: batch.go:10 — errors for unsupported key types."""
     if pub_key.type() == _BLS_KEY_TYPE:

@@ -47,11 +47,21 @@ class InvalidSignatureError(VoteError):
 # bounded, and processing order is unchanged — determinism and verdicts
 # are identical to the unbatched path.
 
+import hashlib as _hashlib
 from collections import OrderedDict as _OrderedDict
 
 _VERIFIED: "_OrderedDict[tuple[bytes, bytes, bytes], None]" = \
     _OrderedDict()
 _VERIFIED_MAX = 8192
+
+
+def _memo_key(pub_key: PubKey, msg: bytes,
+              sig: bytes) -> tuple[bytes, bytes, bytes]:
+    # the message is HASHED into the key: extension sign bytes can be
+    # ~1 MiB, and 8192 entries of embedded messages would be a
+    # byzantine-controllable multi-GB memo; a digest bounds every
+    # entry to ~130 bytes
+    return (pub_key.bytes(), _hashlib.sha256(msg).digest(), bytes(sig))
 
 
 def _memo_add(key: tuple[bytes, bytes, bytes]) -> None:
@@ -62,7 +72,7 @@ def _memo_add(key: tuple[bytes, bytes, bytes]) -> None:
 
 def checked_verify(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
     """pub_key.verify_signature with the verified-triple memo."""
-    key = (pub_key.bytes(), bytes(msg), bytes(sig))
+    key = _memo_key(pub_key, msg, sig)
     if key in _VERIFIED:
         _VERIFIED.move_to_end(key)
         return True
@@ -79,34 +89,20 @@ def preverify_signatures(entries) -> None:
     caller's serial path to verify and reject with its own errors."""
     from ..crypto import batch as crypto_batch
 
-    groups: dict[str, tuple] = {}
+    fresh = []
+    keys = []
     for pub_key, msg, sig in entries:
-        key = (pub_key.bytes(), bytes(msg), bytes(sig))
+        key = _memo_key(pub_key, msg, sig)
         if key in _VERIFIED:
             continue
-        try:
-            if not crypto_batch.supports_batch_verifier(pub_key):
-                continue
-            kt = pub_key.type()
-            entry = groups.get(kt)
-            if entry is None:
-                entry = (crypto_batch.create_batch_verifier(pub_key),
-                         [])
-                groups[kt] = entry
-            entry[0].add(pub_key, key[1], key[2])
-            entry[1].append(key)
-        except Exception:
-            continue        # malformed: the serial path will reject
-    for bv, keys in groups.values():
-        if len(keys) < 2:
-            continue
-        try:
-            ok, mask = bv.verify()
-        except Exception:
-            continue
-        for key, good in zip(keys, mask):
-            if good:
-                _memo_add(key)
+        fresh.append((pub_key, msg, sig))
+        keys.append(key)
+    if len(fresh) < 2:
+        return
+    mask = crypto_batch.batch_verify_by_type(fresh)
+    for key, good in zip(keys, mask):
+        if good:
+            _memo_add(key)
 
 
 @dataclass
@@ -126,9 +122,23 @@ class Vote:
 
     # ------------------------------------------------------------------
     def sign_bytes(self, chain_id: str) -> bytes:
-        return canonical.vote_sign_bytes(
+        # memoized per (vote, chain id, timestamp): the burst
+        # pre-verification and the serial verify both marshal the same
+        # canonical bytes on the consensus hot loop.  The timestamp is
+        # part of the key because privval's double-sign protection
+        # rewrites vote.timestamp on the same-HRS re-sign path
+        # (privval/file.py) AFTER sign bytes may have been computed;
+        # the other signed fields are never mutated post-construction
+        # (signature/extensions are set later but are not signed over).
+        cache = self.__dict__.get("_sb_memo")
+        if cache is not None and cache[0] == chain_id and \
+                cache[1] == self.timestamp:
+            return cache[2]
+        sb = canonical.vote_sign_bytes(
             chain_id, self.type, self.height, self.round, self.block_id,
             self.timestamp)
+        self.__dict__["_sb_memo"] = (chain_id, self.timestamp, sb)
+        return sb
 
     def extension_sign_bytes(self, chain_id: str) -> bytes:
         return canonical.vote_extension_sign_bytes(

@@ -30,6 +30,7 @@
 #include <atomic>
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <thread>
 #include <vector>
 
@@ -478,6 +479,75 @@ struct BatchItem {
     const uint8_t* sig;      // 64
 };
 
+// ------------------------------------------------- pubkey decompress cache
+//
+// Validators repeat across blocks, so the A-point decompression (the
+// sqrt chain, ~265 field muls) is the same work every height — the
+// reference keeps an LRU of expanded pubkeys for exactly this reason
+// (crypto/ed25519/ed25519.go:62-68, size 4096).  Here: a sharded
+// direct-mapped cache of decompressed A points (32768 slots, ~6 MB —
+// sized so the north-star 10k-validator set fits with headroom);
+// R points are per-signature nonces and never repeat.  Purely a
+// speed memo: entries are only ever (pub -> its unique decompressed
+// point), so a stale or evicted entry just costs a recompute.
+
+struct PubCacheSlot {
+    bool used = false;
+    uint8_t pub[32];
+    ge point;           // affine-extended (Z = 1)
+};
+
+struct PubCache {
+    // 32k slots (~6 MB): covers the north-star 10k-validator set with
+    // headroom, so steady-state heights re-verify every validator
+    // from the cache; typical sets (hundreds) always fit
+    static const size_t SLOTS = 32768;
+    static const size_t SHARDS = 16;
+    std::vector<PubCacheSlot> slots;
+    std::mutex mu[SHARDS];
+
+    PubCache() : slots(SLOTS) {}
+
+    static size_t slot_of(const uint8_t pub[32]) {
+        uint64_t h;
+        std::memcpy(&h, pub, 8);
+        h *= 0x9E3779B97F4A7C15ull;
+        return size_t(h >> 49) & (SLOTS - 1);   // 15 bits
+    }
+
+    bool get(const uint8_t pub[32], ge* out) {
+        size_t s = slot_of(pub);
+        std::lock_guard<std::mutex> g(mu[s % SHARDS]);
+        PubCacheSlot& sl = slots[s];
+        if (!sl.used || std::memcmp(sl.pub, pub, 32) != 0)
+            return false;
+        *out = sl.point;
+        return true;
+    }
+
+    void put(const uint8_t pub[32], const ge& pt) {
+        size_t s = slot_of(pub);
+        std::lock_guard<std::mutex> g(mu[s % SHARDS]);
+        PubCacheSlot& sl = slots[s];
+        std::memcpy(sl.pub, pub, 32);
+        sl.point = pt;
+        sl.used = true;
+    }
+};
+
+inline PubCache& pub_cache() {
+    static PubCache c;
+    return c;
+}
+
+inline bool decompress_pub_cached(const uint8_t pub[32], ge* out) {
+    PubCache& c = pub_cache();
+    if (c.get(pub, out)) return true;
+    if (!ge_decompress(pub, out)) return false;
+    c.put(pub, *out);
+    return true;
+}
+
 // thread-count default shared with the binding: hardware concurrency
 // clamped to 8 (the same clamp the prep pipeline uses)
 inline int default_threads() {
@@ -550,7 +620,7 @@ inline int batch_verify_inner(const std::vector<BatchItem>& items,
             const BatchItem& it = items[i];
             ge A, R;
             if (!sc_is_canonical(it.sig + 32) ||
-                !ge_decompress(it.pub, &A) ||
+                !decompress_pub_cached(it.pub, &A) ||
                 !ge_decompress(it.sig, &R)) {
                 bad[i] = 1;
                 continue;

@@ -291,7 +291,7 @@ class TestVotePreverification:
         vote_mod.preverify_signatures(entries)
         # all valid entries memoized; the corrupted one is not
         for i, (pk, msg, sig) in enumerate(entries):
-            key = (pk.bytes(), msg, sig)
+            key = vote_mod._memo_key(pk, msg, sig)
             assert (key in vote_mod._VERIFIED) == (i != 1)
         # and a subsequent vote-style verify of a memoized triple does
         # not call verify_signature again
@@ -307,3 +307,21 @@ class TestVotePreverification:
         for i in range(vote_mod._VERIFIED_MAX + 50):
             vote_mod._memo_add((b"p%d" % i, b"m", b"s"))
         assert len(vote_mod._VERIFIED) == vote_mod._VERIFIED_MAX
+
+    def test_sign_bytes_memo_tracks_timestamp_rewrite(self):
+        # regression: privval's double-sign protection rewrites
+        # vote.timestamp on the same-HRS re-sign path AFTER sign bytes
+        # may have been marshaled; the memo must not serve stale bytes
+        from cometbft_tpu.types import canonical as canon
+        from cometbft_tpu.types.block_id import BlockID as BID
+        v = Vote(type=canonical.PREVOTE_TYPE, height=3, round=0,
+                 block_id=BID(), timestamp=Timestamp(1700000500, 0),
+                 validator_address=b"\x01" * 20, validator_index=0)
+        sb1 = v.sign_bytes("memo-chain")
+        assert v.sign_bytes("memo-chain") == sb1     # memo hit
+        v.timestamp = Timestamp(1700000777, 5)       # privval rewrite
+        sb2 = v.sign_bytes("memo-chain")
+        assert sb2 != sb1
+        assert sb2 == canon.vote_sign_bytes(
+            "memo-chain", v.type, v.height, v.round, v.block_id,
+            v.timestamp)

@@ -218,6 +218,7 @@ class TestBurstPreverification:
         for _, msg, _ in burst:
             v = msg.vote
             val = vals.validators[v.validator_index]
-            key = (val.pub_key.bytes(),
-                   v.sign_bytes(cs.sm_state.chain_id), v.signature)
+            key = vote_mod._memo_key(
+                val.pub_key, v.sign_bytes(cs.sm_state.chain_id),
+                v.signature)
             assert key in vote_mod._VERIFIED

@@ -360,3 +360,22 @@ class TestEd25519BatchMsm:
         ok, mask = bv2.verify()
         assert not ok
         assert mask == [True, True, False, True, True, True]
+
+    def test_pub_decompress_cache_does_not_bypass_verification(self):
+        # the A-point cache memoizes DECOMPRESSION only; a second
+        # batch reusing a cached pubkey with a forged signature must
+        # still reject, and a valid re-verify must still accept
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        mod = _native()
+        if not hasattr(mod, "ed25519_batch_verify"):
+            pytest.skip("module predates ed25519_batch_verify")
+        seed = secrets.token_bytes(32)
+        pub = ref.public_key(seed)
+        items = [(pub, b"m-%d" % i, ref.sign(seed, b"m-%d" % i))
+                 for i in range(4)]
+        z = secrets.token_bytes(16 * 4)
+        assert mod.ed25519_batch_verify(items, z)      # caches pub
+        forged = list(items)
+        forged[2] = (pub, b"forged", items[2][2])
+        assert not mod.ed25519_batch_verify(forged, z)
+        assert mod.ed25519_batch_verify(items, z)
