@@ -59,6 +59,57 @@ def vote_sign_bytes(chain_id: str, type_: int, height: int, round_: int,
         _canonical_vote(chain_id, type_, height, round_, bid, ts))
 
 
+def _split_canonical_vote_desc():
+    """CANONICAL_VOTE split at the timestamp field.  Split descriptors
+    (not dict filtering) because timestamp is always=True — encoding
+    the full descriptor with the field unset would still emit an empty
+    timestamp submessage into the wrong half."""
+    from ..wire.proto import Msg
+    fields = pb.CANONICAL_VOTE.fields
+    if [f.name for f in fields] != \
+            ["type", "height", "round", "block_id", "timestamp",
+             "chain_id"]:
+        # explicit (not assert): must fail fast even under python -O —
+        # a drifted descriptor would otherwise emit wrong sign bytes
+        raise ValueError("CANONICAL_VOTE field layout drifted; "
+                         "fix the template split")
+    pre = Msg(pb.CANONICAL_VOTE.name + ".pre", *fields[:4])
+    ts = Msg(pb.CANONICAL_VOTE.name + ".ts", fields[4])
+    suf = Msg(pb.CANONICAL_VOTE.name + ".suf", fields[5])
+    return pre, ts, suf
+
+
+_CV_SPLIT = None
+
+
+def vote_sign_bytes_template(chain_id: str, type_: int, height: int,
+                             round_: int, bid: BlockID):
+    """Returns make(ts) -> the same bytes as vote_sign_bytes for that
+    timestamp.  Canonical proto fields marshal in field-number order
+    (type=1, height=2, round=3, block_id=4, timestamp=5, chain_id=6),
+    so everything except the timestamp field marshals ONCE and each
+    vote splices its own timestamp between the two halves — a commit's
+    votes share every signed field but the timestamp (~20 us -> ~2 us
+    per signature; parity with vote_sign_bytes pinned by tests)."""
+    global _CV_SPLIT
+    if _CV_SPLIT is None:
+        _CV_SPLIT = _split_canonical_vote_desc()
+    pre_desc, ts_desc, suf_desc = _CV_SPLIT
+    from ..wire.proto import encode, encode_uvarint
+    d = _canonical_vote(chain_id, type_, height, round_, bid,
+                        Timestamp(0, 0))
+    d.pop("timestamp")
+    pre = encode(pre_desc, d)
+    suf = encode(suf_desc, d)
+
+    def make(ts: Timestamp) -> bytes:
+        mid = encode(ts_desc, {"timestamp": ts.to_proto()})
+        body_len = len(pre) + len(mid) + len(suf)
+        return encode_uvarint(body_len) + pre + mid + suf
+
+    return make
+
+
 def vote_extension_sign_bytes(chain_id: str, height: int, round_: int,
                               extension: bytes) -> bytes:
     """Reference: types/vote.go VoteExtensionSignBytes."""

@@ -126,8 +126,27 @@ class Commit:
     def vote_sign_bytes(self, chain_id: str, val_idx: int) -> bytes:
         """Canonical signed bytes of validator val_idx's vote.
 
+        A commit's votes share every signed field except the
+        timestamp (and the block-id variant selected by the flag), so
+        the canonical marshal runs once per (chain id, flag) and each
+        vote splices its timestamp — ~10x cheaper on the verification
+        hot loops (byte-for-byte parity with the Vote.sign_bytes path
+        is pinned in tests/test_types.py).  The memo assumes commits
+        are not mutated in place after first use (nothing does; tests
+        that rebuild signatures replace whole CommitSig objects, and
+        the timestamp/flag are part of the lookup).
+
         Reference: block.go VoteSignBytes (:921)."""
-        return self.get_vote(val_idx).sign_bytes(chain_id)
+        cs = self.signatures[val_idx]
+        tmpls = self.__dict__.setdefault("_vsb_tmpls", {})
+        key = (chain_id, cs.block_id_flag)
+        make = tmpls.get(key)
+        if make is None:
+            make = canonical.vote_sign_bytes_template(
+                chain_id, canonical.PRECOMMIT_TYPE, self.height,
+                self.round, cs.block_id(self.block_id))
+            tmpls[key] = make
+        return make(cs.timestamp)
 
     def validate_basic(self) -> None:
         if self.height < 0:

@@ -298,3 +298,30 @@ class TestValidatorSetHash:
         vset2 = ValidatorSet.from_proto(vset.to_proto())
         assert vset2.hash() == vset.hash()
         assert vset2.proposer.address == vset.proposer.address
+
+
+class TestVoteSignBytesTemplate:
+    def test_template_matches_full_marshal_across_flags_and_times(self):
+        """commit.vote_sign_bytes's template-splice fast path must be
+        byte-for-byte the canonical Vote.sign_bytes marshal for every
+        flag variant and timestamp shape (incl. zero nanos / zero
+        seconds edge encodings)."""
+        bid = BlockID(hash=b"\x9a" * 32,
+                      part_set_header=PartSetHeader(3, b"\xbc" * 32))
+        times = [Timestamp(1700000000, 0), Timestamp(1700000000, 1),
+                 Timestamp(0, 0), Timestamp(1, 999_999_999),
+                 Timestamp(2**31, 5)]
+        sigs = []
+        for i, ts in enumerate(times):
+            flag = (BLOCK_ID_FLAG_COMMIT if i % 3 != 1
+                    else BLOCK_ID_FLAG_NIL)
+            sigs.append(CommitSig(block_id_flag=flag,
+                                  validator_address=bytes([i]) * 20,
+                                  timestamp=ts, signature=b"\x01" * 64))
+        commit = Commit(height=42, round=3, block_id=bid,
+                        signatures=sigs)
+        for chain in ("tmpl-chain", ""):
+            for i in range(len(sigs)):
+                want = commit.get_vote(i).sign_bytes(chain)
+                got = commit.vote_sign_bytes(chain, i)
+                assert got == want, (chain, i)
