@@ -208,26 +208,33 @@ class ConsensusState:
             if (val.pub_key is None or
                     val.pub_key.address() != vote.validator_address):
                 continue
-            try:
-                entries.append((val.pub_key,
-                                vote.sign_bytes(self.sm_state.chain_id),
-                                vote.signature))
-                if (vote.type == canonical.PRECOMMIT_TYPE and
-                        not vote.block_id.is_nil() and
-                        vote.extension_signature and
-                        vote.non_rp_extension_signature):
-                    entries.append((
-                        val.pub_key,
-                        vote.extension_sign_bytes(self.sm_state.chain_id),
-                        vote.extension_signature))
-                    entries.append((
-                        val.pub_key,
-                        vote.non_rp_extension_sign_bytes(),
-                        vote.non_rp_extension_signature))
-            except Exception:
-                continue
+            self._append_vote_entries(
+                entries, vote, val.pub_key, self.sm_state.chain_id)
         if len(entries) >= 2:
             vote_mod.preverify_signatures(entries)
+
+    @staticmethod
+    def _append_vote_entries(entries, vote, pub_key,
+                             chain_id: str) -> None:
+        """Append a vote's signature triples (main + both extension
+        signatures for non-nil precommits) for advisory batch
+        pre-verification.  Never raises: malformed fields are left for
+        the serial path's own errors."""
+        try:
+            entries.append((pub_key, vote.sign_bytes(chain_id),
+                            vote.signature))
+            if (vote.type == canonical.PRECOMMIT_TYPE and
+                    not vote.block_id.is_nil() and
+                    vote.extension_signature and
+                    vote.non_rp_extension_signature):
+                entries.append((pub_key,
+                                vote.extension_sign_bytes(chain_id),
+                                vote.extension_signature))
+                entries.append((pub_key,
+                                vote.non_rp_extension_sign_bytes(),
+                                vote.non_rp_extension_signature))
+        except Exception:
+            pass
 
     async def _handle_msg(self, msg, peer_id: str, internal: bool) -> None:
         # WAL-before-process (reference: state.go:886 handleMsg; internal
@@ -424,8 +431,23 @@ class ConsensusState:
     def _vote_set_from_extended_commit(self, state: SMState,
                                        ec: ExtendedCommit) -> VoteSet:
         vals = self.block_exec.store.load_validators(ec.height)
-        self._preverify_commit_sigs(state.chain_id, ec.to_commit(),
-                                    vals)
+        # pre-verify ALL three signatures per extended vote (main +
+        # both extension sigs) in one batch before the serial tally
+        entries = []
+        for i, ecs in enumerate(ec.extended_signatures):
+            if ecs.absent_flag():
+                continue
+            try:
+                v = ec.get_extended_vote(i)
+                _, val = vals.get_by_address(v.validator_address)
+                if val is None or val.pub_key is None:
+                    continue
+                self._append_vote_entries(entries, v, val.pub_key,
+                                          state.chain_id)
+            except Exception:
+                continue
+        if len(entries) >= 2:
+            vote_mod.preverify_signatures(entries)
         vs = VoteSet.extended(state.chain_id, ec.height, ec.round,
                               canonical.PRECOMMIT_TYPE, vals)
         for i, ecs in enumerate(ec.extended_signatures):

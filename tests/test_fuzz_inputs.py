@@ -264,3 +264,61 @@ class TestFuzzMempoolCheckTx:
 @pytest.mark.slow
 class TestFuzzMempoolCheckTxSlow(TestFuzzMempoolCheckTx):
     pass
+
+
+# --- native batch verifier vs golden model ---------------------------------
+
+class TestFuzzNativeBatchVerify:
+    """Differential fuzz: the native RLC/Pippenger batch verifier
+    (native/ed25519_msm.hpp) must agree with the pure-Python golden
+    model on arbitrarily mutated (pub, msg, sig) triples — a
+    consensus-safety surface: any divergence is an accept/reject split
+    between engines."""
+
+    def test_fuzz_batch_against_golden(self, request):
+        from cometbft_tpu.crypto import _ed25519_ref as ref
+        from cometbft_tpu.crypto import _native_loader
+        mod = _native_loader.load()
+        if mod is None or not hasattr(mod, "ed25519_batch_verify"):
+            pytest.skip("native module unavailable")
+        rng = random.Random(0xBA7C4)
+        seeds = [bytes([i]) * 32 for i in range(8)]
+        pubs = [ref.public_key(s) for s in seeds]
+        corpus = [ref.sign(s, b"fuzz-%d" % i)
+                  for i, s in enumerate(seeds)]
+        deadline = time.monotonic() + _budget(request)
+        rounds = 0
+        while time.monotonic() < deadline:
+            items = []
+            for i in range(rng.randrange(2, 6)):
+                k = rng.randrange(8)
+                msg = b"fuzz-%d" % k
+                sig = bytearray(corpus[k])
+                pub = bytearray(pubs[k])
+                # mutate sig and/or pub (fixed sizes: mutate in place)
+                for _ in range(rng.randrange(0, 4)):
+                    tgt = sig if rng.random() < 0.7 else pub
+                    tgt[rng.randrange(len(tgt))] ^= \
+                        1 << rng.randrange(8)
+                if rng.random() < 0.2:
+                    msg = rng.randbytes(rng.randrange(0, 64))
+                items.append((bytes(pub), msg, bytes(sig)))
+            z = rng.randbytes(16 * len(items))
+            native = None
+            try:
+                native = bool(mod.ed25519_batch_verify(items, z))
+            except Exception as e:        # noqa: BLE001
+                pytest.fail(f"native raised on fuzz input: {e!r}")
+            golden_ok, _ = ref.batch_verify(
+                items, rand_fn=None)
+            # the RLC equation is probabilistic ONLY in the accept
+            # direction for invalid batches (2^-128); verdicts must
+            # match on every fuzz input in practice
+            assert native == golden_ok, (items, native, golden_ok)
+            rounds += 1
+        assert rounds > 0
+
+
+@pytest.mark.slow
+class TestFuzzNativeBatchVerifySlow(TestFuzzNativeBatchVerify):
+    pass
