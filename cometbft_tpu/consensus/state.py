@@ -387,42 +387,40 @@ class ConsensusState:
 
     def _vote_set_from_commit(self, state: SMState,
                               commit: Commit) -> VoteSet:
-        """Reference: types Commit.ToVoteSet.  The per-vote signature
-        checks inside VoteSet.add_vote hit the verified-triple memo:
-        the whole commit batch-verifies first (one native MSM /
-        grouped dispatch), so reconstruction is O(one batch) instead
-        of per-signature — the same trick as the receive loop's burst
-        pre-verification."""
+        """Reference: types Commit.ToVoteSet.  Votes are constructed
+        once and shared between the advisory batch pre-verification
+        and the serial tally: each vote marshals its sign bytes a
+        single time (the per-object memo), and VoteSet.add_vote's
+        signature checks hit the verified-triple memo — one batched
+        dispatch instead of per-signature verification."""
         try:
             vals = self.block_exec.store.load_validators(commit.height)
         except Exception:
             vals = state.last_validators
-        self._preverify_commit_sigs(state.chain_id, commit, vals)
+        votes = [commit.get_vote(i)
+                 for i, cs in enumerate(commit.signatures)
+                 if not cs.absent_flag()]
+        self._preverify_votes(state.chain_id, vals, votes)
         vs = VoteSet(state.chain_id, commit.height, commit.round,
                      canonical.PRECOMMIT_TYPE, vals)
-        for i, cs in enumerate(commit.signatures):
-            if cs.absent_flag():
-                continue
-            vs.add_vote(commit.get_vote(i))
+        for v in votes:
+            vs.add_vote(v)
         return vs
 
-    @staticmethod
-    def _preverify_commit_sigs(chain_id: str, commit: Commit,
-                               vals) -> None:
-        """Advisory batch pre-verification of a stored commit's vote
-        signatures into the verified-triple memo (verdicts unchanged;
-        failures fall to the serial path's own errors)."""
+    def _preverify_votes(self, chain_id: str, vals, votes) -> None:
+        """Advisory batch pre-verification of constructed votes into
+        the verified-triple memo — all three signatures per extended
+        vote (see _append_vote_entries).  Verdicts unchanged: lookup
+        failures and invalid signatures fall to the serial path's own
+        errors."""
         entries = []
-        for i, cs in enumerate(commit.signatures):
-            if cs.absent_flag():
-                continue
+        for v in votes:
             try:
-                _, val = vals.get_by_address(cs.validator_address)
+                _, val = vals.get_by_address(v.validator_address)
                 if val is None or val.pub_key is None:
                     continue
-                entries.append((val.pub_key,
-                                commit.vote_sign_bytes(chain_id, i),
-                                cs.signature))
+                self._append_vote_entries(entries, v, val.pub_key,
+                                          chain_id)
             except Exception:
                 continue
         if len(entries) >= 2:
@@ -431,29 +429,14 @@ class ConsensusState:
     def _vote_set_from_extended_commit(self, state: SMState,
                                        ec: ExtendedCommit) -> VoteSet:
         vals = self.block_exec.store.load_validators(ec.height)
-        # pre-verify ALL three signatures per extended vote (main +
-        # both extension sigs) in one batch before the serial tally
-        entries = []
-        for i, ecs in enumerate(ec.extended_signatures):
-            if ecs.absent_flag():
-                continue
-            try:
-                v = ec.get_extended_vote(i)
-                _, val = vals.get_by_address(v.validator_address)
-                if val is None or val.pub_key is None:
-                    continue
-                self._append_vote_entries(entries, v, val.pub_key,
-                                          state.chain_id)
-            except Exception:
-                continue
-        if len(entries) >= 2:
-            vote_mod.preverify_signatures(entries)
+        votes = [ec.get_extended_vote(i)
+                 for i, ecs in enumerate(ec.extended_signatures)
+                 if not ecs.absent_flag()]
+        self._preverify_votes(state.chain_id, vals, votes)
         vs = VoteSet.extended(state.chain_id, ec.height, ec.round,
                               canonical.PRECOMMIT_TYPE, vals)
-        for i, ecs in enumerate(ec.extended_signatures):
-            if ecs.absent_flag():
-                continue
-            vs.add_vote(ec.get_extended_vote(i))
+        for v in votes:
+            vs.add_vote(v)
         return vs
 
     def _new_step(self) -> None:

@@ -222,3 +222,40 @@ class TestBurstPreverification:
                 val.pub_key, v.sign_bytes(cs.sm_state.chain_id),
                 v.signature)
             assert key in vote_mod._VERIFIED
+
+    def test_append_vote_entries_covers_extension_signatures(self):
+        """The shared entry builder must emit all three signature
+        triples for a non-nil precommit with extensions, and exactly
+        one for a plain prevote."""
+        from cometbft_tpu.consensus.state import ConsensusState
+        from cometbft_tpu.types.vote import Vote
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block_id import BlockID
+        from cometbft_tpu.types.part_set import PartSetHeader
+        from cometbft_tpu.crypto import ed25519
+
+        pk = ed25519.gen_priv_key().pub_key()
+        bid = BlockID(hash=b"\x21" * 32,
+                      part_set_header=PartSetHeader(1, b"\x43" * 32))
+        v = Vote(type=canonical.PRECOMMIT_TYPE, height=9, round=0,
+                 block_id=bid, timestamp=Timestamp(1700000900, 0),
+                 validator_address=pk.address(), validator_index=0,
+                 signature=b"\x01" * 64,
+                 extension=b"ext", extension_signature=b"\x02" * 64,
+                 non_rp_extension=b"nrp",
+                 non_rp_extension_signature=b"\x03" * 64)
+        entries = []
+        ConsensusState._append_vote_entries(entries, v, pk, "x-chain")
+        assert len(entries) == 3
+        assert entries[0][2] == b"\x01" * 64
+        assert entries[1][2] == b"\x02" * 64
+        assert entries[2][2] == b"\x03" * 64
+        prevote = Vote(type=canonical.PREVOTE_TYPE, height=9, round=0,
+                       block_id=bid,
+                       timestamp=Timestamp(1700000901, 0),
+                       validator_address=pk.address(),
+                       validator_index=0, signature=b"\x04" * 64)
+        entries = []
+        ConsensusState._append_vote_entries(entries, prevote, pk,
+                                            "x-chain")
+        assert len(entries) == 1
