@@ -41,7 +41,14 @@ def enable_compilation_cache() -> None:
     replayed with mismatched CPU features.  Called lazily on first
     kernel use; a cache dir already configured by the embedding
     application wins.  Override the location with
-    COMETBFT_TPU_JAX_CACHE."""
+    COMETBFT_TPU_JAX_CACHE.
+
+    Note: XLA:CPU may still log a feature-mismatch warning when
+    replaying SAME-host entries — it bakes its own tuning pseudo-flags
+    (+prefer-no-gather/-scatter) into the serialized executable's
+    feature list, which the host detector never reports.  That
+    residual warning is benign; the dangerous case (replaying a cache
+    carried from a different CPU) is what the host keying removes."""
     global _CACHE_CONFIGURED
     if _CACHE_CONFIGURED:
         return
