@@ -170,11 +170,22 @@ class RPCServer:
                                  "request must be an object")
         rpc_id = req.get("id")
         name = req.get("method", "")
-        params = req.get("params") or {}
+        if not isinstance(name, str):
+            # "method" may be any JSON value on the wire (the fuzzer
+            # sent a dict, which is unhashable and crashed the route
+            # lookup) — JSON-RPC Invalid Request, not a server error
+            return _err_response(rpc_id, -32600, "Invalid request",
+                                 "method must be a string")
+        params = req.get("params")
+        if params is None:
+            params = {}
         if isinstance(params, list):
             return _err_response(rpc_id, -32602,
                                  "Invalid params",
                                  "positional params not supported")
+        if not isinstance(params, dict):
+            return _err_response(rpc_id, -32602, "Invalid params",
+                                 "params must be an object")
         return await self._call(name, params, rpc_id)
 
     async def _call(self, name: str, params: dict, rpc_id) -> dict:

@@ -90,8 +90,29 @@ class TestFuzzJSONRPC:
         b'{"jsonrpc":"2.0","method":"nope","params":{},"id":2}',
         b'[{"jsonrpc":"2.0","method":"echo","id":3}]',
         b'{"method":"echo","params":{"i":-1}}',
+        # non-string method / non-object params: found by the slow
+        # fuzzer crashing the route lookup (unhashable dict method)
+        b'{"jsonrpc":"2.0","method":{"method":-1},"id":4}',
+        b'{"jsonrpc":"2.0","method":"echo","params":"x","id":5}',
         b"{}", b"[]", b"null", b'"str"', b"0",
     ]
+
+    def test_non_string_method_is_invalid_request(self):
+        srv = _rpc_server()
+        resp = _run(srv._dispatch(
+            "POST", "/",
+            b'{"jsonrpc":"2.0","method":{"method":-1},"id":9}'))
+        assert resp["error"]["code"] == -32600
+        resp = _run(srv._dispatch(
+            "POST", "/",
+            b'{"jsonrpc":"2.0","method":"echo","params":"x","id":9}'))
+        assert resp["error"]["code"] == -32602
+        # falsy non-object params must not coerce to {} (review
+        # finding: the guard ran after an `or {}` coercion)
+        resp = _run(srv._dispatch(
+            "POST", "/",
+            b'{"jsonrpc":"2.0","method":"echo","params":"","id":9}'))
+        assert resp["error"]["code"] == -32602
 
     def _one(self, srv, data: bytes):
         resp = _run(srv._dispatch("POST", "/", data))
