@@ -335,7 +335,9 @@ def _kernel_choice() -> str:
         platform = jax.devices()[0].platform
     except Exception:
         return "xla"
-    return "pallas" if platform == "tpu" else "xla"
+    # the pooled chip may register under its plugin name ("axon")
+    # rather than "tpu"; anything that isn't the host CPU is the chip
+    return "pallas" if platform != "cpu" else "xla"
 
 
 def _pallas_module(choice: str):
@@ -448,9 +450,9 @@ def _try_aot(choice: str, interpret: bool, a_b, r_b, s_w8, k_w8):
     if interpret or os.environ.get("COMETBFT_TPU_AOT", "1") == "0":
         return None
     try:
-        if jax.default_backend() != "tpu":
-            return None
-    except Exception:
+        if jax.default_backend() == "cpu":
+            return None     # artifacts are TPU-only (plugin may be
+    except Exception:       # named "axon"; aot.call copes either way)
         return None
     if choice not in ("pallas", "xla"):
         return None     # no committed artifacts for fallback kernels

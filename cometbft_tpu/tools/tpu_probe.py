@@ -1,0 +1,485 @@
+"""Opportunistic TPU prober: sample the pooled chip all round, persist
+every measurement the moment it lands.
+
+The pooled TPU backend in this environment ("axon") is claimable only
+in rare windows — four consecutive rounds of a single blocking 600 s
+wait inside bench.py produced a timeout artifact every time even
+though the pool DID answer mid-round at least once (VERDICT r4 weak
+#1).  The fix is structural:
+
+  * a daemon (``python -m cometbft_tpu.tools.tpu_probe``) runs for the
+    whole round, attempting a SHORT claim every few minutes in a child
+    process it can kill;
+  * the moment a claim lands, the child runs the AOT-exported kernels
+    (``ops/exported/`` — zero tracing, the committed artifacts exist
+    precisely for this) and appends each measurement to
+    ``BENCH_CACHE.json`` IMMEDIATELY — value, shape bucket, kernel,
+    git rev, timestamp — because the pool has vanished mid-window
+    before;
+  * ``bench.py`` folds the cache into the official artifact, labeled
+    live vs cached, so a successful device measurement taken at ANY
+    point in the round is never lost.
+
+Claim-conflict discipline: only one process may dial the pool at a
+time (a second concurrent claim wedges both).  Children take an
+exclusive flock on ``.tpu_claim.lock``; ``bench.py`` stops the daemon
+via ``.tpu_probe_stop`` before its own attempts.
+"""
+from __future__ import annotations
+
+import fcntl
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _cache_path() -> str:
+    # overridable so smoke tests never pollute the round's artifact
+    return os.environ.get("COMETBFT_TPU_PROBE_CACHE",
+                          os.path.join(REPO, "BENCH_CACHE.json"))
+LOCK_PATH = os.path.join(REPO, ".tpu_claim.lock")
+STOP_PATH = os.path.join(REPO, ".tpu_probe_stop")
+PID_PATH = os.path.join(REPO, ".tpu_probe.pid")
+WORKLOAD_PATH = os.path.join(REPO, ".probe_workload.npz")
+
+N = 10_000
+MSG_LEN = 110
+
+
+def _log(*a):
+    print(f"[probe {time.strftime('%H:%M:%S')}]", *a, file=sys.stderr,
+          flush=True)
+
+
+def _git_rev() -> str:
+    try:
+        return subprocess.run(
+            ["git", "-C", REPO, "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=10,
+        ).stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def append_records(recs: list[dict]) -> None:
+    """Append measurement records to BENCH_CACHE.json atomically
+    (flock + tmp/rename) — probe children and bench.py both write."""
+    if not recs:
+        return
+    path = _cache_path()
+    lock = open(path + ".lock", "w")
+    try:
+        fcntl.flock(lock, fcntl.LOCK_EX)
+        data = {"records": []}
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            pass
+        data.setdefault("records", []).extend(recs)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1)
+        os.replace(tmp, path)
+    finally:
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
+def read_records() -> list[dict]:
+    try:
+        with open(_cache_path()) as f:
+            return json.load(f).get("records", [])
+    except (OSError, ValueError):
+        return []
+
+
+# --- workload ---------------------------------------------------------------
+
+def load_or_make_workload(n: int = N):
+    """10k (pub, msg, sig) triples, generated once per round and cached
+    on disk (keygen costs ~10 s; probe windows are precious)."""
+    import numpy as np
+    try:
+        z = np.load(WORKLOAD_PATH)
+        pubs, msgs, sigs = z["pubs"], z["msgs"], z["sigs"]
+        if len(pubs) >= n:
+            return [(pubs[i].tobytes(), msgs[i].tobytes(),
+                     sigs[i].tobytes()) for i in range(n)]
+    except (OSError, ValueError, KeyError):
+        pass        # missing or corrupt (e.g. a writer was SIGKILLed)
+    import secrets
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    base = secrets.token_bytes(MSG_LEN - 8)
+    items = []
+    for i in range(n):
+        sk = Ed25519PrivateKey.generate()
+        pub = sk.public_key().public_bytes(Encoding.Raw,
+                                           PublicFormat.Raw)
+        msg = base + i.to_bytes(8, "little")
+        items.append((pub, msg, sk.sign(msg)))
+    tmp = f"{WORKLOAD_PATH}.{os.getpid()}.tmp"
+    try:
+        with open(tmp, "wb") as f:
+            np.savez(f,
+                     pubs=np.frombuffer(
+                         b"".join(p for p, _, _ in items),
+                         np.uint8).reshape(n, 32),
+                     msgs=np.frombuffer(
+                         b"".join(m for _, m, _ in items),
+                         np.uint8).reshape(n, MSG_LEN),
+                     sigs=np.frombuffer(
+                         b"".join(s for _, _, s in items),
+                         np.uint8).reshape(n, 64))
+        os.replace(tmp, WORKLOAD_PATH)     # atomic: no torn readers
+    except OSError:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+    return items
+
+
+def openssl_baseline_ms(items, sample: int = 1000) -> float:
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PublicKey,
+    )
+    sub = items[:sample]
+    t0 = time.perf_counter()
+    for pub, msg, sig in sub:
+        Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+    return (time.perf_counter() - t0) * 1000.0 * (len(items) / len(sub))
+
+
+# --- the measurement suite (runs inside a claimed child) --------------------
+
+def _measure_suite(smoke: bool = False) -> int:
+    """Claim the backend, then measure — persisting after EVERY step.
+
+    Order is most-important-first (the pool can vanish mid-window):
+    pallas device-only @10240 (validates the r4b carry rework + the
+    10240 bucket), pallas @16384 (direct comparison to r4's measured
+    116 ms), e2e verify_batch, xla @10240, then microbenches.
+    """
+    import numpy as np
+
+    marker = os.environ.get("COMETBFT_TPU_PROBE_MARKER")
+    rev = _git_rev()
+    t0 = time.perf_counter()
+    import jax
+    import jax.numpy as jnp
+
+    if smoke:
+        # JAX_PLATFORMS conflicts with this environment's
+        # sitecustomize TPU-plugin hook (see tests/conftest.py);
+        # post-import config.update never dials the pool
+        jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()                      # blocks until claimed
+    claim_s = time.perf_counter() - t0
+    if marker:
+        with open(marker, "w") as f:
+            f.write(str(os.getpid()))
+    plat_raw = devs[0].platform
+    # the pooled chip may register under the plugin's name ("axon")
+    # rather than "tpu" — anything that isn't the host CPU is the
+    # remote chip, and records normalize to "tpu" so one rare window
+    # is never discarded over a label
+    plat = "cpu" if plat_raw == "cpu" else "tpu"
+    _log(f"claimed backend in {claim_s:.1f}s: {devs}")
+
+    n_items = 64 if smoke else N
+
+    def base_rec(**kw):
+        r = {"ts": time.strftime("%Y-%m-%dT%H:%M:%S"), "git_rev": rev,
+             "platform": plat, "platform_raw": plat_raw,
+             "claim_s": round(claim_s, 1), "n": n_items}
+        r.update(kw)
+        return r
+
+    if plat != "tpu" and not smoke:
+        append_records([base_rec(metric="claim_nontpu",
+                                 note=f"backend={plat}; suite skipped")])
+        return 0
+
+    items = load_or_make_workload(n_items)
+    base_ms = openssl_baseline_ms(items, min(n_items, 1000))
+    append_records([base_rec(metric="openssl_baseline",
+                             value_ms=round(base_ms, 1))])
+
+    from ..ops import ed25519_jax as ej
+    from ..ops import aot
+
+    def time_fn(fn, reps=5):
+        fn()                                   # warm (compile/load)
+        ts = []
+        for _ in range(reps):
+            t = time.perf_counter()
+            fn()
+            ts.append((time.perf_counter() - t) * 1000.0)
+        return float(np.median(ts)), [round(t, 1) for t in ts]
+
+    # device-only kernel dispatches over the AOT artifacts; compiled
+    # pallas only runs on TPU, so smoke (CPU) covers the xla kernel
+    buckets = [64] if smoke else [10240, 16384]
+    kernels = ([("xla", buckets)] if smoke else
+               [("pallas", buckets), ("xla", buckets[:1])])
+    prepped = {}
+    for m in buckets:
+        prepped[m] = ej.prep_arrays(items, m)
+    for kernel, ms in kernels:
+        for m in ms:
+            a_b, r_b, s_w8, k_w8, pre_bad = prepped[m]
+            da, dr = jnp.asarray(a_b), jnp.asarray(r_b)
+            ds, dk = jnp.asarray(s_w8), jnp.asarray(k_w8)
+            for d in (da, dr, ds, dk):
+                d.block_until_ready()
+            exp = aot.load(kernel, m)
+            used_aot = (exp is not None and plat == "tpu"
+                        and "tpu" in getattr(exp, "platforms", ()))
+
+            def live_dispatch(kernel=kernel, da=da, dr=dr, ds=ds,
+                              dk=dk):
+                if kernel == "pallas":
+                    np.asarray(ej._pallas_verify_packed(
+                        da, dr, ds, dk, kernel="pallas"))
+                else:
+                    np.asarray(ej._jit_verify_packed(da, dr, ds, dk))
+
+            if used_aot:
+                try:
+                    np.asarray(exp.call(da, dr, ds, dk))
+
+                    def dispatch(exp=exp, da=da, dr=dr, ds=ds, dk=dk):
+                        np.asarray(exp.call(da, dr, ds, dk))
+                except Exception as e:
+                    # e.g. the backend registers as "axon" and the
+                    # export refuses the platform: fall back to live
+                    # jit rather than burning the window
+                    _log(f"AOT call failed ({e!r:.120}); live jit")
+                    used_aot = False
+                    dispatch = live_dispatch
+            else:
+                dispatch = live_dispatch
+            try:
+                t_first = time.perf_counter()
+                med, runs = time_fn(dispatch)
+                first_s = round(time.perf_counter() - t_first
+                                - sum(runs) / 1000.0, 1)
+                append_records([base_rec(
+                    metric=f"{kernel}_device_only", bucket=m,
+                    value_ms=round(med, 2), runs=runs, aot=used_aot,
+                    first_call_s=first_s,
+                    baseline_cpu_ms=round(base_ms, 1))])
+                _log(f"{kernel}@{m} device-only {med:.1f} ms "
+                     f"(aot={used_aot}, first={first_s}s)")
+            except Exception as e:
+                append_records([base_rec(
+                    metric=f"{kernel}_device_only", bucket=m,
+                    error=repr(e)[:300])])
+                _log(f"{kernel}@{m} failed: {e!r}")
+
+    # e2e: full production path (prep + transfer + kernel + mask)
+    for kernel in (("xla",) if smoke else ("pallas", "xla")):
+        os.environ["COMETBFT_TPU_KERNEL"] = kernel
+        try:
+            ok, mask = ej.verify_batch(items)
+            if not ok:
+                raise AssertionError(
+                    f"workload must verify; mask false at "
+                    f"{[i for i, v in enumerate(mask) if not v][:5]}")
+            med, runs = time_fn(lambda: ej.verify_batch(items))
+            append_records([base_rec(
+                metric=f"{kernel}_e2e", value_ms=round(med, 2),
+                runs=runs, baseline_cpu_ms=round(base_ms, 1),
+                vs_baseline=round(base_ms / med, 2))])
+            _log(f"{kernel} e2e {med:.1f} ms ({base_ms/med:.1f}x)")
+        except Exception as e:
+            append_records([base_rec(metric=f"{kernel}_e2e",
+                                     error=repr(e)[:300])])
+            _log(f"{kernel} e2e failed: {e!r}")
+    os.environ.pop("COMETBFT_TPU_KERNEL", None)
+
+    # correctness spot-check through the production dispatch: one
+    # corrupted signature must be attributed exactly
+    try:
+        bad_items = list(items[:min(256, len(items))])
+        pub, msg, sig = bad_items[7]
+        bad_items[7] = (pub, msg, sig[:8] + bytes([sig[8] ^ 1])
+                        + sig[9:])
+        ok, mask = ej.verify_batch(bad_items)
+        good = (not ok) and (not mask[7]) and all(
+            mask[i] for i in range(len(bad_items)) if i != 7)
+        append_records([base_rec(metric="mask_attribution",
+                                 value_ms=0.0, passed=bool(good))])
+    except Exception as e:
+        append_records([base_rec(metric="mask_attribution",
+                                 error=repr(e)[:300])])
+
+    # per-primitive microbenches (floor analysis) — best-effort
+    try:
+        from ..ops import microbench
+        recs = microbench.run_suite(base_rec, smoke=smoke)
+        _log(f"microbench: {len(recs)} records")
+    except Exception as e:
+        _log(f"microbench skipped: {e!r}")
+    return 0
+
+
+# --- parent-side attempt / daemon -------------------------------------------
+
+def attempt_once(claim_timeout: float = 150.0,
+                 measure_budget: float = 900.0,
+                 smoke: bool = False,
+                 ignore_stop: bool = False) -> bool:
+    """Spawn a measurement child; kill it unless it claims the backend
+    within claim_timeout (the marker file extends the deadline to
+    measure_budget).  Returns True if the child claimed."""
+    marker = os.path.join(REPO, f".tpu_probe_marker.{os.getpid()}")
+    try:
+        os.unlink(marker)
+    except OSError:
+        pass
+    env = dict(os.environ, COMETBFT_TPU_PROBE_MARKER=marker)
+    env.pop("JAX_PLATFORMS", None)      # must see the real backend
+    argv = [sys.executable, "-m", "cometbft_tpu.tools.tpu_probe",
+            "--child"]
+    if smoke:
+        argv.append("--smoke")
+    lock = open(LOCK_PATH, "w")
+    got_lock = False
+    t_lock = time.monotonic()
+    while time.monotonic() - t_lock < claim_timeout:
+        try:
+            fcntl.flock(lock, fcntl.LOCK_EX | fcntl.LOCK_NB)
+            got_lock = True                 # one pool dialer at a time
+            break
+        except OSError:
+            time.sleep(2.0)
+    if not got_lock:
+        # another child is mid-measure; its records land in the cache
+        _log("claim lock busy; skipping this attempt")
+        lock.close()
+        return False
+    try:
+        p = subprocess.Popen(argv, env=env, cwd=REPO,
+                             stdout=sys.stderr, stderr=sys.stderr,
+                             start_new_session=True)
+        t0 = time.monotonic()
+        claimed = False
+        while p.poll() is None:
+            if not claimed and os.path.exists(marker):
+                claimed = True
+                _log("child claimed the backend; extending deadline")
+            limit = measure_budget if claimed else claim_timeout
+            if time.monotonic() - t0 > limit:
+                _log(f"killing child after {limit:.0f}s "
+                     f"(claimed={claimed})")
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+                p.wait()
+                break
+            if (os.path.exists(STOP_PATH) and not claimed
+                    and not ignore_stop):
+                _log("stop requested; killing unclaimed child")
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    p.kill()
+                p.wait()
+                break
+            time.sleep(2.0)
+        return claimed
+    finally:
+        try:
+            os.unlink(marker)
+        except OSError:
+            pass
+        fcntl.flock(lock, fcntl.LOCK_UN)
+        lock.close()
+
+
+def request_stop(wait_s: float = 120.0) -> None:
+    """Ask a running daemon to exit (used by bench.py before its own
+    claim attempts); waits for the pid file to clear."""
+    with open(STOP_PATH, "w") as f:
+        f.write("stop")
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < wait_s:
+        try:
+            with open(PID_PATH) as f:
+                pid = int(f.read().strip())
+            os.kill(pid, 0)                  # still alive?
+        except (OSError, ValueError):
+            return
+        time.sleep(2.0)
+    # daemon still up (likely mid-measure): leave it — its child holds
+    # the claim lock, and our own attempt will block on that lock
+
+
+def daemon_main(interval: float = 240.0, claim_timeout: float = 150.0,
+                measure_budget: float = 900.0,
+                max_age_s: float = 10.5 * 3600) -> int:
+    try:
+        os.unlink(STOP_PATH)
+    except OSError:
+        pass
+    with open(PID_PATH, "w") as f:
+        f.write(str(os.getpid()))
+    _log(f"daemon up (pid {os.getpid()}), interval {interval:.0f}s")
+    t0 = time.monotonic()
+    successes = 0
+    try:
+        while True:
+            if os.path.exists(STOP_PATH):
+                _log("stop file present; exiting")
+                return 0
+            if time.monotonic() - t0 > max_age_s:
+                _log("max age reached; exiting")
+                return 0
+            claimed = attempt_once(claim_timeout, measure_budget)
+            if claimed:
+                successes += 1
+                # after a successful suite, slow down: repeats only
+                # sharpen medians
+                interval = max(interval, 900.0)
+            # sleep in small steps so stop stays responsive
+            slept = 0.0
+            while slept < interval:
+                if os.path.exists(STOP_PATH):
+                    _log("stop file present; exiting")
+                    return 0
+                time.sleep(5.0)
+                slept += 5.0
+    finally:
+        try:
+            os.unlink(PID_PATH)
+        except OSError:
+            pass
+
+
+def main(argv: list[str]) -> int:
+    if "--child" in argv:
+        return _measure_suite(smoke="--smoke" in argv)
+    if "--once" in argv:
+        # manual one-shots must not be self-killed by a stop file left
+        # behind by an earlier bench.py run
+        return 0 if attempt_once(smoke="--smoke" in argv,
+                                 ignore_stop=True) else 1
+    return daemon_main()
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

