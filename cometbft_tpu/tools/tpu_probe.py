@@ -129,6 +129,10 @@ def load_or_make_workload(n: int = N):
                                            PublicFormat.Raw)
         msg = base + i.to_bytes(8, "little")
         items.append((pub, msg, sk.sign(msg)))
+    if n < N:
+        # never let a small (smoke) workload overwrite the full 10k
+        # cache — regenerating it inside a claimed window costs ~10 s
+        return items
     tmp = f"{WORKLOAD_PATH}.{os.getpid()}.tmp"
     try:
         with open(tmp, "wb") as f:
@@ -401,6 +405,9 @@ def attempt_once(claim_timeout: float = 150.0,
                 p.wait()
                 break
             time.sleep(2.0)
+        # the child may claim and exit within one poll interval (fast
+        # suites): re-read the marker before the finally unlinks it
+        claimed = claimed or os.path.exists(marker)
         return claimed
     finally:
         try:

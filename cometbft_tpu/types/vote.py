@@ -54,6 +54,17 @@ _VERIFIED: "_OrderedDict[tuple[bytes, bytes, bytes], None]" = \
     _OrderedDict()
 _VERIFIED_MAX = 8192
 
+# Negative memo: verification is deterministic, so a failed
+# (pubkey, msg-hash, sig) triple is invalid forever.  Without it, a
+# byzantine peer re-sending vote storms with one bad signature per
+# burst gets amplified work per message: the batch rejects, the mask
+# pass pays the slow per-signature fallback on the bad entry, and the
+# serial path then re-verifies the SAME bad entry again (only
+# positives used to be memoized).  Bounded like the positive memo.
+_REJECTED: "_OrderedDict[tuple[bytes, bytes, bytes], None]" = \
+    _OrderedDict()
+_REJECTED_MAX = 4096
+
 
 def _memo_key(pub_key: PubKey, msg: bytes,
               sig: bytes) -> tuple[bytes, bytes, bytes]:
@@ -70,39 +81,66 @@ def _memo_add(key: tuple[bytes, bytes, bytes]) -> None:
         _VERIFIED.popitem(last=False)
 
 
+def _memo_reject(key: tuple[bytes, bytes, bytes]) -> None:
+    _REJECTED[key] = None
+    if len(_REJECTED) > _REJECTED_MAX:
+        _REJECTED.popitem(last=False)
+
+
 def checked_verify(pub_key: PubKey, msg: bytes, sig: bytes) -> bool:
-    """pub_key.verify_signature with the verified-triple memo."""
+    """pub_key.verify_signature with the verified/rejected memos."""
     key = _memo_key(pub_key, msg, sig)
     if key in _VERIFIED:
         _VERIFIED.move_to_end(key)
         return True
+    if key in _REJECTED:
+        _REJECTED.move_to_end(key)
+        return False
     ok = pub_key.verify_signature(msg, sig)
     if ok:
         _memo_add(key)
+    else:
+        _memo_reject(key)
     return ok
 
 
 def preverify_signatures(entries) -> None:
-    """Batch-verify (pub_key, msg, sig) triples and memoize the valid
-    ones.  Never raises and proves nothing on its own: entries that
-    fail (or whose key type cannot batch) are simply left for the
-    caller's serial path to verify and reject with its own errors."""
+    """Batch-verify (pub_key, msg, sig) triples and memoize both
+    verdicts.  Never raises and proves nothing on its own: entries the
+    batch could not judge (None mask — unsupported key type, malformed
+    input, singleton group, verifier error) are left for the caller's
+    serial path to verify and reject with its own errors.
+
+    A False mask entry is confirmed by ONE serial verify before it
+    enters the negative memo: the CPU/BLS batch verifiers' reject
+    masks are already exact (per-signature fallback), but the TPU
+    kernel's are the kernel's own verdicts — the serial verifier must
+    keep final say, or a kernel false-negative would be cached as
+    invalid-forever and this node would reject votes its peers accept
+    (consensus divergence).  The confirmation costs what the caller's
+    serial path would have paid anyway; re-sent storms then hit the
+    memo."""
     from ..crypto import batch as crypto_batch
 
     fresh = []
     keys = []
     for pub_key, msg, sig in entries:
         key = _memo_key(pub_key, msg, sig)
-        if key in _VERIFIED:
+        if key in _VERIFIED or key in _REJECTED:
             continue
         fresh.append((pub_key, msg, sig))
         keys.append(key)
     if len(fresh) < 2:
         return
     mask = crypto_batch.batch_verify_by_type(fresh)
-    for key, good in zip(keys, mask):
+    for (pub_key, msg, sig), key, good in zip(fresh, keys, mask):
         if good:
             _memo_add(key)
+        elif good is not None:
+            if pub_key.verify_signature(msg, sig):
+                _memo_add(key)           # batch false-negative fixed
+            else:
+                _memo_reject(key)
 
 
 @dataclass
@@ -122,22 +160,26 @@ class Vote:
 
     # ------------------------------------------------------------------
     def sign_bytes(self, chain_id: str) -> bytes:
-        # memoized per (vote, chain id, timestamp): the burst
+        # memoized on the FULL signed-field tuple: the burst
         # pre-verification and the serial verify both marshal the same
-        # canonical bytes on the consensus hot loop.  The timestamp is
-        # part of the key because privval's double-sign protection
-        # rewrites vote.timestamp on the same-HRS re-sign path
-        # (privval/file.py) AFTER sign bytes may have been computed;
-        # the other signed fields are never mutated post-construction
-        # (signature/extensions are set later but are not signed over).
+        # canonical bytes on the consensus hot loop.  Keying every
+        # signed field (not just chain id + timestamp) means staleness
+        # safety is enforced rather than resting on a never-mutate
+        # invariant — privval's double-sign protection really does
+        # rebind vote.timestamp on the same-HRS re-sign path
+        # (privval/file.py) after sign bytes may have been computed,
+        # and any future mutation of another signed field now misses
+        # the memo instead of silently signing stale bytes.
+        # (signature/extensions are set later but are not signed over.)
+        key = (chain_id, self.type, self.height, self.round,
+               self.block_id, self.timestamp)
         cache = self.__dict__.get("_sb_memo")
-        if cache is not None and cache[0] == chain_id and \
-                cache[1] == self.timestamp:
-            return cache[2]
+        if cache is not None and cache[0] == key:
+            return cache[1]
         sb = canonical.vote_sign_bytes(
             chain_id, self.type, self.height, self.round, self.block_id,
             self.timestamp)
-        self.__dict__["_sb_memo"] = (chain_id, self.timestamp, sb)
+        self.__dict__["_sb_memo"] = (key, sb)
         return sb
 
     def extension_sign_bytes(self, chain_id: str) -> bytes:

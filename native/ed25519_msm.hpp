@@ -295,11 +295,17 @@ inline bool ge_decompress(const uint8_t s[32], ge* out) {
     fe v7 = fe_mul(fe_sq(v3), v);
     fe x = fe_mul(fe_mul(u, v3), fe_pow22523(fe_mul(u, v7)));
     fe vxx = fe_mul(v, fe_sq(x));
-    // vxx == -u is tested as vxx + u == 0: u is a lazy sub result
-    // whose limbs exceed fe_sub's 8p subtrahend bias, so fe_neg(u)
-    // would underflow
-    if (!fe_eq(vxx, u)) {
-        if (fe_is_zero(fe_add(vxx, u))) {
+    // comparison operand: u is a lazy sub result whose limbs
+    // (~2^54 + 2^51) exceed BOTH fe_neg's and fe_eq's fe_sub
+    // subtrahend bound (2^54 - 152) — one carry sweep brings the
+    // limbs under 2^52, inside the proven precondition, so neither
+    // the u == vxx test nor the vxx + u == 0 test relies on uint64
+    // wrap cancellation.  (u itself stays lazy for the fe_mul calls
+    // above: fe_mul's documented input bound is ~2^55.)
+    fe un = u;
+    fe_carry(un);
+    if (!fe_eq(vxx, un)) {
+        if (fe_is_zero(fe_add(vxx, un))) {
             x = fe_mul(x, fe_frombytes(SQRTM1_BYTES));
         } else {
             return false;

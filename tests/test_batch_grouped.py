@@ -255,9 +255,10 @@ class TestVotePreverification:
     the tally-path batching of SURVEY §2.1 (vote_set.go:219-236 is
     per-vote in the reference)."""
 
-    def test_checked_verify_memoizes_only_positives(self, monkeypatch):
+    def test_checked_verify_memoizes_both_verdicts(self, monkeypatch):
         from cometbft_tpu.types import vote as vote_mod
         vote_mod._VERIFIED.clear()
+        vote_mod._REJECTED.clear()
         priv = ed25519.gen_priv_key()
         pub = priv.pub_key()
         sig = priv.sign(b"memo-me")
@@ -274,11 +275,15 @@ class TestVotePreverification:
         assert calls["n"] == 1          # second hit served by the memo
         assert not vote_mod.checked_verify(pub, b"other", sig)
         assert not vote_mod.checked_verify(pub, b"other", sig)
-        assert calls["n"] == 3          # negatives are never cached
+        # a deterministic failure is invalid forever: the repeat is
+        # served by the negative memo (byzantine re-send amplification
+        # fix, ADVICE r4)
+        assert calls["n"] == 2
 
     def test_preverify_fills_memo_by_key_type_groups(self, monkeypatch):
         from cometbft_tpu.types import vote as vote_mod
         vote_mod._VERIFIED.clear()
+        vote_mod._REJECTED.clear()
         eds = [ed25519.gen_priv_key() for _ in range(3)]
         bls = _bls_keys(2)
         entries = []
@@ -289,24 +294,31 @@ class TestVotePreverification:
                 sig = bytes([sig[0] ^ 2]) + sig[1:]     # corrupt one
             entries.append((p.pub_key(), msg, sig))
         vote_mod.preverify_signatures(entries)
-        # all valid entries memoized; the corrupted one is not
+        # valid entries memoized positive; the corrupted one negative
+        # (the batch mask is exact per signature, even on reject)
         for i, (pk, msg, sig) in enumerate(entries):
             key = vote_mod._memo_key(pk, msg, sig)
             assert (key in vote_mod._VERIFIED) == (i != 1)
-        # and a subsequent vote-style verify of a memoized triple does
-        # not call verify_signature again
-        pk, msg, sig = entries[0]
+            assert (key in vote_mod._REJECTED) == (i == 1)
+        # a subsequent vote-style verify of ANY judged triple does not
+        # call verify_signature again — including the invalid one
         def boom(self, *a):
             raise AssertionError("memo miss")
-        monkeypatch.setattr(type(pk), "verify_signature", boom)
-        assert vote_mod.checked_verify(pk, msg, sig)
+        for i in (0, 1):
+            pk, msg, sig = entries[i]
+            monkeypatch.setattr(type(pk), "verify_signature", boom)
+            assert vote_mod.checked_verify(pk, msg, sig) == (i != 1)
 
     def test_memo_is_bounded(self):
         from cometbft_tpu.types import vote as vote_mod
         vote_mod._VERIFIED.clear()
+        vote_mod._REJECTED.clear()
         for i in range(vote_mod._VERIFIED_MAX + 50):
             vote_mod._memo_add((b"p%d" % i, b"m", b"s"))
         assert len(vote_mod._VERIFIED) == vote_mod._VERIFIED_MAX
+        for i in range(vote_mod._REJECTED_MAX + 50):
+            vote_mod._memo_reject((b"p%d" % i, b"m", b"s"))
+        assert len(vote_mod._REJECTED) == vote_mod._REJECTED_MAX
 
     def test_sign_bytes_memo_tracks_timestamp_rewrite(self):
         # regression: privval's double-sign protection rewrites
@@ -325,3 +337,15 @@ class TestVotePreverification:
         assert sb2 == canon.vote_sign_bytes(
             "memo-chain", v.type, v.height, v.round, v.block_id,
             v.timestamp)
+        # the memo keys EVERY signed field (ADVICE r4): mutating any
+        # of them — not just the timestamp — must miss the memo
+        v.round = 7
+        sb3 = v.sign_bytes("memo-chain")
+        assert sb3 != sb2
+        assert sb3 == canon.vote_sign_bytes(
+            "memo-chain", v.type, v.height, 7, v.block_id, v.timestamp)
+        v.height = 4
+        sb4 = v.sign_bytes("memo-chain")
+        assert sb4 != sb3
+        assert sb4 == canon.vote_sign_bytes(
+            "memo-chain", v.type, 4, 7, v.block_id, v.timestamp)
