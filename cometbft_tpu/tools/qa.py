@@ -51,6 +51,7 @@ class WindowResult:
     duration_s: float
     sent: int = 0
     accepted: int = 0
+    dropped: int = 0            # open-loop ticks held by the cap
     committed: int = 0
     tx_per_s: float = 0.0
     latency_p50_s: float = 0.0
@@ -73,6 +74,16 @@ class QAReport:
     validators_live: int = 0
     windows: list[WindowResult] = field(default_factory=list)
     saturation_rate: int = 0
+    # generator self-check against a null sink (offered ~= requested
+    # must hold independent of the engine under test)
+    offered_check: dict = field(default_factory=dict)
+    # commit signature width actually flowing through verification
+    commit_sigs_avg: float = 0.0
+    commit_sigs_min: int = 0
+    commit_sigs_heights: int = 0
+    # top hot-path entries from node 0's cProfile during the highest-
+    # rate window (libs/pprof.py /debug/pprof/profile)
+    profile_top: list = field(default_factory=list)
     block_interval_avg_s: float = 0.0
     block_interval_std_s: float = 0.0
     block_interval_min_s: float = 0.0
@@ -220,6 +231,51 @@ def _note_saturation(report: "QAReport", w: "WindowResult",
         report.saturation_rate = rate
 
 
+async def _selfcheck_generator(report: "QAReport", rate: int) -> None:
+    """Prove the generator offers the requested rate against a null
+    sink BEFORE the run (VERDICT r4 #3) — a generator regression must
+    never read as an engine saturation point."""
+    from . import loadtime
+    report.offered_check = await loadtime.selfcheck(
+        rate=rate, duration_s=2.0)
+    logger.info("load generator self-check",
+                **report.offered_check)
+
+
+# BLOCK_ID_FLAG_COMMIT / _NIL: slots that carry a real signature (the
+# width the batch verification path actually processes) — the single
+# definition both QA modes share
+_PRESENT_SIG_FLAGS = (2, 3)
+
+
+def _count_commit_sigs(signatures: list) -> int:
+    """Non-absent signatures in a commit's 102-slot array (JSON
+    form)."""
+    return sum(1 for s in signatures
+               if s is not None
+               and s.get("block_id_flag") in _PRESENT_SIG_FLAGS)
+
+
+async def _sample_commit_sigs(report: "QAReport", cli,
+                              final_height: int) -> None:
+    """Per-block verified-signature counts over sampled heights
+    (VERDICT r4 #5: the QA report must state how many real signatures
+    each commit carries through the batch path)."""
+    counts = []
+    for h in range(2, final_height + 1, max(1, final_height // 40)):
+        try:
+            c = await cli.call("commit", height=str(h))
+            sigs = c["signed_header"]["commit"]["signatures"]
+            counts.append(_count_commit_sigs(sigs))
+        except Exception:
+            continue
+    if counts:
+        report.commit_sigs_avg = round(
+            sum(counts) / len(counts), 1)
+        report.commit_sigs_min = min(counts)
+        report.commit_sigs_heights = len(counts)
+
+
 def _configure_joiner(joiner_cfg: Config, endpoints: list,
                       trust_height: int, trust_hash: str,
                       node_ids: dict, p2p_port: dict,
@@ -297,11 +353,12 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                 f"net stuck: {[n.height for n in pool]} < {h}")
 
         await wait_height(2, 120.0)
+        await _selfcheck_generator(report, max(rates))
 
         # --- load windows at increasing rates -----------------------
         for wi, rate in enumerate(rates):
             res = await loadtime.generate(
-                endpoints, rate=rate, connections=1,
+                endpoints, rate=rate, connections=2,
                 duration_s=window_s, size=256, method="async")
             # let the tail commit
             h0 = ref.height
@@ -310,7 +367,8 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                 endpoints[0], experiment_id=res.experiment_id)
             w = WindowResult(
                 rate=rate, duration_s=window_s, sent=res.sent,
-                accepted=res.accepted, committed=rep.latency.count,
+                accepted=res.accepted, dropped=res.dropped,
+                committed=rep.latency.count,
                 tx_per_s=rep.latency.count / window_s,
                 latency_p50_s=rep.latency.p50_s,
                 latency_p90_s=rep.latency.p90_s,
@@ -361,6 +419,21 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                     height=joiner.height)
 
         report.final_height = ref.height
+
+        # --- commit signature width over sampled heights ------------
+        counts = []
+        step = max(1, report.final_height // 40)
+        for h in range(2, report.final_height + 1, step):
+            blk = ref.block_store.load_block(h)
+            if blk is None:
+                continue
+            counts.append(sum(
+                1 for s in blk.last_commit.signatures
+                if s.block_id_flag in _PRESENT_SIG_FLAGS))
+        if counts:
+            report.commit_sigs_avg = round(sum(counts) / len(counts), 1)
+            report.commit_sigs_min = min(counts)
+            report.commit_sigs_heights = len(counts)
 
         # --- block interval stats (benchmark.go:15-24) --------------
         times = []
@@ -481,6 +554,9 @@ def _write_node_overrides(cfg: Config) -> None:
                 "persistent_peers": cfg.p2p.persistent_peers,
                 "allow_duplicate_ip": True, "pex": False},
         "rpc": {"laddr": cfg.rpc.laddr},
+        "instrumentation": {
+            "pprof_listen_addr":
+                cfg.instrumentation.pprof_listen_addr},
         "consensus": {
             "timeout_commit_ns": cfg.consensus.timeout_commit_ns},
         "mempool": {"size": cfg.mempool.size},
@@ -540,6 +616,31 @@ def _spawn_node(home: str):
         env=env, cwd=repo_root, preexec_fn=_die_with_parent)
 
 
+async def _fetch_profile(pprof_port: int, seconds: int = 30) -> list:
+    """Top cumulative-time lines from the node's live cProfile
+    endpoint (libs/pprof.py), trimmed for the report."""
+    import urllib.request
+
+    def _get():
+        url = (f"http://127.0.0.1:{pprof_port}/debug/pprof/profile"
+               f"?seconds={seconds}")
+        with urllib.request.urlopen(url, timeout=seconds + 30) as r:
+            return r.read().decode(errors="replace")
+    try:
+        text = await asyncio.to_thread(_get)
+    except Exception as e:
+        return [f"profile fetch failed: {e!r}"]
+    lines = [ln.rstrip() for ln in text.splitlines()]
+    # keep the stats header + the first ~25 rows of the table
+    out = []
+    for ln in lines:
+        if len(out) >= 30:
+            break
+        if ln.strip():
+            out.append(ln)
+    return out
+
+
 async def _rpc_ready(endpoint: str, budget: float) -> bool:
     from ..rpc.client import HTTPClient
     deadline = time.monotonic() + budget
@@ -563,13 +664,20 @@ async def _rpc_height(endpoint: str) -> int:
 async def run_qa_procs(outdir: str, n_validators: int = 12,
                        n_full: int = 3, ghosts: int = 90,
                        rates: tuple = (25, 50, 100, 200),
-                       window_s: float = 90.0) -> QAReport:
+                       window_s: float = 90.0,
+                       perturb: bool = True,
+                       joiner: bool = True,
+                       profile: bool = True) -> QAReport:
     """The reference-method QA run: separate OS process per node,
     90 s load windows, psutil resource series, mempool occupancy.
 
     Reference: docs/references/qa/method.md (the 90 s window and
     saturation-point procedure) and CometBFT-QA-v1.md:141-170 (result
     tables this report mirrors).
+
+    perturb/joiner gate the kill-restart and statesync stages (the
+    sig-scale stage runs without them); profile captures a cProfile
+    window from node 0's live pprof during the last load window.
     """
     from ..rpc.client import HTTPClient
     from . import loadtime
@@ -578,6 +686,10 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
     report = QAReport()
     names, zones, cfgs, joiner_cfg, node_ids, p2p_port, relay_specs = \
         _setup_net(outdir, n_validators, n_full, ghosts, report)
+    pprof_port = _free_port()
+    if profile:
+        cfgs[names[0]].instrumentation.pprof_listen_addr = \
+            f"127.0.0.1:{pprof_port}"
     for name in names:
         _write_node_overrides(cfgs[name])
 
@@ -588,6 +700,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
     procs: dict = {}
     relays: list[Relay] = []
     sampler: Optional[_Sampler] = None
+    profile_task = None
     try:
         for spec in relay_specs:
             relays.append(await start_relay(spec))
@@ -615,6 +728,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             raise TimeoutError(f"net stuck below {h}")
 
         await wait_height(2, 180.0)
+        await _selfcheck_generator(report, max(rates))
 
         async def occupancy_series(stopper: asyncio.Event, out: list):
             cli = HTTPClient(endpoints[0], timeout=10.0)
@@ -632,9 +746,15 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             stop_occ = asyncio.Event()
             occ_task = asyncio.get_running_loop().create_task(
                 occupancy_series(stop_occ, occ))
+            if profile and wi == len(rates) - 1:
+                # capture node 0's cProfile during the last (highest-
+                # rate) window via the live pprof server
+                profile_task = asyncio.get_running_loop().create_task(
+                    _fetch_profile(pprof_port,
+                                   seconds=min(30, int(window_s))))
             t0 = time.monotonic()
             res = await loadtime.generate(
-                endpoints, rate=rate, connections=1,
+                endpoints, rate=rate, connections=2,
                 duration_s=window_s, size=256, method="async")
             h0 = await _rpc_height(endpoints[0])
             await wait_height(h0 + 2, 90.0)
@@ -645,7 +765,8 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 endpoints[0], experiment_id=res.experiment_id)
             w = WindowResult(
                 rate=rate, duration_s=window_s, sent=res.sent,
-                accepted=res.accepted, committed=rep.latency.count,
+                accepted=res.accepted, dropped=res.dropped,
+                committed=rep.latency.count,
                 tx_per_s=rep.latency.count / window_s,
                 latency_p50_s=rep.latency.p50_s,
                 latency_p90_s=rep.latency.p90_s,
@@ -664,7 +785,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 mempool_max=w.mempool_max)
             _note_saturation(report, w, rate)
 
-            if wi == 1:
+            if wi == 1 and perturb:
                 # kill -9 + restart one validator (reference:
                 # perturb.go kill); memdb state is lost, so recovery
                 # exercises a real from-scratch blocksync
@@ -685,27 +806,34 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 logger.info("perturbed node recovered",
                             victim=victim)
 
-        # --- statesync late joiner (own process) --------------------
+        if profile_task is not None:
+            report.profile_top = await profile_task
+
         cli = HTTPClient(endpoints[0], timeout=30.0)
-        th = max(1, await _rpc_height(endpoints[0]) - 8)
-        blk = await cli.call("block", height=str(th))
-        _configure_joiner(joiner_cfg, endpoints, th,
-                          blk["block_id"]["hash"], node_ids,
-                          p2p_port, names)
-        _write_node_overrides(joiner_cfg)
-        target = await _rpc_height(endpoints[0])
-        procs["joiner"] = _spawn_node(joiner_cfg.base.home)
-        sampler.track("joiner", procs["joiner"])
-        joiner_ep = "http://" + \
-            joiner_cfg.rpc.laddr[len("tcp://"):]
-        if not await _rpc_ready(joiner_ep, 240.0):
-            raise TimeoutError("joiner RPC never came up")
-        await wait_height(target, 300.0, eps=[joiner_ep])
-        report.statesync_joiner_height = await _rpc_height(joiner_ep)
-        logger.info("statesync joiner caught up",
-                    height=report.statesync_joiner_height)
+        joiner_ep = None
+        if joiner:
+            # --- statesync late joiner (own process) ----------------
+            th = max(1, await _rpc_height(endpoints[0]) - 8)
+            blk = await cli.call("block", height=str(th))
+            _configure_joiner(joiner_cfg, endpoints, th,
+                              blk["block_id"]["hash"], node_ids,
+                              p2p_port, names)
+            _write_node_overrides(joiner_cfg)
+            target = await _rpc_height(endpoints[0])
+            procs["joiner"] = _spawn_node(joiner_cfg.base.home)
+            sampler.track("joiner", procs["joiner"])
+            joiner_ep = "http://" + \
+                joiner_cfg.rpc.laddr[len("tcp://"):]
+            if not await _rpc_ready(joiner_ep, 240.0):
+                raise TimeoutError("joiner RPC never came up")
+            await wait_height(target, 300.0, eps=[joiner_ep])
+            report.statesync_joiner_height = await _rpc_height(
+                joiner_ep)
+            logger.info("statesync joiner caught up",
+                        height=report.statesync_joiner_height)
 
         report.final_height = await _rpc_height(endpoints[0])
+        await _sample_commit_sigs(report, cli, report.final_height)
 
         # --- block interval stats over RPC --------------------------
         times = []
@@ -729,7 +857,8 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
         _record_intervals(report, [_parse_ns(t) for _, t in times])
 
         # --- invariants over RPC (sampled heights) ------------------
-        check_eps = [rpc_ep[n] for n in names] + [joiner_ep]
+        check_eps = [rpc_ep[n] for n in names] + \
+            ([joiner_ep] if joiner_ep else [])
         for h in range(1, report.final_height + 1, 5):
             want = None
             for ep in check_eps:
@@ -746,6 +875,13 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                     report.mismatches.append(
                         f"{ep}@{h}: hash/app_hash mismatch")
     finally:
+        if profile_task is not None and not profile_task.done():
+            # a mid-window failure must not abandon the urlopen thread
+            profile_task.cancel()
+            try:
+                await profile_task
+            except (asyncio.CancelledError, Exception):
+                pass
         if sampler is not None:
             sampler.stop()
         for proc in procs.values():
@@ -768,6 +904,21 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
     return report
 
 
+async def run_sig_scale(outdir: str,
+                        window_s: float = 30.0) -> QAReport:
+    """Signature-scale stage (VERDICT r4 #5): 32 LIVE validators
+    (power 100 each) + 70 power-1 ghosts, so every commit carries
+    >= 32 real signatures through the batch verification path in a
+    running network.  Lighter stages (no perturbation / joiner /
+    profile) because 33 processes on this box saturate the core by
+    themselves; the deliverable is the per-block verified-signature
+    width + that the net sustains load at that width."""
+    return await run_qa_procs(
+        outdir, n_validators=32, n_full=1, ghosts=70,
+        rates=(10, 25), window_s=window_s,
+        perturb=False, joiner=False, profile=False)
+
+
 def main(argv=None) -> int:
     import argparse
     ap = argparse.ArgumentParser()
@@ -776,14 +927,25 @@ def main(argv=None) -> int:
     ap.add_argument("--procs", action="store_true",
                     help="one OS process per node + psutil resource "
                          "series (the reference QA method's shape)")
+    ap.add_argument("--sigscale", action="store_true",
+                    help="32 live validators: every commit carries "
+                         ">=32 real signatures through the batch path")
+    ap.add_argument("--no-sigscale", action="store_true",
+                    help="full run without the sig-scale stage")
     ap.add_argument("--window", type=float, default=0.0)
     ap.add_argument("--out", default="")
     args = ap.parse_args(argv)
-    # --quick must never clobber the committed full-scale record
+    # --quick / --sigscale-only must never clobber the committed
+    # full-scale record
     out_path = args.out or (
-        "QA_quick.json" if args.quick else "QA_r04.json")
+        "QA_quick.json" if args.quick else
+        "QA_sigscale.json" if args.sigscale else "QA_r05.json")
+    sig_rep: Optional[QAReport] = None
     with tempfile.TemporaryDirectory() as d:
-        if args.quick and args.procs:
+        if args.sigscale:
+            rep = asyncio.run(run_sig_scale(
+                d, window_s=args.window or 30.0))
+        elif args.quick and args.procs:
             rep = asyncio.run(run_qa_procs(
                 d, n_validators=4, n_full=1, ghosts=20,
                 rates=(25, 50), window_s=args.window or 10.0))
@@ -796,17 +958,32 @@ def main(argv=None) -> int:
                 d, window_s=args.window or 90.0))
         else:
             rep = asyncio.run(run_qa(d, window_s=args.window or 15.0))
+    if args.procs and not args.quick and not args.no_sigscale \
+            and not args.sigscale:
+        # the full reference-method run carries the sig-scale stage
+        # as a second net (the validator set is fixed at genesis)
+        with tempfile.TemporaryDirectory() as d:
+            try:
+                sig_rep = asyncio.run(run_sig_scale(d))
+            except Exception as e:
+                logger.error("sig-scale stage failed", err=repr(e))
     out = rep.to_dict()
+    if sig_rep is not None:
+        out["sig_scale"] = sig_rep.to_dict()
     with open(out_path, "w") as f:
         json.dump(out, f, indent=2)
         f.write("\n")
     print(json.dumps({
         "nodes": rep.nodes, "validators": rep.validators_total,
         "saturation_rate": rep.saturation_rate,
+        "offered_ratio": rep.offered_check.get("offered_ratio"),
+        "commit_sigs_avg": rep.commit_sigs_avg,
         "windows": [[w.rate, round(w.tx_per_s, 1),
                      round(w.latency_p50_s, 3)]
                     for w in rep.windows],
         "block_interval_avg_s": round(rep.block_interval_avg_s, 3),
+        "sig_scale_commit_sigs_avg":
+            sig_rep.commit_sigs_avg if sig_rep else None,
         "mismatches": len(rep.mismatches),
     }))
     return 0 if not rep.mismatches else 1

@@ -28,3 +28,48 @@ from cometbft_tpu.crypto import batch  # noqa: E402
 
 if not os.environ.get("COMETBFT_TPU_CRYPTO_BACKEND"):
     batch.set_backend("cpu")
+
+
+# ---------------------------------------------------------------------------
+# Per-test wall-clock timeouts (VERDICT r4 #8: one hung net must not
+# mask the whole tier).  SIGALRM raises inside the test — including
+# inside asyncio.run — so a wedged event loop still fails fast with a
+# traceback instead of eating the session.  Budgets are generous (the
+# box has one CPU and kernel tests pay a 60-110 s cold compile);
+# override per test with @pytest.mark.timeout_s(N).
+
+import signal
+
+import pytest
+
+_DEFAULT_TIMEOUT_S = 300
+_SLOW_TIMEOUT_S = 600
+_KERNEL_TIMEOUT_S = 900
+
+
+class _TestTimeout(Exception):
+    pass
+
+
+@pytest.fixture(autouse=True)
+def _per_test_timeout(request):
+    limit = _DEFAULT_TIMEOUT_S
+    if request.node.get_closest_marker("slow"):
+        limit = _SLOW_TIMEOUT_S
+    if request.node.get_closest_marker("kernel"):
+        limit = _KERNEL_TIMEOUT_S
+    override = request.node.get_closest_marker("timeout_s")
+    if override and override.args:
+        limit = override.args[0]
+
+    def on_alarm(signum, frame):
+        raise _TestTimeout(
+            f"test exceeded its {limit}s wall-clock budget")
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.setitimer(signal.ITIMER_REAL, limit)
+    try:
+        yield
+    finally:
+        signal.setitimer(signal.ITIMER_REAL, 0)
+        signal.signal(signal.SIGALRM, old)
