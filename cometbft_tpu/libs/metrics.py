@@ -34,9 +34,21 @@ class _Metric:
         self.help = help_
         self.label_names = tuple(label_names)
         self._children: dict[tuple, "_Metric"] = {}
+        self._memo: dict[tuple, "_Metric"] = {}
         self._lock = threading.Lock()
 
     def with_labels(self, *values: str):
+        # hot path: with_labels runs per gossip message in the p2p
+        # send/recv routines — the raw-tuple memo skips the per-call
+        # str() normalization and lock (dict reads are GIL-atomic;
+        # writes happen only under the lock below)
+        try:
+            child = self._memo.get(values)
+            memoizable = True
+        except TypeError:           # unhashable label value
+            child, memoizable = None, False
+        if child is not None:
+            return child
         if len(values) != len(self.label_names):
             raise ValueError(
                 f"{self.name}: expected {len(self.label_names)} label "
@@ -47,6 +59,8 @@ class _Metric:
             if child is None:
                 child = self._new_child(key)
                 self._children[key] = child
+            if memoizable:
+                self._memo[values] = child
             return child
 
     def _new_child(self, key: tuple):  # pragma: no cover - abstract
