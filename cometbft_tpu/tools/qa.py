@@ -52,7 +52,8 @@ class WindowResult:
     sent: int = 0
     accepted: int = 0
     dropped: int = 0            # open-loop ticks held by the cap
-    committed: int = 0
+    stalled: bool = False       # net could not advance 2 blocks after
+    committed: int = 0          # the window: past saturation
     tx_per_s: float = 0.0
     latency_p50_s: float = 0.0
     latency_p90_s: float = 0.0
@@ -93,6 +94,7 @@ class QAReport:
     perturbed_recovered: bool = False
     statesync_joiner_height: int = 0
     mismatches: list[str] = field(default_factory=list)
+    notes: list[str] = field(default_factory=list)
 
     def to_dict(self) -> dict:
         import dataclasses
@@ -167,16 +169,22 @@ def _link_port(zones: dict, relay_specs: list, a: str, b: str,
 
 
 def _setup_net(outdir: str, n_validators: int, n_full: int,
-               ghosts: int, report: "QAReport"):
+               ghosts: int, report: "QAReport",
+               single_zone: bool = False, peer_degree: int = 0):
     """Everything both QA modes share before boot: per-node homes and
-    keys, the mixed-key genesis with ghost validators, the full-mesh
-    topology with inter-zone latency relays.
+    keys, the mixed-key genesis with ghost validators, the topology
+    (full mesh over inter-zone latency relays by default;
+    single_zone=True drops the WAN emulation and peer_degree=k bounds
+    each node to ring+skip neighbors — the sig-scale stage uses both,
+    where the deliverable is signature width, not WAN behavior, and
+    363 relay links across 33 time-shared processes starve the core).
 
     Returns (names, zones, cfgs, joiner_cfg, node_ids, p2p_port,
     relay_specs); cfgs have persistent_peers filled in."""
     names = [f"validator{i:02d}" for i in range(n_validators)] + \
             [f"full{i:02d}" for i in range(n_full)]
-    zones = {name: ZONES[i % len(ZONES)]
+    zones = {name: ZONES[0] if single_zone
+             else ZONES[i % len(ZONES)]
              for i, name in enumerate(names)}
     cfgs = {name: _mk_cfg(outdir, name, zones[name])
             for name in names}
@@ -212,9 +220,22 @@ def _setup_net(outdir: str, n_validators: int, n_full: int,
     relay_specs: list = []
     p2p_port = {name: int(cfgs[name].p2p.laddr.rsplit(":", 1)[1])
                 for name in names}
+    n = len(names)
     for i, name in enumerate(names):
+        if peer_degree and n > peer_degree:
+            # ring + doubling skips: connected, diameter O(log n)
+            offs = {1, 2}
+            k = 4
+            while k < n and len(offs) < peer_degree:
+                offs.add(k)
+                k *= 2
+            targets = [names[(i + o) % n] for o in sorted(offs)]
+        else:
+            targets = names[i + 1:]
         peers = []
-        for other in names[i + 1:]:
+        for other in targets:
+            if other == name:
+                continue
             peers.append(
                 f"{node_ids[other]}@127.0.0.1:"
                 f"{_link_port(zones, relay_specs, name, other, p2p_port[other])}")
@@ -226,8 +247,10 @@ def _setup_net(outdir: str, n_validators: int, n_full: int,
 def _note_saturation(report: "QAReport", w: "WindowResult",
                      rate: float) -> None:
     """Saturation rule (one place): the highest offered rate whose
-    committed throughput still tracks >= 80% of it."""
-    if w.tx_per_s >= 0.8 * rate:
+    committed throughput still tracks >= 80% of it — and whose window
+    did not stall (a net that needs minutes to advance after the
+    window is past saturation even if the backlog commits)."""
+    if not w.stalled and w.tx_per_s >= 0.8 * rate:
         report.saturation_rate = rate
 
 
@@ -308,7 +331,7 @@ def _record_intervals(report: "QAReport", secs: list) -> None:
 
 async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                  ghosts: int = 90,
-                 rates: tuple = (25, 50, 100, 200),
+                 rates: tuple = (10, 25, 50, 100, 200),
                  window_s: float = 15.0) -> QAReport:
     from ..abci.kvstore import KVStoreApplication
     from ..db import new_db
@@ -359,16 +382,23 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
         for wi, rate in enumerate(rates):
             res = await loadtime.generate(
                 endpoints, rate=rate, connections=2,
-                duration_s=window_s, size=256, method="async")
-            # let the tail commit
+                duration_s=window_s, size=256, method="async",
+                max_in_flight=16)
+            # let the tail commit; a net that cannot advance 2 blocks
+            # is past saturation — record the window and stop
+            # escalating instead of failing the whole run
+            stalled = False
             h0 = ref.height
-            await wait_height(h0 + 2, 60.0, who=[ref])
+            try:
+                await wait_height(h0 + 2, 60.0, who=[ref])
+            except TimeoutError:
+                stalled = True
             rep = await loadtime.report(
                 endpoints[0], experiment_id=res.experiment_id)
             w = WindowResult(
                 rate=rate, duration_s=window_s, sent=res.sent,
                 accepted=res.accepted, dropped=res.dropped,
-                committed=rep.latency.count,
+                stalled=stalled, committed=rep.latency.count,
                 tx_per_s=rep.latency.count / window_s,
                 latency_p50_s=rep.latency.p50_s,
                 latency_p90_s=rep.latency.p90_s,
@@ -377,8 +407,13 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
             logger.info("load window done", rate=rate,
                         committed=w.committed,
                         tx_s=round(w.tx_per_s, 1),
-                        p50=round(w.latency_p50_s, 3))
+                        p50=round(w.latency_p50_s, 3),
+                        stalled=stalled)
             _note_saturation(report, w, rate)
+            if stalled:
+                logger.info("net past saturation; stopping the ladder",
+                            rate=rate)
+                break
 
             if wi == 1:
                 # --- perturbation between windows: kill/restart one
@@ -401,22 +436,30 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
                             victim=victim)
 
         # --- statesync late joiner ----------------------------------
+        # non-fatal, like the procs mode: a joiner that cannot catch a
+        # loaded box within budget (e.g. after a stalled ladder broke
+        # out with backlog) must not void the recorded windows
         cli = HTTPClient(endpoints[0], timeout=30.0)
-        th = max(1, ref.height - 8)
-        blk = await cli.call("block", height=str(th))
-        _configure_joiner(joiner_cfg, endpoints, th,
-                          blk["block_id"]["hash"], node_ids,
-                          p2p_port, names)
-        app = KVStoreApplication(
-            db=new_db("app", "memdb", joiner_cfg.base.path("data")),
-            snapshot_interval=5)
-        joiner = Node(joiner_cfg, app=app)
-        await joiner.start()
-        target = ref.height
-        await wait_height(target, 180.0, who=[joiner])
-        report.statesync_joiner_height = joiner.height
-        logger.info("statesync joiner caught up",
-                    height=joiner.height)
+        try:
+            th = max(1, ref.height - 8)
+            blk = await cli.call("block", height=str(th))
+            _configure_joiner(joiner_cfg, endpoints, th,
+                              blk["block_id"]["hash"], node_ids,
+                              p2p_port, names)
+            app = KVStoreApplication(
+                db=new_db("app", "memdb",
+                          joiner_cfg.base.path("data")),
+                snapshot_interval=5)
+            joiner = Node(joiner_cfg, app=app)
+            await joiner.start()
+            target = ref.height
+            await wait_height(target, 180.0, who=[joiner])
+            report.statesync_joiner_height = joiner.height
+            logger.info("statesync joiner caught up",
+                        height=joiner.height)
+        except Exception as e:
+            logger.error("joiner stage failed", err=repr(e))
+            report.notes.append(f"joiner-stage: {e!r:.120}")
 
         report.final_height = ref.height
 
@@ -663,11 +706,14 @@ async def _rpc_height(endpoint: str) -> int:
 
 async def run_qa_procs(outdir: str, n_validators: int = 12,
                        n_full: int = 3, ghosts: int = 90,
-                       rates: tuple = (25, 50, 100, 200),
+                       rates: tuple = (10, 25, 50, 100, 200),
                        window_s: float = 90.0,
                        perturb: bool = True,
                        joiner: bool = True,
-                       profile: bool = True) -> QAReport:
+                       profile: bool = True,
+                       commit_timeout_ns: int = 0,
+                       single_zone: bool = False,
+                       peer_degree: int = 0) -> QAReport:
     """The reference-method QA run: separate OS process per node,
     90 s load windows, psutil resource series, mempool occupancy.
 
@@ -677,7 +723,9 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
 
     perturb/joiner gate the kill-restart and statesync stages (the
     sig-scale stage runs without them); profile captures a cProfile
-    window from node 0's live pprof during the last load window.
+    window from node 0's live pprof in a DEDICATED window after the
+    ladder — never overlapping a recorded window, since cProfile
+    drags the profiled node ~2x.
     """
     from ..rpc.client import HTTPClient
     from . import loadtime
@@ -685,11 +733,15 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
 
     report = QAReport()
     names, zones, cfgs, joiner_cfg, node_ids, p2p_port, relay_specs = \
-        _setup_net(outdir, n_validators, n_full, ghosts, report)
+        _setup_net(outdir, n_validators, n_full, ghosts, report,
+                   single_zone=single_zone, peer_degree=peer_degree)
     pprof_port = _free_port()
     if profile:
         cfgs[names[0]].instrumentation.pprof_listen_addr = \
             f"127.0.0.1:{pprof_port}"
+    if commit_timeout_ns:
+        for cfg in cfgs.values():
+            cfg.consensus.timeout_commit_ns = commit_timeout_ns
     for name in names:
         _write_node_overrides(cfgs[name])
 
@@ -730,6 +782,23 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
         await wait_height(2, 180.0)
         await _selfcheck_generator(report, max(rates))
 
+        async def drain_mempool(budget_s: float = 150.0) -> None:
+            """Let the backlog commit before the next stage so every
+            window measures its own offered rate (not the previous
+            rung's leftovers) and the joiner doesn't have to chase a
+            tip that is digesting minutes of queued load."""
+            deadline = time.monotonic() + budget_s
+            cli0 = HTTPClient(endpoints[0], timeout=10.0)
+            while time.monotonic() < deadline:
+                try:
+                    r = await cli0.call("num_unconfirmed_txs")
+                    if int(r.get("n_txs", r.get("total", 0)) or 0) \
+                            < 50:
+                        return
+                except Exception:
+                    pass
+                await asyncio.sleep(3.0)
+
         async def occupancy_series(stopper: asyncio.Event, out: list):
             cli = HTTPClient(endpoints[0], timeout=10.0)
             while not stopper.is_set():
@@ -746,18 +815,18 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             stop_occ = asyncio.Event()
             occ_task = asyncio.get_running_loop().create_task(
                 occupancy_series(stop_occ, occ))
-            if profile and wi == len(rates) - 1:
-                # capture node 0's cProfile during the last (highest-
-                # rate) window via the live pprof server
-                profile_task = asyncio.get_running_loop().create_task(
-                    _fetch_profile(pprof_port,
-                                   seconds=min(30, int(window_s))))
             t0 = time.monotonic()
             res = await loadtime.generate(
                 endpoints, rate=rate, connections=2,
-                duration_s=window_s, size=256, method="async")
+                duration_s=window_s, size=256, method="async",
+                max_in_flight=16)
+            stalled = False
             h0 = await _rpc_height(endpoints[0])
-            await wait_height(h0 + 2, 90.0)
+            try:
+                await wait_height(h0 + 2, 90.0)
+            except TimeoutError:
+                # past saturation: record the window, stop escalating
+                stalled = True
             t1 = time.monotonic()
             stop_occ.set()
             await occ_task
@@ -766,7 +835,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             w = WindowResult(
                 rate=rate, duration_s=window_s, sent=res.sent,
                 accepted=res.accepted, dropped=res.dropped,
-                committed=rep.latency.count,
+                stalled=stalled, committed=rep.latency.count,
                 tx_per_s=rep.latency.count / window_s,
                 latency_p50_s=rep.latency.p50_s,
                 latency_p90_s=rep.latency.p90_s,
@@ -782,8 +851,13 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 p50=round(w.latency_p50_s, 3),
                 rss_max_mb=round(w.rss_max_mb, 1),
                 cpu_pct=round(w.cpu_total_pct, 1),
-                mempool_max=w.mempool_max)
+                mempool_max=w.mempool_max, stalled=stalled)
             _note_saturation(report, w, rate)
+            if stalled:
+                logger.info("net past saturation; stopping the ladder",
+                            rate=rate)
+                break
+            await drain_mempool()
 
             if wi == 1 and perturb:
                 # kill -9 + restart one validator (reference:
@@ -806,12 +880,30 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 logger.info("perturbed node recovered",
                             victim=victim)
 
-        if profile_task is not None:
+        if profile:
+            # DEDICATED profile window, outside the measured ladder:
+            # cProfile costs ~2x on the profiled node and drags the
+            # whole net, so it must never overlap a recorded window
+            prate = report.saturation_rate or rates[0]
+            profile_task = asyncio.get_running_loop().create_task(
+                _fetch_profile(pprof_port, seconds=25))
+            await loadtime.generate(
+                endpoints, rate=prate, connections=2,
+                duration_s=30.0, size=256, method="async",
+                max_in_flight=16)
             report.profile_top = await profile_task
+            profile_task = None
+            logger.info("profile window captured", rate=prate,
+                        lines=len(report.profile_top))
 
         cli = HTTPClient(endpoints[0], timeout=30.0)
         joiner_ep = None
         if joiner:
+            # let any remaining backlog commit first: the joiner
+            # otherwise blocksyncs against a net that is busy
+            # committing minutes of queued load
+            await drain_mempool(240.0)
+
             # --- statesync late joiner (own process) ----------------
             th = max(1, await _rpc_height(endpoints[0]) - 8)
             blk = await cli.call("block", height=str(th))
@@ -824,24 +916,55 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             sampler.track("joiner", procs["joiner"])
             joiner_ep = "http://" + \
                 joiner_cfg.rpc.laddr[len("tcp://"):]
-            if not await _rpc_ready(joiner_ep, 240.0):
-                raise TimeoutError("joiner RPC never came up")
-            await wait_height(target, 300.0, eps=[joiner_ep])
-            report.statesync_joiner_height = await _rpc_height(
-                joiner_ep)
-            logger.info("statesync joiner caught up",
-                        height=report.statesync_joiner_height)
+            try:
+                if not await _rpc_ready(joiner_ep, 240.0):
+                    raise TimeoutError("joiner RPC never came up")
+                await wait_height(target, 600.0, eps=[joiner_ep])
+                report.statesync_joiner_height = await _rpc_height(
+                    joiner_ep)
+                logger.info("statesync joiner caught up",
+                            height=report.statesync_joiner_height)
+            except Exception as e:
+                # a late joiner that cannot catch a loaded 1-core box
+                # within budget must not void the whole report — the
+                # statesync path itself is covered by
+                # tests/test_statesync_e2e.py
+                logger.error("joiner stage failed", err=repr(e))
+                report.notes.append(f"joiner-stage: {e!r:.120}")
+                joiner_ep = None
 
-        report.final_height = await _rpc_height(endpoints[0])
+        for _ in range(3):
+            try:
+                report.final_height = await _rpc_height(endpoints[0])
+                break
+            except Exception:
+                await asyncio.sleep(2.0)
+        if not report.final_height:
+            report.notes.append(
+                "final-height probe failed; commit-sig/interval/"
+                "invariant scans skipped")
         await _sample_commit_sigs(report, cli, report.final_height)
 
         # --- block interval stats over RPC --------------------------
+        # best-effort with retries: 40 minutes of window data must
+        # never be lost to one slow RPC on the still-busy box
         times = []
         lo = 2
         while lo <= report.final_height:
             hi = min(lo + 19, report.final_height)
-            bc = await cli.call("blockchain", minHeight=str(lo),
-                                maxHeight=str(hi))
+            bc = None
+            for _ in range(3):
+                try:
+                    bc = await cli.call("blockchain",
+                                        minHeight=str(lo),
+                                        maxHeight=str(hi))
+                    break
+                except Exception:
+                    await asyncio.sleep(2.0)
+            if bc is None:
+                report.notes.append(
+                    f"block-interval scan truncated at {lo}")
+                break
             for meta in sorted(
                     bc.get("block_metas", []),
                     key=lambda m: int(m["header"]["height"])):
@@ -910,13 +1033,18 @@ async def run_sig_scale(outdir: str,
     (power 100 each) + 70 power-1 ghosts, so every commit carries
     >= 32 real signatures through the batch verification path in a
     running network.  Lighter stages (no perturbation / joiner /
-    profile) because 33 processes on this box saturate the core by
-    themselves; the deliverable is the per-block verified-signature
-    width + that the net sustains load at that width."""
+    profile — 33 processes on this box saturate the core by
+    themselves), and a 2 s commit timeout: at 200 ms the proposer
+    commits before the slowest third of 32 time-shared validators
+    deliver their precommits, capping the measured width at ~22-24 of
+    32.  The deliverable is the per-block verified-signature width +
+    that the net sustains load at that width."""
     return await run_qa_procs(
         outdir, n_validators=32, n_full=1, ghosts=70,
-        rates=(10, 25), window_s=window_s,
-        perturb=False, joiner=False, profile=False)
+        rates=(5, 10), window_s=window_s,
+        perturb=False, joiner=False, profile=False,
+        commit_timeout_ns=2_000_000_000,
+        single_zone=True, peer_degree=6)
 
 
 def main(argv=None) -> int:

@@ -309,3 +309,33 @@ class TestLightVerifier:
         h1.header.time = Timestamp(1800000000, 0)
         with pytest.raises(InvalidHeaderError):
             verify_backwards(h1.header, h2.header)
+
+
+class TestLaneBytesBookkeeping:
+    """lane_sizes byte totals are maintained incrementally (the rescan
+    form measured ~19% of a saturated node's CPU — QA_r05 profile);
+    the counter must agree with a recount through every mutation."""
+
+    def test_counter_matches_recount_through_lifecycle(self):
+        async def go():
+            mp, app, conns = _mk_mempool()
+
+            def recount(lane):
+                d = mp._lane_txs[lane]
+                return len(d), sum(len(e.tx) for e in d.values())
+
+            txs = [b"k%03d=v%d" % (i, i) for i in range(12)]
+            for tx in txs:
+                await mp.check_tx(tx)
+            for lane in mp.lanes:
+                assert mp.lane_sizes(lane) == recount(lane)
+            # commit-style removal of a third of them
+            from cometbft_tpu.mempool.mempool import tx_key
+            for tx in txs[::3]:
+                mp.remove_tx_by_key(tx_key(tx))
+            for lane in mp.lanes:
+                assert mp.lane_sizes(lane) == recount(lane)
+            mp.flush()
+            for lane in mp.lanes:
+                assert mp.lane_sizes(lane) == (0, 0) == recount(lane)
+        run(go())
