@@ -206,6 +206,14 @@ def _setup_net(outdir: str, n_validators: int, n_full: int,
     doc.consensus_params.validator.pub_key_types = [
         "ed25519", "secp256k1"]
     doc.consensus_params.feature.pbts_enable_height = 1
+    # bound proposals under backlog: with 256 B load txs and the 4 MB
+    # default, a single post-saturation proposal reaps the entire
+    # queue — a block too big to gossip through the latency relays
+    # before the propose timeout, so rounds churn while the backlog
+    # (and the next proposal) keeps growing.  128 KiB ≈ 450 txs keeps
+    # rounds bounded; operators size real chains the same way.
+    doc.consensus_params.block.max_bytes = 131072
+    doc.consensus_params.evidence.max_bytes = 32768
     report.validators_total = len(vals)
     report.validators_live = n_validators
     report.nodes = len(names) + 1
