@@ -1,0 +1,57 @@
+"""QA net topology (tools/qa.py _setup_net): the sig-scale stage's
+bounded-degree graph must stay connected, and single-zone must drop
+every latency relay."""
+import pytest
+
+from cometbft_tpu.tools import qa
+
+
+def _build(tmp_path, n_validators, n_full, **kw):
+    report = qa.QAReport()
+    return qa._setup_net(str(tmp_path), n_validators, n_full, 4,
+                         report, **kw)
+
+
+def _adjacency(names, cfgs, node_ids):
+    id_to_name = {v: k for k, v in node_ids.items()}
+    adj = {n: set() for n in names}
+    for name in names:
+        for peer in filter(None,
+                           cfgs[name].p2p.persistent_peers.split(",")):
+            pid = peer.split("@", 1)[0]
+            other = id_to_name[pid]
+            adj[name].add(other)
+            adj[other].add(name)      # dials are bidirectional links
+    return adj
+
+
+class TestTopology:
+    def test_default_is_full_mesh_with_relays(self, tmp_path):
+        names, zones, cfgs, _jc, node_ids, _pp, relays = _build(
+            tmp_path, 5, 1, single_zone=False, peer_degree=0)
+        adj = _adjacency(names, cfgs, node_ids)
+        for n in names:
+            assert adj[n] == set(names) - {n}
+        assert relays                      # three zones -> relay links
+        assert len(set(zones.values())) == 3
+
+    def test_bounded_degree_ring_is_connected(self, tmp_path):
+        names, zones, cfgs, _jc, node_ids, _pp, relays = _build(
+            tmp_path, 12, 1, single_zone=True, peer_degree=4)
+        assert relays == []                # one zone -> no relays
+        assert set(zones.values()) == {qa.ZONES[0]}
+        adj = _adjacency(names, cfgs, node_ids)
+        # each node dials at most peer_degree targets
+        for name in names:
+            dials = [p for p in
+                     cfgs[name].p2p.persistent_peers.split(",") if p]
+            assert len(dials) <= 4
+        # BFS: the union graph is connected
+        seen, frontier = {names[0]}, [names[0]]
+        while frontier:
+            cur = frontier.pop()
+            for nxt in adj[cur]:
+                if nxt not in seen:
+                    seen.add(nxt)
+                    frontier.append(nxt)
+        assert seen == set(names)
