@@ -17,6 +17,131 @@ class ABCIClientError(Exception):
     pass
 
 
+class ABCITimeoutError(ABCIClientError):
+    """A remote ABCI call exceeded its deadline."""
+
+
+# ---------------------------------------------------------------------
+# Deadline propagation for remote (socket/gRPC) transports: a wedged
+# app process must not hang consensus forever.  Consensus-path methods
+# may legitimately run long (a big FinalizeBlock), so they get a wider
+# budget than queries.
+
+_SLOW_METHODS = frozenset({
+    "init_chain", "prepare_proposal", "process_proposal",
+    "finalize_block", "commit", "extend_vote", "offer_snapshot",
+    "apply_snapshot_chunk"})
+
+# read-only / idempotent methods safe to retry after a transient
+# transport error (a state-mutating call may have executed before the
+# transport died, so it gets exactly one attempt)
+_RETRIABLE_METHODS = frozenset({
+    "echo", "info", "query", "flush", "list_snapshots",
+    "load_snapshot_chunk"})
+
+
+def _is_transient_transport_error(e: BaseException) -> bool:
+    if isinstance(e, (ConnectionError, asyncio.IncompleteReadError,
+                      OSError)):
+        return True
+    # grpc.aio.AioRpcError without importing grpc here (the socket
+    # transport must not require the grpc package)
+    code = getattr(e, "code", None)
+    if callable(code):
+        try:
+            return getattr(code(), "name", "") in (
+                "UNAVAILABLE", "DEADLINE_EXCEEDED")
+        except Exception:
+            return False
+    return False
+
+
+class DeadlineClient:
+    """Transparent per-call deadline + bounded-retry wrapper over any
+    ABCI client (socket or gRPC).
+
+    Every coroutine method gets asyncio.wait_for with a per-method
+    timeout (``overrides`` > slow/default split); read-only methods
+    are retried up to ``retries`` times on transient transport errors
+    with exponential backoff.  A deadline miss surfaces as
+    ABCITimeoutError so callers can distinguish a wedged app from an
+    app-level failure."""
+
+    def __init__(self, inner, default_timeout_s: float = 20.0,
+                 slow_timeout_s: float = 0.0, retries: int = 2,
+                 retry_backoff_s: float = 0.1,
+                 overrides: Optional[dict] = None, logger=None):
+        object.__setattr__(self, "_inner", inner)
+        self._default_timeout_s = default_timeout_s
+        # consensus-path calls default to 6x the query budget
+        self._slow_timeout_s = slow_timeout_s or 6 * default_timeout_s
+        self._retries = max(0, retries)
+        self._retry_backoff_s = retry_backoff_s
+        self._overrides = dict(overrides or {})
+        if logger is None:
+            from ..libs.log import new_logger
+            logger = new_logger("abci-deadline")
+        self._logger = logger
+
+    def timeout_for(self, method: str) -> float:
+        t = self._overrides.get(method)
+        if t is not None:
+            return t
+        return self._slow_timeout_s if method in _SLOW_METHODS \
+            else self._default_timeout_s
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name.startswith("_") or not callable(attr) or \
+                not asyncio.iscoroutinefunction(attr):
+            return attr
+        timeout = self.timeout_for(name)
+        attempts = 1 + (self._retries
+                        if name in _RETRIABLE_METHODS else 0)
+        logger = self._logger
+        backoff = self._retry_backoff_s
+
+        async def bounded(*a, **kw):
+            for i in range(attempts):
+                try:
+                    return await asyncio.wait_for(
+                        attr(*a, **kw),
+                        timeout if timeout > 0 else None)
+                except asyncio.TimeoutError:
+                    raise ABCITimeoutError(
+                        f"ABCI {name} exceeded its {timeout}s "
+                        f"deadline") from None
+                except Exception as e:  # noqa: BLE001 — classify below
+                    if i + 1 < attempts and \
+                            _is_transient_transport_error(e):
+                        logger.info("retrying ABCI call after "
+                                    "transient transport error",
+                                    method=name, attempt=i + 1,
+                                    err=repr(e))
+                        await asyncio.sleep(backoff * (2 ** i))
+                        continue
+                    raise
+
+        # cache so the hot path (every CheckTx) never re-enters
+        # __getattr__ for this method again
+        object.__setattr__(self, name, bounded)
+        return bounded
+
+
+def apply_deadlines(app_conns, default_timeout_s: float,
+                    retries: int = 2) -> None:
+    """Wrap the four named connections with per-call deadlines
+    (remote transports only — a builtin app shares our event loop, so
+    a deadline there would fire on our own backpressure)."""
+    for conn in ("consensus", "mempool", "query", "snapshot"):
+        inner = getattr(app_conns, conn, None)
+        if inner is not None and not isinstance(inner, DeadlineClient):
+            setattr(app_conns, conn, DeadlineClient(
+                inner, default_timeout_s=default_timeout_s,
+                retries=retries))
+    return app_conns
+
+
 class LocalClient:
     """In-process client serializing calls with one lock.
 

@@ -46,7 +46,8 @@ class BlockPool:
     def __init__(self, start_height: int,
                  send_request: Callable[[str, int], bool],
                  ban_peer: Callable[[str, str], None],
-                 logger: Optional[Logger] = None):
+                 logger: Optional[Logger] = None,
+                 supervisor=None):
         self.height = start_height      # next height to sync
         self._send_request = send_request
         self._ban_peer = ban_peer
@@ -54,7 +55,8 @@ class BlockPool:
             new_logger("blockpool")
         self.peers: dict[str, _PoolPeer] = {}
         self.requesters: dict[int, _Requester] = {}
-        self._task: Optional[asyncio.Task] = None
+        self._supervisor = supervisor
+        self._task = None   # asyncio.Task or SupervisedTask
         self.is_running = False
         # event-driven requester loop (reference: the pool blocks on
         # channel events, internal/blocksync/pool.go makeRequestersRoutine);
@@ -78,8 +80,14 @@ class BlockPool:
     # ------------------------------------------------------------------
     def start(self) -> None:
         self.is_running = True
-        self._task = asyncio.get_running_loop().create_task(
-            self._make_requesters_routine())
+        if self._supervisor is not None:
+            self._task = self._supervisor.spawn(
+                lambda: self._make_requesters_routine(),
+                name="blockpool_requesters",
+                kind="blockpool_requesters")
+        else:
+            self._task = asyncio.get_running_loop().create_task(
+                self._make_requesters_routine())
 
     def stop(self) -> None:
         self.is_running = False

@@ -69,7 +69,7 @@ class BlocksyncReactor(Reactor):
         self.active = active
         self.on_caught_up = on_caught_up
         self.pool: Optional[BlockPool] = None
-        self._tasks: list[asyncio.Task] = []
+        self._tasks: list = []   # SupervisedTask handles
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(id=BLOCKSYNC_CHANNEL, priority=5,
@@ -77,19 +77,26 @@ class BlocksyncReactor(Reactor):
 
     # ------------------------------------------------------------------
     async def start_sync(self) -> None:
-        """Begin syncing (reference: OnStart when blocksync enabled)."""
+        """Begin syncing (reference: OnStart when blocksync enabled).
+        Both routines (and the pool's requester loop) are
+        supervisor-owned: a crash restarts the loop instead of
+        silently wedging the sync."""
         self.pool = BlockPool(
             self.block_store.height + 1
             if self.block_store.height else
             max(self.state.initial_height, 1),
             send_request=self._send_block_request,
-            ban_peer=self._ban_peer)
+            ban_peer=self._ban_peer,
+            supervisor=self.supervisor)
         self.pool.start()
         self.metrics.syncing.set(1)
-        loop = asyncio.get_running_loop()
         self._tasks = [
-            loop.create_task(self._sync_routine()),
-            loop.create_task(self._status_routine()),
+            self.supervisor.spawn(lambda: self._sync_routine(),
+                                  name="blocksync_sync",
+                                  kind="blocksync_sync"),
+            self.supervisor.spawn(lambda: self._status_routine(),
+                                  name="blocksync_status",
+                                  kind="blocksync_status"),
         ]
 
     async def stop_sync(self) -> None:
@@ -293,7 +300,7 @@ class BlocksyncReactor(Reactor):
         self.pool = None
         current = asyncio.current_task()
         for t in self._tasks:
-            if t is not current:
+            if getattr(t, "runner", t) is not current:
                 t.cancel()
         self._tasks = []
         if self.on_caught_up is not None:

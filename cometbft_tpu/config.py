@@ -35,6 +35,13 @@ class BaseConfig:
     # record the ABCI call trace for the grammar checker
     # (reference: the e2e app's request recording)
     abci_grammar_trace: bool = False
+    # per-call deadline for remote (socket/grpc) ABCI transports so a
+    # wedged app cannot hang consensus forever; 0 disables.  The
+    # consensus-path methods (FinalizeBlock, PrepareProposal, ...) get
+    # 6x this budget; read-only calls retry on transient transport
+    # errors up to abci_call_retries times.
+    abci_call_timeout_ns: int = 20 * _S
+    abci_call_retries: int = 2
 
     def path(self, rel: str) -> str:
         return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
@@ -200,6 +207,11 @@ def validate_basic(cfg: Config) -> None:
                                    "goleveldb", "pebbledb"):
         raise ConfigError(
             f"base.db_backend: unknown backend {cfg.base.db_backend!r}")
+    if cfg.base.abci_call_timeout_ns < 0 or \
+            cfg.base.abci_call_retries < 0:
+        raise ConfigError(
+            "base.abci_call_timeout/abci_call_retries cannot be "
+            "negative")
     if cfg.rpc.max_body_bytes <= 0:
         raise ConfigError("rpc.max_body_bytes must be positive")
     if cfg.rpc.timeout_broadcast_tx_commit_ns <= 0:

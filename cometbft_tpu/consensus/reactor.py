@@ -14,6 +14,7 @@ from typing import Optional
 
 from ..libs.bits import BitArray
 from ..libs.log import Logger, new_logger
+from ..libs.supervisor import RestartPolicy
 from ..p2p.conn import ChannelDescriptor
 from ..p2p.switch import Peer, Reactor
 from ..types import canonical
@@ -198,6 +199,14 @@ class PeerState:
             prs.catchup_commit = BitArray(num_validators)
 
 
+# per-peer gossip loops: quick bounded restarts; a loop that keeps
+# crashing means the peer (or our state for it) is poison, so the
+# give-up path drops the peer like the pre-supervisor error handlers
+_GOSSIP_RESTART_POLICY = RestartPolicy(
+    max_restarts=3, window_s=30.0, backoff_base_s=0.05,
+    backoff_max_s=1.0)
+
+
 class ConsensusReactor(Reactor):
     def __init__(self, cs: ConsensusState,
                  wait_sync: bool = False,
@@ -208,7 +217,7 @@ class ConsensusReactor(Reactor):
         if logger is not None:
             self.logger = logger
         self._peer_states: dict[str, PeerState] = {}
-        self._gossip_tasks: dict[str, list[asyncio.Task]] = {}
+        self._gossip_tasks: dict[str, list] = {}   # SupervisedTask
         # wire the state machine's broadcasts through the switch
         cs.broadcast_hooks.append(self._on_cs_broadcast)
         cs.on_new_step.append(self._on_new_step)
@@ -231,11 +240,33 @@ class ConsensusReactor(Reactor):
         ps = PeerState(peer)
         self._peer_states[peer.id] = ps
         peer.data["consensus_peer_state"] = ps
-        loop = asyncio.get_running_loop()
+        # supervisor-owned: a crash in a gossip loop restarts that
+        # loop (with a restart metric) instead of silently muting the
+        # peer until disconnect
+        sup = self.supervisor
+        pid = peer.id[:12]
+
+        def _stop_peer_on_giveup(st, exc):
+            # restart budget exhausted: the peer is poison — drop it
+            # (the pre-supervisor behavior, now after bounded retries)
+            if self.switch is not None:
+                asyncio.get_event_loop().create_task(
+                    self.switch.stop_peer(peer, repr(exc)))
+
+        policy = _GOSSIP_RESTART_POLICY
         self._gossip_tasks[peer.id] = [
-            loop.create_task(self._gossip_data_routine(ps)),
-            loop.create_task(self._gossip_votes_routine(ps)),
-            loop.create_task(self._query_maj23_routine(ps)),
+            sup.spawn(lambda: self._gossip_data_routine(ps),
+                      name=f"gossip_data:{pid}",
+                      kind="consensus_gossip_data", policy=policy,
+                      on_giveup=_stop_peer_on_giveup),
+            sup.spawn(lambda: self._gossip_votes_routine(ps),
+                      name=f"gossip_votes:{pid}",
+                      kind="consensus_gossip_votes", policy=policy,
+                      on_giveup=_stop_peer_on_giveup),
+            sup.spawn(lambda: self._query_maj23_routine(ps),
+                      name=f"query_maj23:{pid}",
+                      kind="consensus_query_maj23", policy=policy,
+                      on_giveup=_stop_peer_on_giveup),
         ]
         # tell the new peer our current state — but NOT while we're
         # block/state syncing: we drop incoming votes in that mode, and
@@ -349,6 +380,28 @@ class ConsensusReactor(Reactor):
             self.switch.broadcast(STATE_CHANNEL, encode_p2p(
                 HasVoteMessage(height=v.height, round=v.round,
                                type=v.type, index=v.validator_index)))
+        elif isinstance(msg, tuple) and msg and msg[0] == "valid_block":
+            self.switch.broadcast(STATE_CHANNEL,
+                                  encode_p2p(self._valid_block_msg()))
+
+    def _valid_block_msg(self) -> NewValidBlockMessage:
+        """Reference: makeRoundStepMessages' NewValidBlockMessage —
+        advertises the part-set header we are collecting and the
+        bitmap of parts we ACTUALLY hold, so peers (re)send the rest
+        even when their delivery bookkeeping says otherwise."""
+        rs = self.cs.rs
+        parts = rs.proposal_block_parts
+        bits = BitArray(parts.total if parts is not None else 0)
+        if parts is not None:
+            for i, have in enumerate(parts.bit_array()):
+                if have:
+                    bits.set_index(i, True)
+        return NewValidBlockMessage(
+            height=rs.height, round=rs.round,
+            block_part_set_header=(parts.header() if parts is not None
+                                   else PartSetHeader()),
+            block_parts=bits,
+            is_commit=rs.step == STEP_COMMIT)
 
     def _new_round_step_msg(self) -> NewRoundStepMessage:
         rs = self.cs.rs
@@ -411,9 +464,10 @@ class ConsensusReactor(Reactor):
                 if (rs.proposal is not None and rs.height == prs.height
                         and rs.round == prs.round and
                         not prs.proposal):
-                    if peer.send(DATA_CHANNEL,
-                                 encode_p2p(ProposalMessage(
-                                     rs.proposal))):
+                    sent_prop = peer.send(
+                        DATA_CHANNEL,
+                        encode_p2p(ProposalMessage(rs.proposal)))
+                    if sent_prop:
                         ps.apply_proposal(ProposalMessage(rs.proposal))
                     if rs.proposal.pol_round >= 0:
                         pv = rs.votes.prevotes(rs.proposal.pol_round)
@@ -424,15 +478,19 @@ class ConsensusReactor(Reactor):
                                     proposal_pol_round=rs.proposal
                                     .pol_round,
                                     proposal_pol=pv.bit_array())))
-                    continue
+                    if sent_prop:
+                        await asyncio.sleep(0)  # keep the loop fair
+                        continue
+                    # send queue full: prs.proposal stays False, so a
+                    # bare continue would spin without ever yielding
+                    # (a hard event-loop livelock caught by the
+                    # nemesis crash/restart scenario) — fall through
+                    # to the timed sleep and let the queue drain
                 await asyncio.sleep(self._sleep_s)
         except asyncio.CancelledError:
             raise
-        except Exception as e:
-            self.logger.error("gossip data routine died",
-                              peer=peer.id[:12], err=str(e))
-            if self.switch is not None:
-                await self.switch.stop_peer(peer, str(e))
+        # any other exception propagates to the supervisor, which
+        # restarts this loop (bounded) and drops the peer on give-up
 
     async def _gossip_catchup(self, ps: PeerState) -> bool:
         """Send a block part from the store for a lagging peer
@@ -490,11 +548,8 @@ class ConsensusReactor(Reactor):
                 await asyncio.sleep(self._sleep_s)
         except asyncio.CancelledError:
             raise
-        except Exception as e:
-            self.logger.error("gossip votes routine died",
-                              peer=peer.id[:12], err=str(e))
-            if self.switch is not None:
-                await self.switch.stop_peer(peer, str(e))
+        # crashes propagate to the supervisor (restart, then drop the
+        # peer on give-up)
 
     async def _gossip_votes_for_height(self, rs, ps: PeerState) -> bool:
         """Reference: gossipVotesForHeight."""
@@ -577,6 +632,19 @@ class ConsensusReactor(Reactor):
                 await asyncio.sleep(sleep_s)
                 rs = self.cs.rs
                 prs = ps.prs
+                # wedge guard: while we sit in the commit step with an
+                # incomplete block, periodically re-advertise the part
+                # bitmap we ACTUALLY hold.  A part lost on a lossy
+                # link after the one-shot commit-entry announcement
+                # would otherwise never be re-sent (the sender's
+                # bookkeeping says delivered) and this node would stay
+                # wedged forever — found by the nemesis faulty-links
+                # scenario.
+                if rs.step == STEP_COMMIT and \
+                        rs.proposal_block_parts is not None and \
+                        not rs.proposal_block_parts.is_complete():
+                    peer.send(STATE_CHANNEL,
+                              encode_p2p(self._valid_block_msg()))
                 if rs.height != prs.height or rs.votes is None:
                     continue
                 for type_, vs in ((canonical.PREVOTE_TYPE,
@@ -593,8 +661,5 @@ class ConsensusReactor(Reactor):
                                 type=type_, block_id=bid)))
         except asyncio.CancelledError:
             raise
-        except Exception as e:
-            self.logger.error("query maj23 routine died",
-                              peer=peer.id[:12], err=str(e))
-            if self.switch is not None:
-                await self.switch.stop_peer(peer, str(e))
+        # crashes propagate to the supervisor (restart, then drop the
+        # peer on give-up)

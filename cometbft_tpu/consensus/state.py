@@ -67,9 +67,14 @@ class ConsensusState:
                  event_bus: Optional[EventBus] = None,
                  wal: Optional[WAL] = None,
                  logger: Optional[Logger] = None,
-                 metrics: Optional["Metrics"] = None):
+                 metrics: Optional["Metrics"] = None,
+                 supervisor=None):
         from .metrics import Metrics
         self.metrics = metrics if metrics is not None else Metrics()
+        # when set (node wiring), the receive routine is
+        # supervisor-owned: a crash restarts it (bounded) with metrics
+        # instead of silently halting consensus
+        self.supervisor = supervisor
         self.config = config
         self.block_exec = block_exec
         self.block_store = block_store
@@ -112,8 +117,17 @@ class ConsensusState:
 
     async def start(self) -> None:
         self._stopped.clear()
-        self._task = asyncio.get_running_loop().create_task(
-            self._receive_routine())
+        if self.supervisor is not None:
+            from ..libs.supervisor import RestartPolicy
+            self._task = self.supervisor.spawn(
+                lambda: self._receive_routine(),
+                name="consensus_receive", kind="consensus_receive",
+                policy=RestartPolicy(max_restarts=3, window_s=60.0,
+                                     backoff_base_s=0.05,
+                                     backoff_max_s=1.0))
+        else:
+            self._task = asyncio.get_running_loop().create_task(
+                self._receive_routine())
         self._schedule_round0()
 
     async def stop(self) -> None:
@@ -935,6 +949,13 @@ class ConsensusState:
                 rs.proposal_block_parts = PartSet(
                     block_id.part_set_header)
                 self.event_bus.publish_valid_block(rs.event_summary())
+                # tell peers which parts we ACTUALLY hold (reference:
+                # the reactor broadcasts NewValidBlockMessage on
+                # EventValidBlock).  Without this, a part that was
+                # queued-but-lost before we entered commit is never
+                # re-sent — the sender's bookkeeping says delivered —
+                # and this node wedges in the commit step forever.
+                self._broadcast(("valid_block",))
 
         await self._try_finalize_commit(height)
 
@@ -1145,6 +1166,10 @@ class ConsensusState:
                             block_id.part_set_header)
                     self.event_bus.publish_valid_block(
                         rs.event_summary())
+                    # reference reactor: EventValidBlock ->
+                    # NewValidBlockMessage broadcast (peers learn our
+                    # real part bitmap and (re)send what we miss)
+                    self._broadcast(("valid_block",))
             if rs.round < vote.round and prevotes.has_two_thirds_any():
                 await self._enter_new_round(height, vote.round)
             elif rs.round == vote.round and rs.step >= STEP_PREVOTE:

@@ -37,7 +37,8 @@ def _sources() -> list[str]:
             os.path.join(d, "sha512.hpp"),
             os.path.join(d, "sha512_mb.hpp"),
             os.path.join(d, "bls12381.hpp"),
-            os.path.join(d, "ed25519_msm.hpp")]
+            os.path.join(d, "ed25519_msm.hpp"),
+            os.path.join(d, "chacha20poly1305.hpp")]
 
 
 def _host_tag() -> str:

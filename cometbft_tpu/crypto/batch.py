@@ -130,6 +130,96 @@ def batch_verify_by_type(entries) -> list:
     return out
 
 
+# --- TPU dispatch circuit breaker ------------------------------------
+# A failed kernel compile/dispatch on this platform is deterministic
+# per process (e.g. the Pallas TPU kernel on a GPU or unknown
+# accelerator): without a breaker the dispatch re-attempted — and
+# re-paid — the failed compile on EVERY batch (ADVICE r5 #1).  The
+# first non-transient failure latches the breaker open and every later
+# batch goes straight to the CPU verifier; transient faults (pooled
+# TPU hiccups) open it for a timeout and then re-probe once.  Breaker
+# state is exported on the process-global metrics registry.
+
+_TPU_BREAKER = None
+
+
+def tpu_breaker():
+    """The process-global breaker guarding TPU kernel dispatch."""
+    global _TPU_BREAKER
+    if _TPU_BREAKER is None:
+        from ..libs import metrics as libmetrics
+        from ..libs.breaker import CircuitBreaker
+        from ..libs.breaker import Metrics as BreakerMetrics
+        _TPU_BREAKER = CircuitBreaker(
+            "crypto_tpu_kernel", failure_threshold=1,
+            reset_timeout_s=float(os.environ.get(
+                "COMETBFT_TPU_BREAKER_RESET_S", "300")),
+            metrics=BreakerMetrics(libmetrics.DEFAULT))
+    return _TPU_BREAKER
+
+
+def reset_tpu_breaker() -> None:
+    """Test hook: discard the process-global breaker."""
+    global _TPU_BREAKER
+    _TPU_BREAKER = None
+
+
+_TRANSIENT_MARKERS = ("timeout", "timed out", "deadline", "unavailable",
+                      "resource_exhausted", "connection", "aborted")
+
+
+def _is_transient_kernel_error(e: BaseException) -> bool:
+    """Conservative classification: connection/timeout shapes re-probe
+    after a cooldown; anything else (compile/lowering/platform errors)
+    is deterministic for this process and latches the breaker."""
+    if isinstance(e, (TimeoutError, ConnectionError)):
+        return True
+    s = f"{type(e).__name__}: {e}".lower()
+    return any(m in s for m in _TRANSIENT_MARKERS)
+
+
+class GuardedTpuBatchVerifier(BatchVerifier):
+    """TPU batch verifier behind the process-global circuit breaker.
+
+    verify() attempts the JAX/XLA kernel only while the breaker
+    admits it; a dispatch failure records against the breaker (latched
+    open for non-transient faults, so the failing kernel is attempted
+    at most once per process) and the SAME batch falls back to the CPU
+    verifier — callers always get a verdict."""
+
+    def __init__(self, breaker=None):
+        self._breaker = breaker if breaker is not None else tpu_breaker()
+        self._items: list[tuple[PubKey, bytes, bytes]] = []
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        if pub_key.type() != ed25519.KEY_TYPE:
+            raise TypeError("GuardedTpuBatchVerifier requires ed25519 keys")
+        if len(sig) != 64:
+            raise ValueError("malformed signature")
+        self._items.append((pub_key, bytes(msg), bytes(sig)))
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+    def verify(self):
+        br = self._breaker
+        if br.allow():
+            try:
+                from ..ops.ed25519_jax import verify_batch
+                out = verify_batch([(pk.bytes(), m, s)
+                                    for pk, m, s in self._items])
+            except Exception as e:  # noqa: BLE001 — fall back below
+                br.record_failure(
+                    latch=not _is_transient_kernel_error(e))
+            else:
+                br.record_success()
+                return out
+        cpu = ed25519.CpuBatchVerifier()
+        for pk, m, s in self._items:
+            cpu.add(pk, m, s)
+        return cpu.verify()
+
+
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     """Reference: batch.go:10 — errors for unsupported key types."""
     if pub_key.type() == _BLS_KEY_TYPE:
@@ -138,9 +228,5 @@ def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     if pub_key.type() != ed25519.KEY_TYPE:
         raise ValueError(f"batch verification unsupported for {pub_key.type()}")
     if get_backend() == "tpu":
-        try:
-            from ..ops.ed25519_jax import TpuBatchVerifier
-            return TpuBatchVerifier()
-        except Exception:
-            pass
+        return GuardedTpuBatchVerifier()
     return ed25519.CpuBatchVerifier()

@@ -21,11 +21,25 @@ import secrets
 from collections import OrderedDict
 from typing import Sequence
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:
+    # Gate the missing dependency instead of dying at import: some
+    # containers ship without the OpenSSL bindings, which used to take
+    # down EVERY module that (transitively) imports this one.  The
+    # exact pure-python ZIP-215 model signs/verifies, with the native
+    # C++ batch equation as the single-verify fast-accept path.
+    _HAVE_OPENSSL = False
+
+    class InvalidSignature(Exception):
+        pass
+
+    Ed25519PrivateKey = Ed25519PublicKey = None  # type: ignore[assignment]
 
 from . import _ed25519_ref as ref
 from .keys import BatchVerifier, PrivKey, PubKey, address_hash
@@ -84,6 +98,8 @@ class Ed25519PubKey(PubKey):
         # check fast in both directions.
         if len(sig) != SIGNATURE_SIZE:
             return False
+        if not _HAVE_OPENSSL:
+            return _verify_without_openssl(self._raw, msg, sig)
         try:
             _cached_openssl_pub(self._raw).verify(sig, msg)
             return True
@@ -96,6 +112,23 @@ class Ed25519PubKey(PubKey):
             return ref.verify(self._raw, msg, sig)
 
 
+def _verify_without_openssl(raw_pub: bytes, msg: bytes,
+                            sig: bytes) -> bool:
+    """Single-signature verify when the OpenSSL bindings are absent:
+    fast-accept through the native C++ batch equation (one item), with
+    the exact-but-slow python ZIP-215 model deciding rejects — the
+    same accept/reject contract as the CpuBatchVerifier path."""
+    native = _native_msm()
+    if native is not None:
+        try:
+            if native.ed25519_batch_verify(
+                    [(raw_pub, msg, sig)], secrets.token_bytes(16)):
+                return True
+        except Exception:
+            pass   # malformed shapes fall through to the exact model
+    return ref.verify(raw_pub, msg, sig)
+
+
 class Ed25519PrivKey(PrivKey):
     __slots__ = ("_seed", "_pub", "_ossl")
 
@@ -106,17 +139,24 @@ class Ed25519PrivKey(PrivKey):
         if len(raw) != 32:
             raise ValueError("ed25519 privkey must be 32-byte seed or 64 bytes")
         self._seed = bytes(raw)
-        self._ossl = Ed25519PrivateKey.from_private_bytes(self._seed)
-        from cryptography.hazmat.primitives.serialization import (
-            Encoding, PublicFormat,
-        )
-        self._pub = self._ossl.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw)
+        if _HAVE_OPENSSL:
+            self._ossl = Ed25519PrivateKey.from_private_bytes(
+                self._seed)
+            from cryptography.hazmat.primitives.serialization import (
+                Encoding, PublicFormat,
+            )
+            self._pub = self._ossl.public_key().public_bytes(
+                Encoding.Raw, PublicFormat.Raw)
+        else:
+            self._ossl = None
+            self._pub = ref.public_key(self._seed)
 
     def bytes(self) -> bytes:
         return self._seed + self._pub  # 64-byte reference layout
 
     def sign(self, msg: bytes) -> bytes:
+        if self._ossl is None:
+            return ref.sign(self._seed, msg)
         return self._ossl.sign(msg)
 
     def pub_key(self) -> Ed25519PubKey:

@@ -19,15 +19,23 @@ from __future__ import annotations
 import hashlib
 import secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:
+    # dependency gate: the pure-python RFC 6979 signer/verifier in
+    # _secp256k1_math carries this (cold-path) key type instead of
+    # the import killing crypto/encoding.py and everything above it
+    _HAVE_OPENSSL = False
 
+from . import _secp256k1_math as _sp
 from .keys import PrivKey, PubKey
 
 KEY_TYPE = "secp256k1"
@@ -39,8 +47,9 @@ SIG_SIZE = 64              # R || S
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 _HALF_N = _N // 2
 
-_CURVE = ec.SECP256K1()
-_PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))
+if _HAVE_OPENSSL:
+    _CURVE = ec.SECP256K1()
+    _PREHASHED_SHA256 = ec.ECDSA(Prehashed(hashes.SHA256()))
 
 
 def _sha256(b: bytes) -> bytes:
@@ -86,6 +95,12 @@ class Secp256k1PubKey(PubKey):
         s = int.from_bytes(sig[32:], "big")
         if not (0 < r < _N) or not (0 < s < _N) or s > _HALF_N:
             return False
+        if not _HAVE_OPENSSL:
+            try:
+                return _sp.verify(_sp.decode_point(self._raw),
+                                  _sha256(msg), r, s)
+            except ValueError:
+                return False
         try:
             der = encode_dss_signature(r, s)
             self._parsed().verify(der, _sha256(msg), _PREHASHED_SHA256)
@@ -95,7 +110,7 @@ class Secp256k1PubKey(PubKey):
 
 
 class Secp256k1PrivKey(PrivKey):
-    __slots__ = ("_raw", "_sk")
+    __slots__ = ("_raw", "_sk", "_d")
 
     def __init__(self, raw: bytes):
         if len(raw) != PRIV_KEY_SIZE:
@@ -105,7 +120,9 @@ class Secp256k1PrivKey(PrivKey):
         if not (0 < d < _N):
             raise ValueError("secp256k1 privkey scalar out of range")
         self._raw = bytes(raw)
-        self._sk = ec.derive_private_key(d, _CURVE)
+        self._d = d
+        self._sk = ec.derive_private_key(d, _CURVE) \
+            if _HAVE_OPENSSL else None
 
     def bytes(self) -> bytes:
         return self._raw
@@ -113,13 +130,19 @@ class Secp256k1PrivKey(PrivKey):
     def sign(self, msg: bytes) -> bytes:
         """ECDSA over SHA-256(msg); returns R||S with S normalized to the
         lower half-order. Ref secp256k1.go:120-131."""
-        der = self._sk.sign(_sha256(msg), _PREHASHED_SHA256)
-        r, s = decode_dss_signature(der)
+        if self._sk is None:
+            r, s = _sp.sign(self._d, _sha256(msg))
+        else:
+            der = self._sk.sign(_sha256(msg), _PREHASHED_SHA256)
+            r, s = decode_dss_signature(der)
         if s > _HALF_N:
             s = _N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> Secp256k1PubKey:
+        if self._sk is None:
+            return Secp256k1PubKey(_sp.encode_compressed(
+                _sp.pub_point(self._d)))
         from cryptography.hazmat.primitives.serialization import (
             Encoding, PublicFormat,
         )

@@ -12,19 +12,25 @@ from __future__ import annotations
 
 import secrets
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric import ec
-from cryptography.hazmat.primitives.asymmetric.utils import (
-    Prehashed,
-    decode_dss_signature,
-    encode_dss_signature,
-)
-from cryptography.hazmat.primitives.serialization import (
-    Encoding,
-    PublicFormat,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric import ec
+    from cryptography.hazmat.primitives.asymmetric.utils import (
+        Prehashed,
+        decode_dss_signature,
+        encode_dss_signature,
+    )
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding,
+        PublicFormat,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:
+    # dependency gate — see crypto/secp256k1.py
+    _HAVE_OPENSSL = False
 
+from . import _secp256k1_math as _sp
 from ._keccak import keccak256
 from .keys import PrivKey, PubKey
 
@@ -36,10 +42,11 @@ SIG_SIZE = 64
 
 _N = 0xFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFFEBAAEDCE6AF48A03BBFD25E8CD0364141
 _HALF_N = _N // 2
-_CURVE = ec.SECP256K1()
-# ECDSA over an externally-computed Keccak-256 digest: SHA-256 here only
-# names a 32-byte digest length for the Prehashed wrapper
-_PREHASHED = ec.ECDSA(Prehashed(hashes.SHA256()))
+if _HAVE_OPENSSL:
+    _CURVE = ec.SECP256K1()
+    # ECDSA over an externally-computed Keccak-256 digest: SHA-256 here
+    # only names a 32-byte digest length for the Prehashed wrapper
+    _PREHASHED = ec.ECDSA(Prehashed(hashes.SHA256()))
 
 
 class Secp256k1EthPubKey(PubKey):
@@ -76,6 +83,12 @@ class Secp256k1EthPubKey(PubKey):
         s = int.from_bytes(sig[32:], "big")
         if not (0 < r < _N) or not (0 < s < _N) or s > _HALF_N:
             return False
+        if not _HAVE_OPENSSL:
+            try:
+                return _sp.verify(_sp.decode_point(self._raw),
+                                  keccak256(msg), r, s)
+            except ValueError:
+                return False
         try:
             self._parsed().verify(encode_dss_signature(r, s),
                                   keccak256(msg), _PREHASHED)
@@ -85,7 +98,7 @@ class Secp256k1EthPubKey(PubKey):
 
 
 class Secp256k1EthPrivKey(PrivKey):
-    __slots__ = ("_raw", "_sk")
+    __slots__ = ("_raw", "_sk", "_d")
 
     def __init__(self, raw: bytes):
         if len(raw) != PRIV_KEY_SIZE:
@@ -95,19 +108,27 @@ class Secp256k1EthPrivKey(PrivKey):
         if not (0 < d < _N):
             raise ValueError("secp256k1eth privkey scalar out of range")
         self._raw = bytes(raw)
-        self._sk = ec.derive_private_key(d, _CURVE)
+        self._d = d
+        self._sk = ec.derive_private_key(d, _CURVE) \
+            if _HAVE_OPENSSL else None
 
     def bytes(self) -> bytes:
         return self._raw
 
     def sign(self, msg: bytes) -> bytes:
-        der = self._sk.sign(keccak256(msg), _PREHASHED)
-        r, s = decode_dss_signature(der)
+        if self._sk is None:
+            r, s = _sp.sign(self._d, keccak256(msg))
+        else:
+            der = self._sk.sign(keccak256(msg), _PREHASHED)
+            r, s = decode_dss_signature(der)
         if s > _HALF_N:
             s = _N - s
         return r.to_bytes(32, "big") + s.to_bytes(32, "big")
 
     def pub_key(self) -> Secp256k1EthPubKey:
+        if self._sk is None:
+            return Secp256k1EthPubKey(_sp.encode_uncompressed(
+                _sp.pub_point(self._d)))
         raw = self._sk.public_key().public_bytes(
             Encoding.X962, PublicFormat.UncompressedPoint)
         return Secp256k1EthPubKey(raw)

@@ -31,15 +31,17 @@ class EvidenceReactor(Reactor):
         if logger is not None:
             self.logger = logger
         self.pool = pool
-        self._tasks: dict[str, asyncio.Task] = {}
+        self._tasks: dict[str, object] = {}   # SupervisedTask
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(id=EVIDENCE_CHANNEL, priority=6,
                                   send_queue_capacity=100)]
 
     async def add_peer(self, peer: Peer) -> None:
-        self._tasks[peer.id] = asyncio.get_running_loop().create_task(
-            self._broadcast_routine(peer))
+        self._tasks[peer.id] = self.supervisor.spawn(
+            lambda: self._broadcast_routine(peer),
+            name=f"evidence_broadcast:{peer.id[:12]}",
+            kind="evidence_broadcast")
 
     async def remove_peer(self, peer: Peer, reason: str) -> None:
         t = self._tasks.pop(peer.id, None)
@@ -81,6 +83,5 @@ class EvidenceReactor(Reactor):
                 await asyncio.sleep(_BROADCAST_INTERVAL_S)
         except asyncio.CancelledError:
             raise
-        except Exception as e:
-            self.logger.error("evidence broadcast died",
-                              peer=peer.id[:12], err=str(e))
+        # crashes propagate to the supervisor, which restarts this
+        # loop instead of letting evidence gossip die silently

@@ -25,6 +25,9 @@ def _fmt_value(v: float) -> str:
     return repr(v)
 
 
+_MEMO_MAX = 1024
+
+
 class _Metric:
     kind = "untyped"
 
@@ -41,12 +44,18 @@ class _Metric:
         # hot path: with_labels runs per gossip message in the p2p
         # send/recv routines — the raw-tuple memo skips the per-call
         # str() normalization and lock (dict reads are GIL-atomic;
-        # writes happen only under the lock below)
+        # writes happen only under the lock below).  Only all-str
+        # tuples are memoized: that is the actual hot-path shape, and
+        # it keeps equal-but-differently-typed values (1 vs "1") from
+        # creating duplicate memo entries for one child; the memo is
+        # FIFO-bounded like the vote memos so peer-controlled label
+        # values cannot grow it without bound.
         try:
             child = self._memo.get(values)
-            memoizable = True
         except TypeError:           # unhashable label value
             child, memoizable = None, False
+        else:
+            memoizable = all(type(v) is str for v in values)
         if child is not None:
             return child
         if len(values) != len(self.label_names):
@@ -60,6 +69,8 @@ class _Metric:
                 child = self._new_child(key)
                 self._children[key] = child
             if memoizable:
+                if len(self._memo) >= _MEMO_MAX:
+                    self._memo.pop(next(iter(self._memo)))
                 self._memo[values] = child
             return child
 

@@ -13,9 +13,14 @@ strings, numbers, dates (treated as strings here).  Tags are multi-valued
 from __future__ import annotations
 
 import asyncio
+import re
 from datetime import datetime, timezone
 from dataclasses import dataclass, field
 from typing import Any, Callable, Optional
+
+# "<date>T<time>.<frac><tz-or-nothing>" — fraction capped to
+# microseconds for python 3.10's fromisoformat
+_FRAC_RE = re.compile(r"^([^.]+)\.(\d+)(.*)$")
 
 
 class PubSubError(Exception):
@@ -73,6 +78,12 @@ def _parse_time_like(raw: str):
     txt = raw.strip()
     if txt.endswith("Z"):
         txt = txt[:-1] + "+00:00"
+    # python < 3.11 fromisoformat accepts only 3- or 6-digit
+    # fractional seconds; RFC3339 emitters produce 1-9 digits (a
+    # nanosecond field with trailing zeros trimmed) — normalize to 6
+    m = _FRAC_RE.match(txt)
+    if m:
+        txt = f"{m.group(1)}.{(m.group(2) + '000000')[:6]}{m.group(3)}"
     try:
         dt = datetime.fromisoformat(txt)
     except ValueError:

@@ -12,6 +12,7 @@ from typing import Optional
 
 from ..config import MempoolConfig
 from ..libs.log import Logger
+from ..libs.supervisor import RestartPolicy
 from ..p2p.conn import ChannelDescriptor
 from ..p2p.switch import Peer, Reactor
 from ..wire.proto import F, Msg, encode, decode
@@ -33,7 +34,7 @@ class MempoolReactor(Reactor):
         self.config = config
         if logger is not None:
             self.logger = logger
-        self._gossip_tasks: dict[str, asyncio.Task] = {}
+        self._gossip_tasks: dict[str, object] = {}  # SupervisedTask
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(id=MEMPOOL_CHANNEL, priority=5,
@@ -42,9 +43,20 @@ class MempoolReactor(Reactor):
     async def add_peer(self, peer: Peer) -> None:
         if not self.config.broadcast:
             return
-        self._gossip_tasks[peer.id] = \
-            asyncio.get_running_loop().create_task(
-                self._gossip_routine(peer))
+
+        def _stop_peer_on_giveup(st, exc):
+            if self.switch is not None:
+                asyncio.get_event_loop().create_task(
+                    self.switch.stop_peer(peer, repr(exc)))
+
+        self._gossip_tasks[peer.id] = self.supervisor.spawn(
+            lambda: self._gossip_routine(peer),
+            name=f"mempool_gossip:{peer.id[:12]}",
+            kind="mempool_gossip",
+            policy=RestartPolicy(max_restarts=3, window_s=30.0,
+                                 backoff_base_s=0.05,
+                                 backoff_max_s=1.0),
+            on_giveup=_stop_peer_on_giveup)
 
     async def remove_peer(self, peer: Peer, reason: str) -> None:
         t = self._gossip_tasks.pop(peer.id, None)
@@ -107,8 +119,5 @@ class MempoolReactor(Reactor):
                     await self.mempool.wait_for_change(last_seq)
         except asyncio.CancelledError:
             raise
-        except Exception as e:
-            self.logger.error("mempool gossip died", peer=peer.id[:12],
-                              err=str(e))
-            if self.switch is not None:
-                await self.switch.stop_peer(peer, str(e))
+        # crashes propagate to the supervisor (bounded restart, then
+        # drop the peer on give-up)

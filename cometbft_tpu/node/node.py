@@ -139,6 +139,8 @@ class Node:
         from ..blocksync.metrics import Metrics as BlocksyncMetrics
         from ..consensus.metrics import Metrics as ConsensusMetrics
         from ..libs.metrics import Registry
+        from ..libs.supervisor import Metrics as SupervisorMetrics
+        from ..libs.supervisor import Supervisor
         from ..mempool.metrics import Metrics as MempoolMetrics
         from ..p2p.metrics import Metrics as P2PMetrics
         from ..state.metrics import Metrics as StateMetrics
@@ -151,6 +153,14 @@ class Node:
         self.statesync_metrics = StatesyncMetrics(self.metrics_registry)
         self.state_metrics = StateMetrics(self.metrics_registry)
         self.proxy_metrics = ProxyMetrics(self.metrics_registry)
+        # failure-domain supervision: node-level loops (consensus
+        # receive) run under this supervisor; the switch owns a
+        # sibling sharing the same metric family, so every restart is
+        # visible at /metrics
+        self.supervisor_metrics = SupervisorMetrics(
+            self.metrics_registry)
+        self.supervisor = Supervisor("node", logger=self.logger,
+                                     metrics=self.supervisor_metrics)
 
         # --- mempool ----------------------------------------------------
         self.mempool: Optional[CListMempool] = None
@@ -167,7 +177,8 @@ class Node:
             moniker=config.base.moniker,
             send_rate=config.p2p.send_rate,
             recv_rate=config.p2p.recv_rate,
-            metrics=self.p2p_metrics)
+            metrics=self.p2p_metrics,
+            supervisor_metrics=self.supervisor_metrics)
         self.switch.private_ids = {
             s.strip() for s in
             config.p2p.private_peer_ids.split(",") if s.strip()}
@@ -201,6 +212,18 @@ class Node:
         # out-of-process app: open the four socket AppConns first
         # (reference: createAndStartProxyAppConns, setup.go:179)
         await self.app_conns.start()
+
+        # deadline propagation on the remote ABCI boundary: a wedged
+        # app process cannot hang consensus forever (builtin apps
+        # share our event loop, so no deadline there)
+        if cfg.base.abci not in ("local", "builtin",
+                                 "builtin_unsync") and \
+                cfg.base.abci_call_timeout_ns > 0:
+            from ..abci.client import apply_deadlines
+            apply_deadlines(
+                self.app_conns,
+                default_timeout_s=cfg.base.abci_call_timeout_ns / 1e9,
+                retries=cfg.base.abci_call_retries)
 
         # per-method ABCI timing (reference: proxy metrics)
         from ..abci.metrics import instrument_app_conns
@@ -302,7 +325,8 @@ class Node:
             cfg.consensus, state, block_exec, self.block_store,
             priv_validator=self.priv_validator,
             event_bus=self.event_bus, wal=WAL(wal_path),
-            metrics=self.consensus_metrics)
+            metrics=self.consensus_metrics,
+            supervisor=self.supervisor)
         try:
             try:
                 await catchup_replay(self.consensus_state, wal_path)
@@ -482,6 +506,7 @@ class Node:
             self._event_sink.close()
         if self.consensus_state is not None:
             await self.consensus_state.stop()
+        await self.supervisor.stop()
         await self.switch.stop()
         if self._rpc_server is not None:
             await self._rpc_server.stop()

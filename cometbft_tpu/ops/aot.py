@@ -69,11 +69,17 @@ def call(kernel: str, a, r, s_w8, k_w8):
     if exp is None:
         return None
     import jax
-    if jax.default_backend() == "cpu":
-        return None     # artifacts are TPU-only; CPU uses live jit
-    # non-CPU backend: attempt the TPU-lowered artifact even if the
-    # plugin registers under another name ("axon"); a genuine platform
-    # mismatch raises inside exp.call and falls back to live jit below
+
+    from .ed25519_jax import TPU_PLATFORMS
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        return None
+    if backend not in TPU_PLATFORMS:
+        # artifacts are TPU-lowered; the allowlist covers the pooled
+        # plugin name ("axon"), while CPU/GPU/unknown accelerators use
+        # live jit instead of failing the artifact per batch
+        return None
     try:
         return exp.call(a, r, s_w8, k_w8)
     except Exception:

@@ -321,13 +321,22 @@ def verify_batch(
     return bool(out.all()), out.tolist()
 
 
+# Platforms whose devices run the Mosaic/Pallas TPU kernels.  The
+# pooled chip may register under its plugin name ("axon") rather than
+# "tpu" — but the set is an ALLOWLIST: a GPU or unknown accelerator
+# would fail the TPU lowering on every batch (ADVICE r5 #1), so
+# anything not listed here takes the portable XLA/CPU path.
+TPU_PLATFORMS = frozenset({"tpu", "axon"})
+
+
 def _kernel_choice() -> str:
     """'pallas' (fused Mosaic 24-limb kernel; TPU), 'pallas8' (the
     first-generation 32x8-bit kernel) or 'xla' (portable).
 
     COMETBFT_TPU_KERNEL=pallas|pallas8|xla overrides; auto picks
-    pallas on TPU platforms only — on CPU the pallas path would run
-    interpreted."""
+    pallas on known TPU platforms only — on CPU the pallas path would
+    run interpreted, and on GPUs/unknown accelerators it would fail
+    to lower."""
     choice = os.environ.get("COMETBFT_TPU_KERNEL", "auto").lower()
     if choice in ("pallas", "pallas8", "xla"):
         return choice
@@ -335,9 +344,7 @@ def _kernel_choice() -> str:
         platform = jax.devices()[0].platform
     except Exception:
         return "xla"
-    # the pooled chip may register under its plugin name ("axon")
-    # rather than "tpu"; anything that isn't the host CPU is the chip
-    return "pallas" if platform != "cpu" else "xla"
+    return "pallas" if platform in TPU_PLATFORMS else "xla"
 
 
 def _pallas_module(choice: str):
@@ -450,9 +457,12 @@ def _try_aot(choice: str, interpret: bool, a_b, r_b, s_w8, k_w8):
     if interpret or os.environ.get("COMETBFT_TPU_AOT", "1") == "0":
         return None
     try:
-        if jax.default_backend() == "cpu":
-            return None     # artifacts are TPU-only (plugin may be
-    except Exception:       # named "axon"; aot.call copes either way)
+        # artifacts are TPU-lowered: only attempt them on a known TPU
+        # platform name (allowlist, not "anything non-cpu" — a GPU
+        # would fail the deserialized program on every batch)
+        if jax.default_backend() not in TPU_PLATFORMS:
+            return None
+    except Exception:
         return None
     if choice not in ("pallas", "xla"):
         return None     # no committed artifacts for fallback kernels

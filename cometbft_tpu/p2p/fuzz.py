@@ -19,6 +19,8 @@ class FuzzConfig:
     prob_delay: float = 0.0           # sleep before delivering
     max_delay_s: float = 0.05
     prob_corrupt_read: float = 0.0    # flip a byte in an incoming frame
+    prob_reorder: float = 0.0         # deliver a frame after its successor
+    prob_duplicate: float = 0.0       # deliver an outgoing frame twice
     seed: int = 0
 
 
@@ -32,8 +34,14 @@ class FuzzedConnection:
         self.dropped = 0
         self.delayed = 0
         self.corrupted = 0
+        self.reordered = 0
+        self.duplicated = 0
+        self._held: bytes | None = None   # one-frame reorder window
 
     async def write_msg(self, data: bytes) -> None:
+        # the reorder/duplicate draws are gated on their probability
+        # being set, so existing seeded schedules (drop/delay/corrupt
+        # only) consume the exact same RNG sequence as before
         cfg = self.config
         if self._rng.random() < cfg.prob_drop_write:
             self.dropped += 1
@@ -41,7 +49,21 @@ class FuzzedConnection:
         if self._rng.random() < cfg.prob_delay:
             self.delayed += 1
             await asyncio.sleep(self._rng.random() * cfg.max_delay_s)
+        if cfg.prob_reorder and self._held is None and \
+                self._rng.random() < cfg.prob_reorder:
+            # hold this frame back; it ships right after the NEXT
+            # frame (frame boundaries preserved, order swapped)
+            self._held = data
+            self.reordered += 1
+            return
         await self._conn.write_msg(data)
+        if cfg.prob_duplicate and \
+                self._rng.random() < cfg.prob_duplicate:
+            self.duplicated += 1
+            await self._conn.write_msg(data)
+        if self._held is not None:
+            held, self._held = self._held, None
+            await self._conn.write_msg(held)
 
     async def read_msg(self) -> bytes:
         data = await self._conn.read_msg()
@@ -56,6 +78,12 @@ class FuzzedConnection:
         return data
 
     def close(self) -> None:
+        if self._held is not None:
+            # a frame held for reorder with no successor is a drop,
+            # not a reorder — keep the counters truthful
+            self._held = None
+            self.reordered -= 1
+            self.dropped += 1
         self._conn.close()
 
     def __getattr__(self, name):

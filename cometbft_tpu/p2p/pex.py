@@ -222,15 +222,16 @@ class PexReactor(Reactor):
         self.book = book
         self.seed_mode = seed_mode
         self.max_outbound = max_outbound
-        self._task: Optional[asyncio.Task] = None
+        self._task = None   # SupervisedTask
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return [ChannelDescriptor(id=PEX_CHANNEL, priority=1,
                                   send_queue_capacity=10)]
 
     async def start(self) -> None:
-        self._task = asyncio.get_running_loop().create_task(
-            self._ensure_peers_routine())
+        self._task = self.supervisor.spawn(
+            lambda: self._ensure_peers_routine(),
+            name="pex_ensure_peers", kind="pex_ensure_peers")
 
     async def stop(self) -> None:
         if self._task is not None:

@@ -13,16 +13,28 @@ from __future__ import annotations
 import asyncio
 import struct
 
-from cryptography.hazmat.primitives import hashes
-from cryptography.hazmat.primitives.asymmetric.x25519 import (
-    X25519PrivateKey, X25519PublicKey,
-)
-from cryptography.hazmat.primitives.ciphers.aead import ChaCha20Poly1305
-from cryptography.hazmat.primitives.kdf.hkdf import HKDF
-from cryptography.hazmat.primitives.serialization import (
-    Encoding, PublicFormat,
-)
+try:
+    from cryptography.hazmat.primitives import hashes
+    from cryptography.hazmat.primitives.asymmetric.x25519 import (
+        X25519PrivateKey, X25519PublicKey,
+    )
+    from cryptography.hazmat.primitives.ciphers.aead import (
+        ChaCha20Poly1305,
+    )
+    from cryptography.hazmat.primitives.kdf.hkdf import HKDF
+    from cryptography.hazmat.primitives.serialization import (
+        Encoding, PublicFormat,
+    )
+    _HAVE_OPENSSL = True
+except ImportError:
+    # Dependency gate: without the OpenSSL bindings the whole p2p
+    # stack used to die at import.  The self-contained fallback
+    # (native C++ AEAD + python X25519/HKDF) is bit-compatible, so
+    # mixed deployments interoperate.
+    _HAVE_OPENSSL = False
+    ChaCha20Poly1305 = None  # type: ignore[assignment]
 
+from ..crypto import _aead_fallback
 from ..crypto import ed25519
 from ..crypto.keys import PrivKey, PubKey
 
@@ -48,12 +60,45 @@ def _derive(dh_secret: bytes, lo: bytes, hi: bytes,
             loc_is_least: bool) -> tuple[bytes, bytes, bytes]:
     """(recv_secret, send_secret, challenge) — reference:
     deriveSecrets + transcript challenge extraction."""
-    okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=lo + hi,
-               info=_HKDF_INFO).derive(dh_secret)
+    if _HAVE_OPENSSL:
+        okm = HKDF(algorithm=hashes.SHA256(), length=96, salt=lo + hi,
+                   info=_HKDF_INFO).derive(dh_secret)
+    else:
+        okm = _aead_fallback.hkdf_sha256(dh_secret, lo + hi,
+                                         _HKDF_INFO, 96)
     s1, s2, challenge = okm[:32], okm[32:64], okm[64:]
     if loc_is_least:
         return s2, s1, challenge   # recv, send
     return s1, s2, challenge
+
+
+def _new_aead(key: bytes):
+    if _HAVE_OPENSSL:
+        return ChaCha20Poly1305(key)
+    return _aead_fallback.ChaCha20Poly1305(key)
+
+
+def _gen_ephemeral() -> tuple[object, bytes]:
+    """(private handle, raw public key bytes)."""
+    if _HAVE_OPENSSL:
+        priv = X25519PrivateKey.generate()
+        return priv, priv.public_key().public_bytes(
+            Encoding.Raw, PublicFormat.Raw)
+    return _aead_fallback.x25519_keypair()
+
+
+def _dh(priv, rem_pub: bytes) -> bytes:
+    if _HAVE_OPENSSL:
+        return priv.exchange(X25519PublicKey.from_public_bytes(
+            rem_pub))
+    out = _aead_fallback.x25519(priv, rem_pub)
+    if out == bytes(32):
+        # match OpenSSL's contributory-behavior check: a small-order
+        # peer point yields the all-zero secret, which would let an
+        # active attacker fix the session keys
+        raise SecretConnectionError(
+            "x25519: low-order peer public key")
+    return out
 
 
 class SecretConnection:
@@ -81,9 +126,7 @@ class SecretConnection:
                    writer: asyncio.StreamWriter,
                    loc_priv_key: PrivKey) -> "SecretConnection":
         """The 2-round handshake (reference: MakeSecretConnection)."""
-        eph_priv = X25519PrivateKey.generate()
-        eph_pub = eph_priv.public_key().public_bytes(
-            Encoding.Raw, PublicFormat.Raw)
+        eph_priv, eph_pub = _gen_ephemeral()
 
         # 1) exchange ephemeral pubkeys in the clear
         writer.write(eph_pub)
@@ -92,13 +135,12 @@ class SecretConnection:
 
         lo, hi = sorted([eph_pub, rem_eph_pub])
         loc_is_least = eph_pub == lo
-        dh_secret = eph_priv.exchange(
-            X25519PublicKey.from_public_bytes(rem_eph_pub))
+        dh_secret = _dh(eph_priv, rem_eph_pub)
         recv_secret, send_secret, challenge = _derive(
             dh_secret, lo, hi, loc_is_least)
 
-        sc = cls(reader, writer, ChaCha20Poly1305(send_secret),
-                 ChaCha20Poly1305(recv_secret), remote_pub_key=None)
+        sc = cls(reader, writer, _new_aead(send_secret),
+                 _new_aead(recv_secret), remote_pub_key=None)
 
         # 2) prove identity: send (pubkey || sig(challenge)) encrypted
         loc_pub = loc_priv_key.pub_key()

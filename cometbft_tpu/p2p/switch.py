@@ -14,6 +14,7 @@ from typing import Optional
 
 from .. import version as _version
 from ..libs.log import Logger, new_logger
+from ..libs.supervisor import Supervisor
 from .conn import ChannelDescriptor, MConnection
 from .key import NodeKey, node_id_from_pub_key
 from .secret_connection import SecretConnection
@@ -106,6 +107,20 @@ class Reactor:
         self.name = name
         self.switch: Optional["Switch"] = None
         self.logger = new_logger(name.lower())
+        self._own_supervisor: Optional[Supervisor] = None
+
+    @property
+    def supervisor(self) -> Supervisor:
+        """Every reactor background loop is supervisor-owned: a crash
+        restarts the loop (with metrics) instead of silently killing
+        it.  Reactors attached to a switch share its supervisor;
+        standalone reactors (tests) lazily get a private one."""
+        if self.switch is not None:
+            return self.switch.supervisor
+        if self._own_supervisor is None:
+            self._own_supervisor = Supervisor(self.name.lower(),
+                                              logger=self.logger)
+        return self._own_supervisor
 
     def get_channels(self) -> list[ChannelDescriptor]:
         return []
@@ -128,7 +143,8 @@ class Switch:
                  logger: Optional[Logger] = None,
                  send_rate: float = 5_120_000,
                  recv_rate: float = 5_120_000,
-                 metrics=None):
+                 metrics=None,
+                 supervisor_metrics=None):
         self.node_key = node_key
         self.network = network
         self.listen_addr = listen_addr
@@ -147,10 +163,18 @@ class Switch:
         self.peers: dict[str, Peer] = {}
         self._server: Optional[asyncio.base_events.Server] = None
         self._persistent_addrs: list[str] = []
-        self._dial_tasks: list[asyncio.Task] = []
+        self._dial_tasks: list = []   # SupervisedTask handles
         # peer ids whose addresses must never be gossiped via PEX
         # (reference: sw.AddPrivatePeerIDs / p2p.private_peer_ids)
         self.private_ids: set[str] = set()
+        # one-for-one supervision of every switch/reactor background
+        # loop; reactors reach it via Reactor.supervisor
+        self.supervisor = Supervisor("p2p", logger=self.logger,
+                                     metrics=supervisor_metrics)
+        # test seam (nemesis/fuzz link faults): wraps the authenticated
+        # secret connection before the MConnection is built —
+        # conn_wrapper(sconn, peer_node_id, outbound) -> conn
+        self.conn_wrapper = None
 
     # ------------------------------------------------------------------
     def add_reactor(self, reactor: Reactor) -> None:
@@ -183,8 +207,8 @@ class Switch:
             self.logger.info("P2P listening", addr=self.listen_addr)
 
     async def stop(self) -> None:
-        for t in self._dial_tasks:
-            t.cancel()
+        await self.supervisor.stop()
+        self._dial_tasks = []
         if self._server is not None:
             self._server.close()
         for peer in list(self.peers.values()):
@@ -240,6 +264,13 @@ class Switch:
 
         peer_holder: list[Peer] = []
 
+        conn = sconn
+        if self.conn_wrapper is not None:
+            # nemesis/fuzz seam: slot link-fault wrappers between the
+            # authenticated transport and the MConnection
+            conn = self.conn_wrapper(sconn, their_info.node_id,
+                                     outbound)
+
         async def on_receive(chan_id: int, msg: bytes) -> None:
             reactor = self._chan_to_reactor.get(chan_id)
             if reactor is not None and peer_holder:
@@ -250,7 +281,7 @@ class Switch:
                 asyncio.get_event_loop().create_task(
                     self.stop_peer(peer_holder[0], str(e)))
 
-        mconn = MConnection(sconn, self._channel_descs, on_receive,
+        mconn = MConnection(conn, self._channel_descs, on_receive,
                             on_error, send_rate=self.send_rate,
                             recv_rate=self.recv_rate,
                             metrics=self.metrics,
@@ -290,11 +321,13 @@ class Switch:
     def dial_peers_async(self, addrs: list[str],
                          persistent: bool = True) -> None:
         """Background dialing with exponential backoff for persistent
-        peers (reference: dial loops + reconnect)."""
-        loop = asyncio.get_running_loop()
+        peers (reference: dial loops + reconnect).  Each dial loop is
+        supervisor-owned: an uncaught exception restarts it instead of
+        silently ending redials for that address."""
         for addr in addrs:
-            self._dial_tasks.append(loop.create_task(
-                self._dial_loop(addr, persistent)))
+            self._dial_tasks.append(self.supervisor.spawn(
+                lambda a=addr, p=persistent: self._dial_loop(a, p),
+                name=f"dial:{addr}", kind="dial"))
 
     async def _dial_loop(self, addr: str, persistent: bool) -> None:
         """Dial with backoff; persistent peers are re-dialed forever

@@ -17,6 +17,13 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+try:
+    _shard_map = jax.shard_map
+except AttributeError:
+    # dependency gate: jax < 0.5 ships shard_map under experimental;
+    # the installed 0.4.37 has no top-level alias
+    from jax.experimental.shard_map import shard_map as _shard_map
+
 from ..ops.ed25519_jax import _verify_kernel
 
 BATCH_AXIS = "sig_batch"
@@ -57,7 +64,7 @@ def _sharded_verify_fn(ndev: int, kernel: str, interpret: bool,
         def body(a, r, s, k):
             return _verify_kernel(a, r, _win_cols(s), _win_cols(k))
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         body,
         mesh=mesh,
         in_specs=(P(BATCH_AXIS), P(BATCH_AXIS),
@@ -118,7 +125,7 @@ def sharded_verify_tally(mesh: Mesh):
         count = jax.lax.psum(jnp.sum(ok.astype(jnp.int32)), BATCH_AXIS)
         return ok, count
 
-    shard = jax.shard_map(
+    shard = _shard_map(
         step,
         mesh=mesh,
         in_specs=(P(BATCH_AXIS), P(BATCH_AXIS),

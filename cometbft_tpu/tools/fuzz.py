@@ -42,7 +42,11 @@ _REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 DEFAULT_CORPUS = os.path.join(_REPO, "tests", "fuzz_corpus")
 
-_TOOL = sys.monitoring.COVERAGE_ID
+# PEP 669 is python 3.12+; on older interpreters the CoverageMap
+# falls back to sys.settrace (slower, same semantics) instead of
+# killing every importer of this module at collection time
+_HAVE_MONITORING = hasattr(sys, "monitoring")
+_TOOL = sys.monitoring.COVERAGE_ID if _HAVE_MONITORING else 0
 _MAX_INPUT = 4096
 
 
@@ -65,7 +69,24 @@ class CoverageMap:
             self.fresh += 1
         return sys.monitoring.DISABLE
 
+    def _trace(self, frame, event, arg):
+        # sys.settrace fallback (pre-3.12): per-call filtering keeps
+        # the overhead on non-target frames to one dict lookup
+        if event == "call":
+            return self._trace \
+                if frame.f_code.co_filename in self._files else None
+        if event == "line":
+            loc = (frame.f_code.co_filename, frame.f_lineno)
+            if loc not in self.locations:
+                self.locations.add(loc)
+                self.fresh += 1
+        return self._trace
+
     def __enter__(self):
+        if not _HAVE_MONITORING:
+            sys.settrace(self._trace)
+            self._active = True
+            return self
         sys.monitoring.use_tool_id(_TOOL, "cometbft-fuzz")
         sys.monitoring.register_callback(
             _TOOL, sys.monitoring.events.LINE, self._on_line)
@@ -79,11 +100,14 @@ class CoverageMap:
 
     def __exit__(self, *exc):
         if self._active:
-            sys.monitoring.set_events(
-                _TOOL, sys.monitoring.events.NO_EVENTS)
-            sys.monitoring.register_callback(
-                _TOOL, sys.monitoring.events.LINE, None)
-            sys.monitoring.free_tool_id(_TOOL)
+            if not _HAVE_MONITORING:
+                sys.settrace(None)
+            else:
+                sys.monitoring.set_events(
+                    _TOOL, sys.monitoring.events.NO_EVENTS)
+                sys.monitoring.register_callback(
+                    _TOOL, sys.monitoring.events.LINE, None)
+                sys.monitoring.free_tool_id(_TOOL)
             self._active = False
         return False
 

@@ -24,6 +24,7 @@
 #include "sha512_mb.hpp"
 #include "ed25519_msm.hpp"
 #include "bls12381.hpp"
+#include "chacha20poly1305.hpp"
 
 namespace {
 
@@ -776,7 +777,63 @@ PyObject* ed25519_batch_verify(PyObject*, PyObject* args) {
     return PyLong_FromLong(ok);
 }
 
+// chacha20poly1305_seal(key, nonce, aad, plaintext) -> ct||tag
+// The p2p secret-connection frame hot path when the python
+// `cryptography` package is absent (see crypto/_aead_fallback.py).
+PyObject* chacha20poly1305_seal(PyObject*, PyObject* args) {
+    const char *key, *nonce, *aad, *pt;
+    Py_ssize_t keyl, noncel, aadl, ptl;
+    if (!PyArg_ParseTuple(args, "y#y#y#y#", &key, &keyl, &nonce,
+                          &noncel, &aad, &aadl, &pt, &ptl))
+        return nullptr;
+    if (keyl != 32 || noncel != 12) {
+        PyErr_SetString(PyExc_ValueError,
+                        "key must be 32 bytes, nonce 12");
+        return nullptr;
+    }
+    PyObject* out = PyBytes_FromStringAndSize(nullptr, ptl + 16);
+    if (!out) return nullptr;
+    ccp::seal(reinterpret_cast<const uint8_t*>(key),
+              reinterpret_cast<const uint8_t*>(nonce),
+              reinterpret_cast<const uint8_t*>(aad), size_t(aadl),
+              reinterpret_cast<const uint8_t*>(pt), size_t(ptl),
+              reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
+    return out;
+}
+
+// chacha20poly1305_open(key, nonce, aad, ct_and_tag) -> plaintext
+// or None on tag mismatch.
+PyObject* chacha20poly1305_open(PyObject*, PyObject* args) {
+    const char *key, *nonce, *aad, *ct;
+    Py_ssize_t keyl, noncel, aadl, ctl;
+    if (!PyArg_ParseTuple(args, "y#y#y#y#", &key, &keyl, &nonce,
+                          &noncel, &aad, &aadl, &ct, &ctl))
+        return nullptr;
+    if (keyl != 32 || noncel != 12 || ctl < 16) {
+        PyErr_SetString(PyExc_ValueError,
+                        "key must be 32 bytes, nonce 12, ct >= 16");
+        return nullptr;
+    }
+    PyObject* out = PyBytes_FromStringAndSize(nullptr, ctl - 16);
+    if (!out) return nullptr;
+    bool ok = ccp::open(
+        reinterpret_cast<const uint8_t*>(key),
+        reinterpret_cast<const uint8_t*>(nonce),
+        reinterpret_cast<const uint8_t*>(aad), size_t(aadl),
+        reinterpret_cast<const uint8_t*>(ct), size_t(ctl),
+        reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out)));
+    if (!ok) {
+        Py_DECREF(out);
+        Py_RETURN_NONE;
+    }
+    return out;
+}
+
 PyMethodDef kMethods[] = {
+    {"chacha20poly1305_seal", chacha20poly1305_seal, METH_VARARGS,
+     "RFC 8439 AEAD seal: (key, nonce, aad, pt) -> ct||tag"},
+    {"chacha20poly1305_open", chacha20poly1305_open, METH_VARARGS,
+     "RFC 8439 AEAD open: (key, nonce, aad, ct||tag) -> pt | None"},
     {"merkle_root", merkle_root, METH_O,
      "RFC-6962/CometBFT merkle root of a sequence of bytes"},
     {"leaf_hashes", leaf_hashes, METH_O,

@@ -7,6 +7,9 @@
 #if defined(__x86_64__) || defined(_M_X64)
 #define COMETBFT_SHA_NI_POSSIBLE 1
 #include <immintrin.h>
+#if defined(__GNUC__)
+#include <cpuid.h>
+#endif
 
 namespace sha256ni {
 
@@ -144,8 +147,22 @@ inline void compress(uint32_t state[8], const uint8_t* data) {
 }
 
 inline bool supported() {
+#if defined(__GNUC__) && !defined(__clang__) && __GNUC__ < 11
+    // GCC 10's __builtin_cpu_supports rejects the "sha" feature
+    // string at compile time (added in GCC 11) — the whole native
+    // build died on it.  Probe cpuid directly instead.
+    unsigned eax, ebx, ecx, edx;
+    if (!__get_cpuid_count(7, 0, &eax, &ebx, &ecx, &edx))
+        return false;
+    const bool sha = (ebx >> 29) & 1u;          // leaf 7.0 EBX[29]
+    if (!__get_cpuid(1, &eax, &ebx, &ecx, &edx))
+        return false;
+    const bool sse41 = (ecx >> 19) & 1u;        // leaf 1 ECX[19]
+    return sha && sse41;
+#else
     return __builtin_cpu_supports("sha") &&
            __builtin_cpu_supports("sse4.1");
+#endif
 }
 
 }  // namespace sha256ni

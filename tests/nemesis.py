@@ -1,0 +1,393 @@
+"""Deterministic seeded nemesis: fault schedules against in-proc testnets.
+
+The runner composes fault schedules — asymmetric partitions (directed
+link cuts), heal, hard node crash/restart, and seeded link faults
+(drop / delay / reorder / duplicate via p2p.fuzz.FuzzedConnection) —
+against an in-process validator net built on real sockets (the same
+substrate as tests/test_perturbations.py), then asserts the two
+properties that define BFT consensus:
+
+  * safety   — no two honest nodes ever commit conflicting blocks at
+               the same height (checked over the FULL chain history;
+               block stores are append-only, so a violation at any
+               point survives to the final check);
+  * liveness — after every fault heals, the chain commits
+               ``recovery_blocks`` more blocks within a bounded time.
+
+Determinism: the fault schedule is a literal list of steps; every
+random choice (link-fuzz schedules, validator keys) derives from the
+scenario seed.  asyncio interleaving is not bit-reproducible, but the
+*injected* fault pattern is.
+
+Link faults ride the Switch.conn_wrapper seam: each node wraps every
+authenticated connection in (optionally) a FuzzedConnection and a
+_NemesisConn that drops outbound frames on blocked directed links —
+so "A cannot reach B" composes with "B can still reach A".
+"""
+from __future__ import annotations
+
+import asyncio
+import random
+from dataclasses import dataclass
+from typing import Optional
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import DEFAULT_LANES, KVStoreApplication
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.config import test_config as _test_config
+from cometbft_tpu.consensus.reactor import ConsensusReactor
+from cometbft_tpu.consensus.state import ConsensusState
+from cometbft_tpu.crypto import ed25519
+from cometbft_tpu.db import MemDB
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
+from cometbft_tpu.p2p.key import NodeKey
+from cometbft_tpu.p2p.switch import Switch
+from cometbft_tpu.state import make_genesis_state
+from cometbft_tpu.state.execution import BlockExecutor
+from cometbft_tpu.state.store import Store
+from cometbft_tpu.store import BlockStore
+from cometbft_tpu.types.genesis import GenesisDoc, GenesisValidator
+from cometbft_tpu.types.priv_validator import MockPV
+from cometbft_tpu.types.timestamp import Timestamp
+
+
+class LinkTable:
+    """Directed link cuts shared by every node of one net."""
+
+    def __init__(self):
+        self.blocked: set[tuple[int, int]] = set()   # (src, dst) idx
+        self.dropped = 0
+
+    def block(self, src: int, dst: int) -> None:
+        self.blocked.add((src, dst))
+
+    def heal(self) -> None:
+        self.blocked.clear()
+
+    def is_blocked(self, src: int, dst: int) -> bool:
+        return (src, dst) in self.blocked
+
+
+class _NemesisConn:
+    """Write-side frame drop on blocked directed links, slotted under
+    the MConnection (reads always pass: blocking A→B must not stop
+    B→A)."""
+
+    def __init__(self, conn, table: LinkTable, src: int, dst: int):
+        self._conn = conn
+        self._table = table
+        self._src = src
+        self._dst = dst
+
+    async def write_msg(self, data: bytes) -> None:
+        if self._table.is_blocked(self._src, self._dst):
+            self._table.dropped += 1
+            return
+        await self._conn.write_msg(data)
+
+    async def read_msg(self) -> bytes:
+        return await self._conn.read_msg()
+
+    def close(self) -> None:
+        self._conn.close()
+
+    def __getattr__(self, name):
+        return getattr(self._conn, name)
+
+
+class NemesisNode:
+    """A validator whose consensus+p2p can be hard-killed and
+    restarted on its durable stores, with every link wrapped in the
+    net's fault injectors."""
+
+    def __init__(self, net: "NemesisNet", idx: int, doc: GenesisDoc,
+                 pv: MockPV, node_key: NodeKey):
+        self.net = net
+        self.idx = idx
+        self.doc = doc
+        self.pv = pv
+        self.node_key = node_key
+        self.app = KVStoreApplication()
+        self.conns = AppConns(self.app)
+        self.state_store = Store(MemDB())
+        self.block_store = BlockStore(MemDB())
+        self.state_store.save(make_genesis_state(doc))
+        self.cs: Optional[ConsensusState] = None
+        self.switch: Optional[Switch] = None
+        self.mempool: Optional[CListMempool] = None
+        self.running = False
+
+    async def start(self) -> None:
+        state = self.state_store.load()
+        self.mempool = CListMempool(
+            MempoolConfig(), self.conns.mempool, lanes=DEFAULT_LANES,
+            default_lane="default", height=state.last_block_height)
+        ex = BlockExecutor(self.state_store, self.conns.consensus,
+                           mempool=self.mempool,
+                           block_store=self.block_store)
+        self.cs = ConsensusState(
+            _test_config().consensus, state, ex, self.block_store,
+            priv_validator=self.pv)
+        self.switch = Switch(self.node_key, self.doc.chain_id,
+                             listen_addr="127.0.0.1:0")
+        self.switch.conn_wrapper = self._wrap_conn
+        self.switch.add_reactor(ConsensusReactor(self.cs))
+        await self.switch.start()
+        await self.cs.start()
+        self.running = True
+
+    def _wrap_conn(self, sconn, their_id: str, outbound: bool):
+        dst = self.net.idx_of(their_id)
+        conn = sconn
+        fuzz = self.net.fuzz_config(self.idx, dst)
+        if fuzz is not None:
+            conn = FuzzedConnection(conn, fuzz)
+            self.net.fuzzed_conns.append(conn)
+        return _NemesisConn(conn, self.net.links, self.idx, dst)
+
+    async def crash(self) -> None:
+        """Hard stop: no flush, no goodbye (in-proc analog of docker
+        kill; the stores survive)."""
+        await self.cs.stop()
+        await self.switch.stop()
+        self.running = False
+
+    @property
+    def height(self) -> int:
+        return self.block_store.height
+
+
+class NemesisNet:
+    def __init__(self, n: int = 4, seed: int = 0,
+                 fuzz_profile: Optional[dict] = None):
+        self.seed = seed
+        self.rng = random.Random(seed)
+        self.links = LinkTable()
+        self.fuzz_profile = fuzz_profile
+        self.fuzzed_conns: list[FuzzedConnection] = []
+        # every random artifact (keys included) derives from the seed
+        pvs = [MockPV(ed25519.Ed25519PrivKey(
+            self.rng.getrandbits(256).to_bytes(32, "big")))
+            for _ in range(n)]
+        doc = GenesisDoc(
+            chain_id=f"nemesis-{seed}",
+            genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(
+                address=b"", pub_key=pv.get_pub_key(), power=10)
+                for pv in pvs])
+        keys = [NodeKey.generate() for _ in range(n)]
+        self._id_to_idx = {k.id: i for i, k in enumerate(keys)}
+        self.nodes = [NemesisNode(self, i, doc, pvs[i], keys[i])
+                      for i in range(n)]
+        self._load_task: Optional[asyncio.Task] = None
+        self._load_stop = asyncio.Event()
+        self._tx_seq = 0
+
+    # ------------------------------------------------------------------
+    def idx_of(self, node_id: str) -> int:
+        return self._id_to_idx.get(node_id, -1)
+
+    def fuzz_config(self, src: int, dst: int) -> Optional[FuzzConfig]:
+        if self.fuzz_profile is None:
+            return None
+        # deterministic per ordered link, derived from the net seed
+        link_seed = self.seed * 1_000_003 + src * 101 + dst * 13 + 1
+        return FuzzConfig(seed=link_seed, **self.fuzz_profile)
+
+    # ------------------------------------------------------------------
+    async def start(self) -> None:
+        for node in self.nodes:
+            await node.start()
+        await self.connect_full_mesh()
+        self._load_task = asyncio.ensure_future(self._load())
+
+    async def stop(self) -> None:
+        self._load_stop.set()
+        if self._load_task is not None:
+            self._load_task.cancel()
+        for node in self.nodes:
+            if node.running:
+                await node.crash()
+
+    async def connect_full_mesh(self) -> None:
+        alive = [n for n in self.nodes if n.running]
+        for i, node in enumerate(alive):
+            for other in alive[i + 1:]:
+                if any(p.id == other.node_key.id
+                       for p in node.switch.peers.values()):
+                    continue
+                try:
+                    # bounded: a saturated peer must not wedge the
+                    # waiter inside an unbounded handshake read
+                    await asyncio.wait_for(node.switch.dial_peer(
+                        other.switch.listen_addr), 5.0)
+                except Exception:
+                    pass   # retried by the next mesh pass
+
+    async def _load(self) -> None:
+        """Background tx injection (reference: runner/load.go)."""
+        while not self._load_stop.is_set():
+            for n in self.nodes:
+                if n.running and n.mempool is not None:
+                    try:
+                        await n.mempool.check_tx(
+                            f"load{self._tx_seq}=v".encode())
+                    except Exception:
+                        pass
+                self._tx_seq += 1
+            await asyncio.sleep(0.02)
+
+    async def reset_all_links(self) -> None:
+        """Drop every connection (fresh PeerState on both sides) and
+        re-mesh — the runner's model of 'the faulty links were
+        replaced'."""
+        for node in self.nodes:
+            if node.running and node.switch is not None:
+                for peer in list(node.switch.peers.values()):
+                    await node.switch.stop_peer(
+                        peer, "nemesis: link replaced")
+        await self.connect_full_mesh()
+
+    async def heal_links(self) -> None:
+        """Unblock every link AND reset the connections that carried a
+        blocked direction.  On real TCP a one-way cut ends in
+        backpressure → keepalive timeout → reconnect, which resets the
+        peers' delivery bookkeeping; frames silently dropped by the
+        nemesis wrapper were marked delivered by the gossip routines,
+        so the reconnect (fresh PeerState) is part of the fault model,
+        not a cheat."""
+        pairs = set(self.links.blocked)
+        self.links.heal()
+        reset: set[tuple[int, int]] = set()
+        for s, d in pairs:
+            reset.add((min(s, d), max(s, d)))
+        for a, b in reset:
+            for src, dst in ((a, b), (b, a)):
+                node = self.nodes[src]
+                if node.running and node.switch is not None:
+                    peer = node.switch.peers.get(
+                        self.nodes[dst].node_key.id)
+                    if peer is not None:
+                        await node.switch.stop_peer(
+                            peer, "nemesis heal: link reset")
+        await self.connect_full_mesh()
+
+    # ------------------------------------------------------------------
+    def max_height(self) -> int:
+        return max(n.height for n in self.nodes)
+
+    async def wait_all_height(self, h: int, timeout: float,
+                              nodes: Optional[list] = None) -> None:
+        """All (running) target nodes reach height h; the mesh is
+        re-dialed periodically since fault injection can kill
+        connections."""
+        loop = asyncio.get_running_loop()
+        deadline = loop.time() + timeout
+        last_mesh = 0.0
+        while True:
+            targets = [n for n in (nodes or self.nodes) if n.running]
+            if targets and all(n.height >= h for n in targets):
+                return
+            if loop.time() > deadline:
+                raise AssertionError(
+                    f"liveness: heights "
+                    f"{[n.height for n in self.nodes]} never reached "
+                    f"{h} within {timeout}s")
+            if loop.time() - last_mesh > 0.5:
+                await self.connect_full_mesh()
+                last_mesh = loop.time()
+            await asyncio.sleep(0.05)
+
+    def assert_no_conflicting_commits(self) -> None:
+        """Safety: at every height, every node that committed a block
+        committed the SAME block."""
+        for h in range(1, self.max_height() + 1):
+            seen: dict[bytes, list[int]] = {}
+            for n in self.nodes:
+                b = n.block_store.load_block(h)
+                if b is not None:
+                    seen.setdefault(b.hash(), []).append(n.idx)
+            assert len(seen) <= 1, (
+                f"SAFETY VIOLATION: conflicting commits at height "
+                f"{h}: {{{', '.join(h_.hex()[:12] + ': ' + str(i) for h_, i in seen.items())}}}")
+
+    # ------------------------------------------------------------------
+    async def apply(self, step: tuple) -> None:
+        kind, *args = step
+        if kind == "wait_blocks":
+            target = self.max_height() + args[0]
+            await self.wait_all_height(target, timeout=60.0)
+        elif kind == "partition":
+            srcs, dsts = args
+            for s in srcs:
+                for d in dsts:
+                    self.links.block(s, d)
+        elif kind == "heal":
+            await self.heal_links()
+        elif kind == "crash":
+            await self.nodes[args[0]].crash()
+        elif kind == "restart":
+            await self.nodes[args[0]].start()
+            await self.connect_full_mesh()
+        elif kind == "sleep":
+            await asyncio.sleep(args[0])
+        elif kind == "expect_stall":
+            window_s, slack = args
+            h0 = self.max_height()
+            await asyncio.sleep(window_s)
+            h1 = self.max_height()
+            assert h1 <= h0 + slack, (
+                f"expected a stall but the chain advanced "
+                f"{h1 - h0} blocks in {window_s}s")
+        elif kind == "expect_progress":
+            # some subset must keep committing despite the fault
+            idxs, blocks, timeout = args
+            subset = [self.nodes[i] for i in idxs]
+            target = max(n.height for n in subset) + blocks
+            await self.wait_all_height(target, timeout, nodes=subset)
+        else:
+            raise ValueError(f"unknown nemesis step {kind!r}")
+
+
+@dataclass(frozen=True)
+class Scenario:
+    """A named, seeded fault schedule.  After the steps run, the
+    runner force-heals everything (links, crashed nodes), re-meshes,
+    and asserts bounded-time recovery + full-history safety."""
+    name: str
+    seed: int = 0
+    n: int = 4
+    fuzz: Optional[dict] = None     # FuzzConfig kwargs for every link
+    steps: tuple = ()
+    recovery_blocks: int = 3
+    recovery_timeout_s: float = 90.0
+
+
+async def run_scenario(s: Scenario) -> NemesisNet:
+    net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz)
+    await net.start()
+    try:
+        for step in s.steps:
+            await net.apply(step)
+        # quiesce the load so the (single-core) recovery check
+        # measures consensus catchup, not tx-throughput contention
+        net._load_stop.set()
+        # heal the world, then require recovery
+        await net.heal_links()
+        if s.fuzz is not None:
+            # link noise "heals" too: new connections are clean, and
+            # the old (noise-poisoned) ones are replaced
+            net.fuzz_profile = None
+            await net.reset_all_links()
+        for node in net.nodes:
+            if not node.running:
+                await node.start()
+        await net.connect_full_mesh()
+        h0 = net.max_height()
+        await net.wait_all_height(h0 + s.recovery_blocks,
+                                  s.recovery_timeout_s)
+        net.assert_no_conflicting_commits()
+    finally:
+        await net.stop()
+    return net

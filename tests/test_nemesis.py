@@ -1,0 +1,143 @@
+"""Nemesis scenarios: deterministic seeded fault schedules against
+in-proc testnets (runner: tests/nemesis.py).
+
+Every scenario asserts BOTH properties:
+  * safety  — no two honest nodes commit conflicting blocks at any
+              height (full-history check);
+  * liveness — the chain commits `recovery_blocks` more blocks within
+              a bounded time after the faults heal.
+
+The default (not-slow) tier keeps three fast scenarios; the longer
+partition sweeps are `slow`.
+"""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+
+from nemesis import Scenario, run_scenario
+
+pytestmark = pytest.mark.nemesis
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class TestNemesisScenarios:
+    def test_asymmetric_partition_stalls_then_heals(self):
+        """One-way 2|2 cut: {0,1} frames never reach {2,3}, the
+        reverse direction stays up.  Neither side can assemble a
+        quorum (votes flow one way only), so the chain must STALL —
+        committing through an asymmetric half-cut would be a safety
+        smell — and after heal the vote-catchup gossip must revive
+        the wedged round within the recovery budget."""
+        run(run_scenario(Scenario(
+            name="asym-partition-2x2",
+            seed=7,
+            steps=(
+                ("wait_blocks", 2),
+                ("partition", (0, 1), (2, 3)),
+                ("expect_stall", 1.5, 1),
+                ("heal",),
+            ),
+            recovery_blocks=3)))
+
+    def test_crash_restart_mid_height(self):
+        """Hard-kill a validator mid-height; the 3/4 supermajority
+        keeps committing; the crashed node restarts on its durable
+        stores and converges onto the same chain."""
+        run(run_scenario(Scenario(
+            name="crash-restart",
+            seed=11,
+            steps=(
+                ("wait_blocks", 2),
+                ("crash", 3),
+                ("expect_progress", (0, 1, 2), 3, 60.0),
+                ("restart", 3),
+            ),
+            recovery_blocks=2)))
+
+    def test_reorder_duplicate_drop_links(self):
+        """Every link reorders, duplicates, delays, and drops frames
+        (seeded); the stack must keep committing through the noise
+        and the histories must agree."""
+        run(run_scenario(Scenario(
+            name="faulty-links",
+            seed=23,
+            fuzz=dict(prob_reorder=0.05, prob_duplicate=0.05,
+                      prob_drop_write=0.02, prob_delay=0.05,
+                      max_delay_s=0.02),
+            steps=(
+                ("wait_blocks", 4),
+            ),
+            recovery_blocks=2)))
+
+    def test_mute_validator_routes_around(self):
+        """Asymmetric single-node mute: node 3's frames reach nobody,
+        but it still hears the net.  The other three form a quorum and
+        progress must CONTINUE during the fault (gossip routes around
+        the mute), and node 3 still follows the chain passively."""
+        run(run_scenario(Scenario(
+            name="mute-one",
+            seed=13,
+            steps=(
+                ("wait_blocks", 2),
+                ("partition", (3,), (0, 1, 2)),
+                ("expect_progress", (0, 1, 2), 3, 60.0),
+                ("heal",),
+            ),
+            recovery_blocks=2)))
+
+
+@pytest.mark.slow
+class TestNemesisSweeps:
+    def test_partition_sweep_seeded(self):
+        """Sweep cut patterns x seeds: every asymmetric cut must heal
+        into a safe, live chain."""
+        cuts = (
+            ((0,), (1, 2, 3)),          # mute one
+            ((0, 1), (2, 3)),           # half split
+            ((0, 1, 2), (3,)),          # isolate one's inbound
+        )
+        for seed in (1, 2):
+            for srcs, dsts in cuts:
+                run(run_scenario(Scenario(
+                    name=f"sweep-{srcs}-{dsts}-s{seed}",
+                    seed=seed,
+                    steps=(
+                        ("wait_blocks", 2),
+                        ("partition", srcs, dsts),
+                        ("sleep", 1.0),
+                        ("heal",),
+                    ),
+                    recovery_blocks=3)))
+
+    def test_compound_fuzz_plus_crash(self):
+        """Compose link noise with a crash/restart — the schedules
+        must not mask each other."""
+        run(run_scenario(Scenario(
+            name="fuzz+crash",
+            seed=29,
+            fuzz=dict(prob_reorder=0.03, prob_duplicate=0.03,
+                      prob_drop_write=0.01),
+            steps=(
+                ("wait_blocks", 2),
+                ("crash", 1),
+                ("expect_progress", (0, 2, 3), 2, 60.0),
+                ("restart", 1),
+                ("wait_blocks", 2),
+            ),
+            recovery_blocks=2)))

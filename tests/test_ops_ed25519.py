@@ -64,6 +64,8 @@ class TestFieldOps:
 
 
 class TestVerifyKernel:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     def test_valid_and_corrupted(self):
         items = [_sig() for _ in range(4)]
         pub, msg, sig = items[0]
@@ -161,6 +163,8 @@ class TestBatchVerifierDispatch:
 
 
 class TestShardedTally:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     def test_verify_tally_over_mesh(self):
         import jax
         from cometbft_tpu.parallel import mesh as pmesh
@@ -203,6 +207,8 @@ def _pallas_verify_items(items, block=8, kernel="pallas"):
 
 
 class TestPallasKernel:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     """Interpret-mode parity of the fused Mosaic kernel
     (ops/ed25519_pallas.py) against the ZIP-215 golden model — the
     same semantics the XLA-kernel suite above pins down
@@ -284,6 +290,8 @@ class TestPallasKernel:
 
 
 class TestMultiChipDispatch:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     def test_verify_batch_auto_shards_with_mixed_lanes(
             self, monkeypatch):
         """The PRODUCTION dispatch (verify_batch -> _dispatch) must
@@ -335,6 +343,8 @@ class TestAOTArtifacts:
 
 
 class TestPallasMultiBlock:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     def test_grid_of_two_blocks(self):
         """A batch spanning two grid steps (n=16, block=8) must
         produce the same per-lane verdicts — exercises the BlockSpec
@@ -352,6 +362,8 @@ class TestPallasMultiBlock:
 
 
 class TestPallas8Fallback:
+    pytestmark = pytest.mark.slow  # cold kernel compile (60-270s on 1 CPU)
+
     """The first-generation 32x8-bit kernel stays correct behind
     COMETBFT_TPU_KERNEL=pallas8 (one smoke case; its full parity
     history is r3's suite — the 24-limb kernel above inherits it)."""
