@@ -128,6 +128,45 @@ class DeadlineClient:
         return bounded
 
 
+class TracingClient:
+    """Flight-recorder span per ABCI call (libs/tracing.py category
+    "abci", name "<conn>/<method>") — the execute slice of the
+    per-height trace timeline.  Transparent like DeadlineClient;
+    near-zero overhead when tracing is disabled."""
+
+    def __init__(self, inner, conn_name: str):
+        object.__setattr__(self, "_inner", inner)
+        object.__setattr__(self, "_conn_name", conn_name)
+
+    def __getattr__(self, name):
+        attr = getattr(self._inner, name)
+        if name.startswith("_") or not callable(attr) or \
+                not asyncio.iscoroutinefunction(attr):
+            return attr
+        from ..libs import tracing
+        label = f"{self._conn_name}/{name}"
+
+        async def traced(*a, **kw):
+            with tracing.span(tracing.ABCI, label):
+                return await attr(*a, **kw)
+
+        # cache so the hot path (every CheckTx) never re-enters
+        # __getattr__ for this method again
+        object.__setattr__(self, name, traced)
+        return traced
+
+
+def apply_tracing(app_conns) -> None:
+    """Wrap the four named connections with flight-recorder spans
+    (all transports — a builtin app's FinalizeBlock time is exactly
+    what the per-height breakdown needs to attribute)."""
+    for conn in ("consensus", "mempool", "query", "snapshot"):
+        inner = getattr(app_conns, conn, None)
+        if inner is not None and not isinstance(inner, TracingClient):
+            setattr(app_conns, conn, TracingClient(inner, conn))
+    return app_conns
+
+
 def apply_deadlines(app_conns, default_timeout_s: float,
                     retries: int = 2) -> None:
     """Wrap the four named connections with per-call deadlines

@@ -174,8 +174,10 @@ class BlocksyncReactor(Reactor):
             return
         peer = self.switch.peers.get(peer_id)
         if peer is not None:
-            asyncio.get_event_loop().create_task(
-                self.switch.stop_peer(peer, reason))
+            # supervised one-shot teardown (AST-checked invariant)
+            self.supervisor.spawn(
+                lambda: self.switch.stop_peer(peer, reason),
+                name=f"stop_peer:{peer_id[:12]}", kind="stop_peer")
 
     # ------------------------------------------------------------------
     async def _status_routine(self) -> None:

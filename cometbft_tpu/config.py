@@ -42,6 +42,10 @@ class BaseConfig:
     # errors up to abci_call_retries times.
     abci_call_timeout_ns: int = 20 * _S
     abci_call_retries: int = 2
+    # LRU cap for the shared sig -> (addr, sign_bytes) verification
+    # cache (types/signature_cache.py); unbounded growth under
+    # sustained traffic was the alternative
+    signature_cache_size: int = 10_000
 
     def path(self, rel: str) -> str:
         return rel if os.path.isabs(rel) else os.path.join(self.home, rel)
@@ -177,6 +181,13 @@ class InstrumentationConfig:
     prometheus_listen_addr: str = ":26660"
     pprof_listen_addr: str = ""
     namespace: str = "cometbft"
+    # flight recorder (libs/tracing.py): always-on ring-buffer span
+    # tracing, dumped on supervisor give-up / nemesis safety failures
+    # and served at the /trace RPC.  trace_categories is a comma list
+    # ("consensus,crypto,..."); empty enables every category.
+    trace_enabled: bool = True
+    trace_buffer_size: int = 4096
+    trace_categories: str = ""
 
 
 @dataclass
@@ -263,6 +274,12 @@ def validate_basic(cfg: Config) -> None:
         raise ConfigError(
             "instrumentation.prometheus_listen_addr required when "
             "prometheus enabled")
+    if cfg.instrumentation.trace_buffer_size <= 0:
+        raise ConfigError(
+            "instrumentation.trace_buffer_size must be positive")
+    if cfg.base.signature_cache_size <= 0:
+        raise ConfigError(
+            "base.signature_cache_size must be positive")
 
 
 def default_config() -> Config:

@@ -8,10 +8,10 @@ parts), gossipVotesRoutine (:654), queryMaj23Routine (:718).
 from __future__ import annotations
 
 import asyncio
-import time
 from dataclasses import dataclass, field
 from typing import Optional
 
+from ..libs import tracing
 from ..libs.bits import BitArray
 from ..libs.log import Logger, new_logger
 from ..libs.supervisor import RestartPolicy
@@ -248,10 +248,13 @@ class ConsensusReactor(Reactor):
 
         def _stop_peer_on_giveup(st, exc):
             # restart budget exhausted: the peer is poison — drop it
-            # (the pre-supervisor behavior, now after bounded retries)
+            # (the pre-supervisor behavior, now after bounded retries);
+            # the one-shot teardown is itself supervised so a crash in
+            # stop_peer is metered, never silent
             if self.switch is not None:
-                asyncio.get_event_loop().create_task(
-                    self.switch.stop_peer(peer, repr(exc)))
+                sup.spawn(lambda: self.switch.stop_peer(
+                    peer, repr(exc)), name=f"stop_peer:{pid}",
+                    kind="stop_peer")
 
         policy = _GOSSIP_RESTART_POLICY
         self._gossip_tasks[peer.id] = [
@@ -342,12 +345,19 @@ class ConsensusReactor(Reactor):
             elif isinstance(msg, BlockPartMessage):
                 ps.set_has_proposal_block_part(msg.height, msg.round,
                                                msg.part.index)
+                tracing.instant(tracing.CONSENSUS, "block_part_recv",
+                                height=msg.height,
+                                index=msg.part.index,
+                                peer=peer.id[:12])
                 self.cs.send_peer(msg, peer.id)
         elif chan_id == VOTE_CHANNEL:
             if isinstance(msg, VoteMessage):
                 v = msg.vote
                 ps.set_has_vote(v.height, v.round, v.type,
                                 v.validator_index)
+                tracing.instant(tracing.CONSENSUS, "vote_recv",
+                                height=v.height, round=v.round,
+                                type=v.type, peer=peer.id[:12])
                 self.cs.send_peer(msg, peer.id)
         elif chan_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage) and \
@@ -405,10 +415,13 @@ class ConsensusReactor(Reactor):
 
     def _new_round_step_msg(self) -> NewRoundStepMessage:
         rs = self.cs.rs
+        # monotonic interval (clock-discipline): wall time here broke
+        # under wall-clock steps; start_time stays wall only because
+        # it derives from protocol timestamps
         return NewRoundStepMessage(
             height=rs.height, round=rs.round, step=rs.step,
             seconds_since_start_time=max(
-                0, int(time.time()) - rs.start_time.seconds),
+                0, self.cs.seconds_since_start()),
             last_commit_round=rs.last_commit.round
             if rs.last_commit is not None else -1)
 

@@ -12,10 +12,12 @@ invariant holds (only that task mutates RoundState).
 from __future__ import annotations
 
 import asyncio
+import time
 from typing import Callable, Optional
 
 from ..config import ConsensusConfig
 from ..libs import fail
+from ..libs import tracing
 from ..libs.log import Logger, new_logger
 from ..state.execution import BlockExecutor
 from ..state.state import State as SMState
@@ -39,9 +41,9 @@ from .messages import (
     BlockPartMessage, ProposalMessage, VoteMessage,
 )
 from .round_state import (
-    STEP_COMMIT, STEP_NEW_HEIGHT, STEP_NEW_ROUND, STEP_PRECOMMIT,
-    STEP_PRECOMMIT_WAIT, STEP_PREVOTE, STEP_PREVOTE_WAIT, STEP_PROPOSE,
-    RoundState, TimeoutInfo,
+    STEP_COMMIT, STEP_NAMES, STEP_NEW_HEIGHT, STEP_NEW_ROUND,
+    STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT, STEP_PREVOTE,
+    STEP_PREVOTE_WAIT, STEP_PROPOSE, RoundState, TimeoutInfo,
 )
 from .ticker import TimeoutTicker
 from .wal import WAL, NilWAL
@@ -98,6 +100,13 @@ class ConsensusState:
         self._stopped = asyncio.Event()
         self.n_steps = 0
         self.replay_mode = False
+        # flight recorder: (height, round, step, t0_ns) of the step in
+        # progress — closed into a span when the next step begins
+        self._trace_step: Optional[tuple] = None
+        # monotonic anchor for rs.start_time (wall): interval math on
+        # it (reactor's seconds_since_start_time) must survive
+        # wall-clock steps
+        self._start_time_mono = time.monotonic()
 
         # hooks for the reactor / tests: called after state transitions
         self.on_new_step: list[Callable[[RoundState], None]] = []
@@ -117,17 +126,22 @@ class ConsensusState:
 
     async def start(self) -> None:
         self._stopped.clear()
-        if self.supervisor is not None:
-            from ..libs.supervisor import RestartPolicy
-            self._task = self.supervisor.spawn(
-                lambda: self._receive_routine(),
-                name="consensus_receive", kind="consensus_receive",
-                policy=RestartPolicy(max_restarts=3, window_s=60.0,
-                                     backoff_base_s=0.05,
-                                     backoff_max_s=1.0))
-        else:
-            self._task = asyncio.get_running_loop().create_task(
-                self._receive_routine())
+        if self.supervisor is None:
+            # standalone (tests / light wiring): the receive routine
+            # still runs supervisor-owned — a bare create_task would
+            # die silently on the first uncaught exception, and the
+            # tier-1 AST check (tests/test_supervised_tasks_ast.py)
+            # locks that invariant for all reactor/node loops
+            from ..libs.supervisor import Supervisor
+            self.supervisor = Supervisor("consensus",
+                                         logger=self.logger)
+        from ..libs.supervisor import RestartPolicy
+        self._task = self.supervisor.spawn(
+            lambda: self._receive_routine(),
+            name="consensus_receive", kind="consensus_receive",
+            policy=RestartPolicy(max_restarts=3, window_s=60.0,
+                                 backoff_base_s=0.05,
+                                 backoff_max_s=1.0))
         self._schedule_round0()
 
     async def stop(self) -> None:
@@ -352,6 +366,12 @@ class ConsensusState:
             rs.start_time = Timestamp.now().add_ns(next_block_delay)
         else:
             rs.start_time = rs.commit_time.add_ns(next_block_delay)
+        # re-anchor: start_time is wall (a protocol-adjacent value);
+        # elapsed-time consumers use the monotonic twin.  The offset
+        # is SIGNED — a start_time already in the past (WAL replay,
+        # slow commit) must keep reporting real elapsed time
+        self._start_time_mono = time.monotonic() + \
+            rs.start_time.sub(Timestamp.now()) / 1e9
 
         rs.validators = validators
         rs.proposal = None
@@ -453,10 +473,38 @@ class ConsensusState:
             vs.add_vote(v)
         return vs
 
+    def seconds_since_start(self) -> int:
+        """Whole seconds since this height's (wall) start_time,
+        measured on the monotonic clock so a wall-clock step cannot
+        corrupt the interval (reactor NewRoundStep messages)."""
+        return int(time.monotonic() - self._start_time_mono)
+
+    def _trace_step_transition(self) -> None:
+        """Close the in-progress step into a flight-recorder span when
+        the (height, round, step) triple advances."""
+        rs = self.rs
+        cur = (rs.height, rs.round, rs.step)
+        prev = self._trace_step
+        if prev is not None and (prev[0], prev[1], prev[2]) == cur:
+            return                     # re-announce of the same step
+        now = tracing.now_ns()
+        if prev is not None:
+            tracing.record_span(
+                tracing.CONSENSUS,
+                f"step:{STEP_NAMES.get(prev[2], '?')}",
+                prev[3], now, height=prev[0], round=prev[1])
+        self._trace_step = (*cur, now)
+
     def _new_step(self) -> None:
         self.wal.write({"type": "round_state",
                         **self.rs.event_summary()})
         self.n_steps += 1
+        # height context first and unconditionally: other categories
+        # (crypto/p2p/abci) rely on it even when the consensus
+        # category itself is filtered out
+        tracing.set_height(self.rs.height)
+        if tracing.enabled(tracing.CONSENSUS):
+            self._trace_step_transition()
         self.event_bus.publish_new_round_step(self.rs.event_summary())
         self.metrics.mark_step(self.rs)
         for hook in self.on_new_step:
@@ -652,6 +700,9 @@ class ConsensusState:
         if rs.proposal_block_parts is None:
             rs.proposal_block_parts = PartSet(
                 proposal.block_id.part_set_header)
+        tracing.instant(tracing.CONSENSUS, "proposal_received",
+                        height=proposal.height, round=proposal.round,
+                        parts=proposal.block_id.part_set_header.total)
         self.logger.info("Received proposal", proposal=str(proposal))
 
     async def _add_proposal_block_part(self, msg: BlockPartMessage,
@@ -690,6 +741,9 @@ class ConsensusState:
         if rs.proposal_block_parts.is_complete():
             raw = rs.proposal_block_parts.assemble()
             rs.proposal_block = Block.from_proto(decode(pb.BLOCK, raw))
+            tracing.instant(tracing.CONSENSUS, "proposal_complete",
+                            height=msg.height,
+                            bytes=rs.proposal_block_parts.byte_size)
             self.logger.info(
                 "Received complete proposal block",
                 height=rs.proposal_block.header.height,
@@ -988,7 +1042,9 @@ class ConsensusState:
             raise ConsensusError("proposal parts header != commit header")
         if block.hash() != block_id.hash:
             raise ConsensusError("proposal block != commit hash")
-        self.block_exec.validate_block(self.sm_state, block)
+        with tracing.span(tracing.CONSENSUS, "validate_block",
+                          height=height):
+            self.block_exec.validate_block(self.sm_state, block)
 
         self.logger.info("Finalizing commit of block",
                          height=height,
@@ -997,18 +1053,20 @@ class ConsensusState:
 
         fail.fail()    # crash point: before block save (state.go:1872)
 
-        if self.block_store.height < block.header.height:
-            seen_ext = rs.votes.precommits(rs.commit_round) \
-                .make_extended_commit(
-                    self.sm_state.consensus_params.feature
-                    .vote_extensions_enable_height)
-            if self.sm_state.consensus_params.feature \
-                    .vote_extensions_enabled(block.header.height):
-                self.block_store.save_block_with_extended_commit(
-                    block, block_parts, seen_ext)
-            else:
-                self.block_store.save_block(block, block_parts,
-                                            seen_ext.to_commit())
+        with tracing.span(tracing.CONSENSUS, "save_block",
+                          height=height):
+            if self.block_store.height < block.header.height:
+                seen_ext = rs.votes.precommits(rs.commit_round) \
+                    .make_extended_commit(
+                        self.sm_state.consensus_params.feature
+                        .vote_extensions_enable_height)
+                if self.sm_state.consensus_params.feature \
+                        .vote_extensions_enabled(block.header.height):
+                    self.block_store.save_block_with_extended_commit(
+                        block, block_parts, seen_ext)
+                else:
+                    self.block_store.save_block(block, block_parts,
+                                                seen_ext.to_commit())
 
         fail.fail()    # crash point: block saved, WAL barrier not yet
                        # written (state.go:1889)
@@ -1024,15 +1082,21 @@ class ConsensusState:
                                    rs.validators,
                                    block_size=block_parts.byte_size)
         state_copy = self.sm_state.copy()
-        state_copy = await self.block_exec.apply_verified_block(
-            state_copy,
-            BlockID(hash=block.hash(),
-                    part_set_header=block_parts.header()),
-            block, block.header.height)
+        with tracing.span(tracing.CONSENSUS, "apply_block",
+                          height=height, num_txs=len(block.data.txs)):
+            state_copy = await self.block_exec.apply_verified_block(
+                state_copy,
+                BlockID(hash=block.hash(),
+                        part_set_header=block_parts.header()),
+                block, block.header.height)
 
         fail.fail()    # crash point: applied, consensus state not yet
                        # advanced (state.go:1933)
 
+        tracing.instant(tracing.CONSENSUS, "commit", height=height,
+                        num_txs=len(block.data.txs),
+                        round=rs.commit_round,
+                        block_bytes=block_parts.byte_size)
         self.update_to_state(state_copy)
         if self.priv_validator is not None:
             self.priv_validator_pub_key = \

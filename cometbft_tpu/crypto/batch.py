@@ -16,6 +16,7 @@ import os
 import threading
 from typing import Optional
 
+from ..libs import tracing
 from . import ed25519
 from .keys import BatchVerifier, PubKey
 
@@ -203,30 +204,62 @@ class GuardedTpuBatchVerifier(BatchVerifier):
 
     def verify(self):
         br = self._breaker
+        attempted_tpu = False
         if br.allow():
+            attempted_tpu = True
             try:
-                from ..ops.ed25519_jax import verify_batch
-                out = verify_batch([(pk.bytes(), m, s)
-                                    for pk, m, s in self._items])
+                with tracing.span(tracing.CRYPTO, "batch_verify",
+                                  batch=len(self._items),
+                                  backend="tpu"):
+                    from ..ops.ed25519_jax import verify_batch
+                    out = verify_batch([(pk.bytes(), m, s)
+                                        for pk, m, s in self._items])
             except Exception as e:  # noqa: BLE001 — fall back below
                 br.record_failure(
                     latch=not _is_transient_kernel_error(e))
             else:
                 br.record_success()
                 return out
-        cpu = ed25519.CpuBatchVerifier()
-        for pk, m, s in self._items:
-            cpu.add(pk, m, s)
-        return cpu.verify()
+        with tracing.span(tracing.CRYPTO, "batch_verify",
+                          batch=len(self._items), backend="cpu",
+                          fallback=attempted_tpu):
+            cpu = ed25519.CpuBatchVerifier()
+            for pk, m, s in self._items:
+                cpu.add(pk, m, s)
+            return cpu.verify()
+
+
+class TracedBatchVerifier(BatchVerifier):
+    """Flight-recorder span around any BatchVerifier's dispatch —
+    every batch shows up in /trace with its size and backend label."""
+
+    def __init__(self, inner: BatchVerifier, backend: str):
+        self._inner = inner
+        self._backend = backend
+
+    def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
+        self._inner.add(pub_key, msg, sig)
+
+    def __len__(self) -> int:
+        try:
+            return len(self._inner)
+        except TypeError:   # verifier without __len__ (bls)
+            return len(getattr(self._inner, "_items", ()))
+
+    def verify(self):
+        with tracing.span(tracing.CRYPTO, "batch_verify",
+                          batch=len(self), backend=self._backend):
+            return self._inner.verify()
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:
     """Reference: batch.go:10 — errors for unsupported key types."""
     if pub_key.type() == _BLS_KEY_TYPE:
         from . import bls12381
-        return bls12381.Bls12381BatchVerifier()
+        return TracedBatchVerifier(bls12381.Bls12381BatchVerifier(),
+                                   "bls_native")
     if pub_key.type() != ed25519.KEY_TYPE:
         raise ValueError(f"batch verification unsupported for {pub_key.type()}")
     if get_backend() == "tpu":
-        return GuardedTpuBatchVerifier()
-    return ed25519.CpuBatchVerifier()
+        return GuardedTpuBatchVerifier()   # traces internally
+    return TracedBatchVerifier(ed25519.CpuBatchVerifier(), "cpu")

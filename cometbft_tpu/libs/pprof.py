@@ -103,12 +103,28 @@ async def _profile_dump(seconds: float) -> str:
     return buf.getvalue()
 
 
+def _trace_dump(write_file: bool) -> str:
+    """Flight-recorder dump hook (libs/tracing.py): the whole span
+    timeline as JSON; ?dump=1 also writes a flight-record file to the
+    configured dump dir and reports its path."""
+    import json as _json
+
+    from . import tracing
+    out = {"enabled": tracing.enabled(),
+           "events": tracing.snapshot()}
+    if write_file:
+        out["dump_path"] = tracing.dump(reason="pprof_request")
+    return _json.dumps(out, indent=1) + "\n"
+
+
 _INDEX = """pprof endpoints (asyncio runtime):
 /debug/pprof/tasks     asyncio task dump (goroutine analog)
 /debug/pprof/threads   OS thread stacks
 /debug/pprof/heap      tracemalloc allocations (?start=1 begins
                          recording, ?stop=1 stops)
 /debug/pprof/profile   CPU profile, ?seconds=N (default 5)
+/debug/pprof/trace     flight-recorder timeline (?dump=1 writes a
+                         flight-record file too)
 """
 
 
@@ -159,6 +175,8 @@ class PprofServer:
             elif path == "/debug/pprof/heap":
                 body = _heap_dump(params.get("start") == "1",
                                   params.get("stop") == "1")
+            elif path == "/debug/pprof/trace":
+                body = _trace_dump(params.get("dump") == "1")
             elif path == "/debug/pprof/profile":
                 try:
                     seconds = float(params.get("seconds", "5"))

@@ -222,6 +222,7 @@ class Supervisor:
                             "supervised loop abandoned: restart "
                             "budget exhausted", task=st.name,
                             restarts=st.restarts, err=repr(e))
+                        self._dump_flight_record(st, e)
                         self._notify(on_giveup, st, e)
                         return
                     st.restarts += 1
@@ -246,6 +247,27 @@ class Supervisor:
                 self._tasks.remove(st)
             except ValueError:
                 pass
+
+    def _dump_flight_record(self, st: SupervisedTask,
+                            exc: BaseException) -> None:
+        """A give-up is the node's 'black box moment': dump the flight
+        recorder (libs/tracing.py) so the timeline leading into the
+        crash loop survives.  Never lets a dump failure mask the
+        give-up itself."""
+        try:
+            from . import tracing
+            tracing.instant(tracing.SUPERVISOR, "giveup",
+                            supervisor=self.name, task=st.name,
+                            err=repr(exc)[:200])
+            path = tracing.dump(
+                reason=f"supervisor_giveup_{self.name}_{st.kind}",
+                extra={"supervisor": self.name, "task": st.name,
+                       "kind": st.kind, "restarts": st.restarts,
+                       "error": repr(exc)})
+            if path:
+                self.logger.error("flight record dumped", path=path)
+        except Exception:  # noqa: BLE001 — best-effort black box
+            pass
 
     def _notify(self, cb: Optional[Callable], st: SupervisedTask,
                 exc: BaseException) -> None:

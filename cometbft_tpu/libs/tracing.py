@@ -1,0 +1,320 @@
+"""Flight recorder: node-wide span tracing with crash-dump timelines.
+
+Always-on, near-zero-overhead attribution of where a height's
+wall-clock goes.  Monotonic-clock spans and instant events are written
+to fixed-size per-category ring buffers (the flight recorder) — no
+I/O, no allocation beyond one tuple per event, bounded memory.  The
+committee-based-consensus measurement line of work (PAPERS.md) showed
+per-step latency attribution is what separates signature cost from
+gossip/tally cost; this module bakes that attribution into the node so
+every later perf PR is judged against the same timeline.
+
+Readers:
+  * the ``/trace`` JSON-RPC endpoint (rpc/core.py) — live timeline,
+    filterable by height/category;
+  * ``/debug/pprof/trace`` on the pprof listener (libs/pprof.py);
+  * automatic crash dumps: the supervisor give-up path and the nemesis
+    safety-assertion failure both call :func:`dump`, leaving a JSON
+    flight record next to the node's data (the black box);
+  * ``tools/trace_report.py`` — per-height gossip/verify/execute/commit
+    breakdown rendered from a dump.
+
+Disabled mode compiles to a no-op: ``span()`` returns a shared inert
+context manager and ``instant()`` returns immediately — the benchmark
+guard in tests/test_tracing.py holds the disabled path under 1µs per
+call.  Category enables and the ring size come from
+``instrumentation.trace_*`` (config.py), wired by the node.
+
+Events are tuples ``(ts_ns, dur_ns, name, height, attrs)`` on a
+``deque(maxlen=size)`` per category; ``time.monotonic_ns()`` is the
+only clock, so timelines are immune to wall-clock steps and strictly
+ordered within a process.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Optional
+
+# canonical categories (free-form strings are accepted; these are the
+# ones the node emits and the report understands)
+CONSENSUS = "consensus"
+CRYPTO = "crypto"
+P2P = "p2p"
+MEMPOOL = "mempool"
+ABCI = "abci"
+SUPERVISOR = "supervisor"
+NEMESIS = "nemesis"
+
+CATEGORIES = (CONSENSUS, CRYPTO, P2P, MEMPOOL, ABCI, SUPERVISOR,
+              NEMESIS)
+
+now_ns = time.monotonic_ns
+
+
+class Recorder:
+    """Per-category ring buffers + dump machinery.
+
+    The module-global instance behind :func:`span`/:func:`instant` is
+    what the node wires; tests may construct private recorders."""
+
+    def __init__(self, buffer_size: int = 4096, enabled: bool = True,
+                 categories: Optional[str] = None,
+                 dump_dir: str = "."):
+        self.buffer_size = max(1, int(buffer_size))
+        self.enabled = enabled
+        # None = every category; else the enabled set
+        self.categories: Optional[frozenset] = (
+            frozenset(c.strip() for c in categories.split(",")
+                      if c.strip())
+            if isinstance(categories, str) and categories.strip()
+            else (frozenset(categories) if categories else None))
+        self.dump_dir = dump_dir
+        self.last_dump_path = ""
+        # best-effort height context: the consensus step machine
+        # stamps the height in progress, and events recorded without
+        # an explicit height (crypto dispatches, p2p frames, abci
+        # calls) inherit it — that is what makes "/trace?height=H" a
+        # complete per-height timeline rather than consensus-only
+        self.current_height = 0
+        self._rings: dict[str, deque] = {}
+        self._dump_seq = 0
+        self._lock = threading.Lock()
+
+    # -- hot path ----------------------------------------------------
+    def enabled_for(self, category: str) -> bool:
+        return self.enabled and (self.categories is None or
+                                 category in self.categories)
+
+    def _ring(self, category: str) -> deque:
+        ring = self._rings.get(category)
+        if ring is None:
+            # rare path; the lock only guards ring creation — appends
+            # ride the GIL (deque.append is atomic)
+            with self._lock:
+                ring = self._rings.get(category)
+                if ring is None:
+                    ring = deque(maxlen=self.buffer_size)
+                    self._rings[category] = ring
+        return ring
+
+    def record(self, category: str, name: str, start_ns: int,
+               end_ns: int, height: int,
+               attrs: Optional[dict]) -> None:
+        self._ring(category).append(
+            (start_ns, end_ns - start_ns, name,
+             height or self.current_height, attrs))
+
+    def record_instant(self, category: str, name: str, height: int,
+                       attrs: Optional[dict]) -> None:
+        self._ring(category).append(
+            (now_ns(), 0, name, height or self.current_height,
+             attrs))
+
+    # -- readers -----------------------------------------------------
+    def snapshot(self, height: Optional[int] = None,
+                 category: Optional[str] = None,
+                 limit: int = 0) -> list[dict]:
+        """Merged timeline, strictly ordered by monotonic timestamp.
+        ``height`` keeps only events stamped with that height;
+        ``category`` keeps one ring; ``limit`` keeps the newest N."""
+        out = []
+        for cat, ring in list(self._rings.items()):
+            if category is not None and cat != category:
+                continue
+            for ts, dur, name, h, attrs in list(ring):
+                if height is not None and h != height:
+                    continue
+                ev = {"ts_ns": ts, "dur_ns": dur, "category": cat,
+                      "name": name, "height": h}
+                if attrs:
+                    ev["attrs"] = attrs
+                out.append(ev)
+        out.sort(key=lambda e: (e["ts_ns"], e["dur_ns"]))
+        if limit > 0:
+            out = out[-limit:]
+        return out
+
+    def clear(self) -> None:
+        with self._lock:
+            self._rings.clear()
+
+    # -- the black box -----------------------------------------------
+    def dump(self, reason: str = "", path: str = "",
+             extra: Optional[dict] = None) -> str:
+        """Write the whole flight record to a JSON file and return its
+        path.  Never raises — a failing dump must not mask the crash
+        being dumped; returns "" on failure."""
+        try:
+            with self._lock:
+                self._dump_seq += 1
+                seq = self._dump_seq
+            if not path:
+                slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                               for c in reason)[:48] or "flight"
+                path = os.path.join(
+                    self.dump_dir or ".",
+                    f"flight-{os.getpid()}-{seq:03d}-{slug}.json")
+            record = {
+                "reason": reason,
+                "wall_time": time.strftime(
+                    "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+                "monotonic_ns": now_ns(),
+                "pid": os.getpid(),
+                "extra": extra or {},
+                "events": self.snapshot(),
+            }
+            d = os.path.dirname(path)
+            if d:
+                os.makedirs(d, exist_ok=True)
+            tmp = f"{path}.tmp"
+            with open(tmp, "w") as f:
+                json.dump(record, f)
+            os.replace(tmp, path)
+            self.last_dump_path = path
+            return path
+        except Exception:
+            return ""
+
+
+# the process-global recorder (the node configures it; tests may swap
+# their own via set_recorder)
+_R = Recorder()
+
+
+class _NopSpan:
+    """Shared inert context manager for the disabled path."""
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def note(self, **attrs) -> None:
+        pass
+
+
+_NOP = _NopSpan()
+
+
+class _Span:
+    __slots__ = ("_r", "cat", "name", "height", "attrs", "t0")
+
+    def __init__(self, r: Recorder, cat: str, name: str, height: int,
+                 attrs: Optional[dict]):
+        self._r = r
+        self.cat = cat
+        self.name = name
+        self.height = height
+        self.attrs = attrs
+        self.t0 = 0
+
+    def __enter__(self):
+        self.t0 = now_ns()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc_type is not None:
+            a = self.attrs or {}
+            a["error"] = exc_type.__name__
+            self.attrs = a
+        self._r.record(self.cat, self.name, self.t0, now_ns(),
+                       self.height, self.attrs)
+        return False
+
+    def note(self, **attrs) -> None:
+        """Attach attributes discovered mid-span."""
+        if self.attrs is None:
+            self.attrs = {}
+        self.attrs.update(attrs)
+
+
+# ---------------------------------------------------------------------
+# module-level API — what the instrumented call sites use
+
+def span(category: str, name: str, height: int = 0, **attrs):
+    """Context manager recording a monotonic span on exit.  When the
+    category (or tracing) is disabled this is a no-op."""
+    r = _R
+    if not r.enabled or (r.categories is not None and
+                         category not in r.categories):
+        return _NOP
+    return _Span(r, category, name, height, attrs or None)
+
+
+def instant(category: str, name: str, height: int = 0,
+            **attrs) -> None:
+    """Record a zero-duration point event."""
+    r = _R
+    if not r.enabled or (r.categories is not None and
+                         category not in r.categories):
+        return
+    r.record_instant(category, name, height, attrs or None)
+
+
+def record_span(category: str, name: str, start_ns: int,
+                end_ns: Optional[int] = None, height: int = 0,
+                **attrs) -> None:
+    """Record a span whose start was captured by the caller (e.g. the
+    consensus step tracker, which learns a step ended only when the
+    next one begins)."""
+    r = _R
+    if not r.enabled or (r.categories is not None and
+                         category not in r.categories):
+        return
+    r.record(category, name, start_ns,
+             end_ns if end_ns is not None else now_ns(), height,
+             attrs or None)
+
+
+def set_height(height: int) -> None:
+    """Stamp the height in progress (consensus step machine) so
+    height-less events inherit it."""
+    _R.current_height = height
+
+
+def enabled(category: str = "") -> bool:
+    return _R.enabled_for(category) if category else _R.enabled
+
+
+def snapshot(height: Optional[int] = None,
+             category: Optional[str] = None,
+             limit: int = 0) -> list[dict]:
+    return _R.snapshot(height=height, category=category, limit=limit)
+
+
+def dump(reason: str = "", path: str = "",
+         extra: Optional[dict] = None) -> str:
+    return _R.dump(reason=reason, path=path, extra=extra)
+
+
+def clear() -> None:
+    _R.clear()
+
+
+def configure(enabled: bool = True, buffer_size: int = 4096,
+              categories: Optional[str] = None,
+              dump_dir: str = ".") -> Recorder:
+    """(Re)configure the process-global recorder — called by the node
+    from instrumentation.trace_* config.  Existing rings are dropped
+    so the new buffer size takes effect."""
+    global _R
+    _R = Recorder(buffer_size=buffer_size, enabled=enabled,
+                  categories=categories, dump_dir=dump_dir)
+    return _R
+
+
+def recorder() -> Recorder:
+    return _R
+
+
+def set_recorder(r: Recorder) -> Recorder:
+    """Test seam: install a private recorder; returns the old one."""
+    global _R
+    old, _R = _R, r
+    return old

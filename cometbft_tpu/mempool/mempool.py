@@ -19,6 +19,7 @@ from typing import Callable, Optional, Sequence
 
 from ..abci import types as abci
 from ..config import MempoolConfig
+from ..libs import tracing
 from ..libs.log import Logger, new_logger
 from ..types.tx import compute_proto_size_overhead, tx_key
 
@@ -260,8 +261,11 @@ class CListMempool(Mempool):
             self.metrics.already_received_txs.add()
             raise TxInCacheError("tx already exists in cache")
         try:
-            res = await self.proxy_app.check_tx(
-                abci.CheckTxRequest(tx=tx, type=abci.CHECK_TX_TYPE_CHECK))
+            with tracing.span(tracing.MEMPOOL, "checktx",
+                              height=self.height, bytes=len(tx)):
+                res = await self.proxy_app.check_tx(
+                    abci.CheckTxRequest(
+                        tx=tx, type=abci.CHECK_TX_TYPE_CHECK))
         except Exception:
             self.cache.remove(key)
             raise
@@ -410,7 +414,9 @@ class CListMempool(Mempool):
         if self.config.recheck and self.size() > 0:
             import time as _time
             t0 = _time.perf_counter()
-            await self._recheck_txs()
+            with tracing.span(tracing.MEMPOOL, "recheck",
+                              height=height, txs=self.size()):
+                await self._recheck_txs()
             self.metrics.recheck_duration_seconds.set(
                 _time.perf_counter() - t0)
         self.metrics.update_sizes(self)

@@ -45,9 +45,12 @@ class MempoolReactor(Reactor):
             return
 
         def _stop_peer_on_giveup(st, exc):
+            # supervised one-shot teardown (AST-checked invariant)
             if self.switch is not None:
-                asyncio.get_event_loop().create_task(
-                    self.switch.stop_peer(peer, repr(exc)))
+                self.supervisor.spawn(
+                    lambda: self.switch.stop_peer(peer, repr(exc)),
+                    name=f"stop_peer:{peer.id[:12]}",
+                    kind="stop_peer")
 
         self._gossip_tasks[peer.id] = self.supervisor.spawn(
             lambda: self._gossip_routine(peer),

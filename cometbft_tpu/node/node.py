@@ -81,6 +81,18 @@ class Node:
         home = config.base.home
         db_dir = config.base.path(config.base.db_dir)
 
+        # flight recorder (libs/tracing.py): the always-on span rings
+        # every subsystem appends to; crash dumps land in the data dir
+        from ..libs import tracing
+        tracing.configure(
+            enabled=config.instrumentation.trace_enabled,
+            buffer_size=config.instrumentation.trace_buffer_size,
+            categories=config.instrumentation.trace_categories or None,
+            dump_dir=db_dir)
+        from ..types import signature_cache
+        signature_cache.set_default_capacity(
+            config.base.signature_cache_size)
+
         # --- genesis & identity -----------------------------------------
         self.genesis_doc = genesis_doc if genesis_doc is not None else \
             GenesisDoc.from_file(config.base.path(
@@ -224,6 +236,11 @@ class Node:
                 self.app_conns,
                 default_timeout_s=cfg.base.abci_call_timeout_ns / 1e9,
                 retries=cfg.base.abci_call_retries)
+
+        # flight-recorder span per ABCI call: the execute slice of the
+        # per-height timeline (/trace, tools/trace_report.py)
+        from ..abci.client import apply_tracing
+        apply_tracing(self.app_conns)
 
         # per-method ABCI timing (reference: proxy metrics)
         from ..abci.metrics import instrument_app_conns

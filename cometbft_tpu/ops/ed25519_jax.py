@@ -30,7 +30,14 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..libs import tracing
+
 _CACHE_CONFIGURED = False
+
+# (kernel choice, bucket) shapes already dispatched in this process —
+# the first dispatch of a shape pays tracing/compilation, so the
+# flight-recorder span carries warm=False for it
+_SEEN_SHAPES: set[tuple[str, int]] = set()
 
 
 def enable_compilation_cache() -> None:
@@ -364,8 +371,19 @@ def _verify_chunk(items) -> np.ndarray:
     choice = _kernel_choice()
     if choice.startswith("pallas"):
         m = max(m, _pallas_module(choice).BLOCK)
-    a_b, r_b, s_win, k_win, pre_bad = prep_arrays(items, m)
-    return _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
+    with tracing.span(tracing.CRYPTO, "host_prep", batch=n,
+                      bucket=m):
+        a_b, r_b, s_win, k_win, pre_bad = prep_arrays(items, m)
+    # compile-vs-execute attribution: the first dispatch of a
+    # (kernel, bucket) shape includes trace+compile (unless the AOT
+    # artifact or persistent cache serves it); warm dispatches are
+    # pure execution
+    warm = (choice, m) in _SEEN_SHAPES
+    with tracing.span(tracing.CRYPTO, "kernel_execute", batch=n,
+                      bucket=m, kernel=choice, warm=warm):
+        out = _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
+    _SEEN_SHAPES.add((choice, m))
+    return out
 
 
 def prep_arrays(items, m: int):
@@ -536,15 +554,19 @@ def _warmup_bucket(m: int) -> None:
     a = np.tile(np.frombuffer(_B_BYTES, np.uint8), (m, 1))
     r = np.tile(np.frombuffer(_IDENTITY_BYTES, np.uint8), (m, 1))
     z = np.zeros((m, _WINDOWS), np.uint8)
-    if _try_aot(choice, False, a, r, z, z) is not None:
-        return          # AOT artifact serves this bucket: no compile
-    if choice.startswith("pallas"):
-        np.asarray(_pallas_verify_packed(
-            jnp.asarray(a), jnp.asarray(r), jnp.asarray(z),
-            jnp.asarray(z), kernel=choice))
-        return
-    _jit_verify_packed(jnp.asarray(a), jnp.asarray(r), jnp.asarray(z),
-                       jnp.asarray(z)).block_until_ready()
+    with tracing.span(tracing.CRYPTO, "kernel_compile", bucket=m,
+                      kernel=choice) as sp:
+        if _try_aot(choice, False, a, r, z, z) is not None:
+            sp.note(aot=True)   # artifact served it: no compile paid
+        elif choice.startswith("pallas"):
+            np.asarray(_pallas_verify_packed(
+                jnp.asarray(a), jnp.asarray(r), jnp.asarray(z),
+                jnp.asarray(z), kernel=choice))
+        else:
+            _jit_verify_packed(jnp.asarray(a), jnp.asarray(r),
+                               jnp.asarray(z),
+                               jnp.asarray(z)).block_until_ready()
+    _SEEN_SHAPES.add((choice, m))
 
 
 class TpuBatchVerifier(BatchVerifier):

@@ -14,6 +14,7 @@ import struct
 from dataclasses import dataclass, field
 from typing import Awaitable, Callable, Optional
 
+from ..libs import tracing
 from ..libs.flowrate import RateLimiter
 from ..libs.log import Logger, new_logger
 
@@ -49,6 +50,7 @@ class _Channel:
         self.sent_pos = 0
         self.recv_buffer = bytearray()
         self.recently_sent = 0   # for least-ratio scheduling
+        self.last_msg_len = 0    # size of the last fully-sent message
 
     def is_send_pending(self) -> bool:
         return bool(self.sending) or not self.send_queue.empty()
@@ -63,6 +65,7 @@ class _Channel:
         self.sent_pos += len(chunk)
         eof = self.sent_pos >= len(self.sending)
         if eof:
+            self.last_msg_len = self.sent_pos
             self.sending = b""
             self.sent_pos = 0
         self.recently_sent += len(chunk)
@@ -142,6 +145,11 @@ class MConnection:
         try:
             ch.send_queue.put_nowait(msg)
         except asyncio.QueueFull:
+            # the canonical gossip stall: TrySend dropped on a full
+            # per-channel queue — flight-recorded so /trace shows
+            # which peer/channel backpressured a height
+            tracing.instant(tracing.P2P, "send_queue_full",
+                            chan=channel_id, peer=self.peer_id[:12])
             return False
         self._pending_bytes += len(msg)
         self.metrics.peer_pending_send_bytes.with_labels(
@@ -190,7 +198,17 @@ class MConnection:
                 if _dt > 0:
                     self.metrics.send_rate_limiter_delay.with_labels(
                         self.peer_id).add(_dt)
+                    tracing.instant(tracing.P2P, "send_rate_stall",
+                                    chan=ch.desc.id,
+                                    peer=self.peer_id[:12],
+                                    stall_ms=round(_dt * 1e3, 3))
                 await self._sconn.write_msg(pkt)
+                if eof:
+                    # one event per complete message, not per packet
+                    tracing.instant(tracing.P2P, "send",
+                                    chan=ch.desc.id,
+                                    peer=self.peer_id[:12],
+                                    bytes=ch.last_msg_len)
                 self.metrics.message_send_bytes_total.with_labels(
                     f"{ch.desc.id:#x}").add(len(pkt))
                 self._pending_bytes = max(
@@ -241,6 +259,10 @@ class MConnection:
                     complete = ch.recv_packet(
                         msg[3:], eof, ch.desc.recv_message_capacity)
                     if complete is not None:
+                        tracing.instant(tracing.P2P, "recv",
+                                        chan=chan_id,
+                                        peer=self.peer_id[:12],
+                                        bytes=len(complete))
                         await self._on_receive(chan_id, complete)
                 else:
                     raise MConnectionError(

@@ -42,7 +42,10 @@ class KnownAddress:
     node_id: str
     ip: str
     port: int
-    last_seen: float = field(default_factory=time.time)
+    # monotonic: last_seen feeds interval arithmetic (freshness
+    # ordering, eviction), which a wall-clock step would corrupt; the
+    # JSON book converts to/from wall time at the save/load boundary
+    last_seen: float = field(default_factory=time.monotonic)
     attempts: int = 0
     is_old: bool = False        # promoted after a successful connection
     bucket: int = 0
@@ -105,7 +108,7 @@ class AddrBook:
         ka = self._addrs.get(node_id)
         if ka is not None:
             ka.ip, ka.port = ip, port
-            ka.last_seen = time.time()
+            ka.last_seen = time.monotonic()
             return False
         idx = self._bucket_index(node_id, old=False)
         members = self._bucket_members(False, idx)
@@ -124,7 +127,7 @@ class AddrBook:
         if ka is None:
             return
         ka.attempts = 0
-        ka.last_seen = time.time()
+        ka.last_seen = time.monotonic()
         if ka.is_old:
             return
         idx = self._bucket_index(node_id, old=True)
@@ -178,10 +181,14 @@ class AddrBook:
         if not self.path:
             return
         os.makedirs(os.path.dirname(self.path) or ".", exist_ok=True)
+        # persist wall time (meaningful across reboots); in-memory
+        # last_seen is monotonic, so convert via the current offset
+        now_m, now_w = time.monotonic(), time.time()
         with open(self.path, "w") as f:
             json.dump({"key": self.key, "addrs": [
                 {"id": a.node_id, "ip": a.ip, "port": a.port,
-                 "last_seen": a.last_seen, "attempts": a.attempts,
+                 "last_seen": now_w - max(0.0, now_m - a.last_seen),
+                 "attempts": a.attempts,
                  "is_old": a.is_old, "bucket": a.bucket}
                 for a in self._addrs.values()]}, f, indent=2)
 
@@ -194,10 +201,14 @@ class AddrBook:
                 entries = raw.get("addrs", [])
             else:                      # legacy flat format
                 entries = raw
+            now_m, now_w = time.monotonic(), time.time()
             for d in entries:
+                # wall -> monotonic: age the entry by its wall-clock
+                # staleness (clamped — a future wall stamp is "now")
+                age = max(0.0, now_w - d.get("last_seen", 0.0))
                 self._addrs[d["id"]] = KnownAddress(
                     d["id"], d["ip"], int(d["port"]),
-                    d.get("last_seen", 0.0),
+                    now_m - age,
                     attempts=d.get("attempts", 0),
                     is_old=d.get("is_old", False),
                     bucket=d.get("bucket", 0))

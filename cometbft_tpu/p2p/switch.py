@@ -13,6 +13,7 @@ from dataclasses import dataclass, field
 from typing import Optional
 
 from .. import version as _version
+from ..libs import tracing
 from ..libs.log import Logger, new_logger
 from ..libs.supervisor import Supervisor
 from .conn import ChannelDescriptor, MConnection
@@ -278,8 +279,13 @@ class Switch:
 
         def on_error(e: Exception) -> None:
             if peer_holder:
-                asyncio.get_event_loop().create_task(
-                    self.stop_peer(peer_holder[0], str(e)))
+                # supervised one-shot: a crash inside stop_peer is
+                # metered and retried instead of vanishing with the
+                # fire-and-forget task
+                self.supervisor.spawn(
+                    lambda: self.stop_peer(peer_holder[0], str(e)),
+                    name=f"stop_peer:{their_info.node_id[:12]}",
+                    kind="stop_peer")
 
         mconn = MConnection(conn, self._channel_descs, on_receive,
                             on_error, send_rate=self.send_rate,
@@ -290,6 +296,8 @@ class Switch:
         peer_holder.append(peer)
         self.peers[peer.id] = peer
         self.metrics.peers.set(len(self.peers))
+        tracing.instant(tracing.P2P, "peer_add", peer=peer.id[:12],
+                        outbound=outbound)
         mconn.start()
         for reactor in self.reactors.values():
             await reactor.add_peer(peer)
@@ -302,6 +310,8 @@ class Switch:
         if self.peers.pop(peer.id, None) is None:
             return
         self.metrics.peers.set(len(self.peers))
+        tracing.instant(tracing.P2P, "peer_remove",
+                        peer=peer.id[:12], reason=reason[:64])
         peer.close()
         for reactor in self.reactors.values():
             await reactor.remove_peer(peer, reason)

@@ -77,6 +77,10 @@ def routes(env: Environment) -> dict:
         "consensus_state": lambda: _consensus_state(env),
         "dump_consensus_state": lambda:
             _dump_consensus_state(env),
+        # flight recorder (libs/tracing.py): the per-height span
+        # timeline every perf PR is judged with
+        "trace": lambda height="0", category="", limit="0":
+            _trace(env, height, category, limit),
         "consensus_params": lambda height="0":
             _consensus_params(env, height),
         "tx": lambda hash="", prove=False: _tx(env, hash),
@@ -506,6 +510,35 @@ async def _consensus_state(env):
             rs.valid_block.hash().hex().upper()
             if rs.valid_block else "",
     }}
+
+
+async def _trace(env, height, category, limit):
+    """Flight-recorder timeline (libs/tracing.py): spans + instant
+    events from the per-category ring buffers, strictly ordered by
+    monotonic timestamp.  ?height=H keeps one height's events,
+    ?category=consensus|crypto|p2p|mempool|abci keeps one ring,
+    ?limit=N keeps the newest N."""
+    from ..libs import tracing
+    try:
+        h = int(height or 0)
+    except (TypeError, ValueError):
+        h = 0
+    try:
+        lim = int(limit or 0)
+    except (TypeError, ValueError):
+        lim = 0
+    events = tracing.snapshot(height=h if h > 0 else None,
+                              category=str(category)
+                              if category else None,
+                              limit=lim)
+    return {
+        "enabled": tracing.enabled(),
+        "count": len(events),
+        # int64s ride as strings, the surface-wide convention
+        "events": [{**e, "ts_ns": str(e["ts_ns"]),
+                    "dur_ns": str(e["dur_ns"]),
+                    "height": str(e["height"])} for e in events],
+    }
 
 
 def _vote_set_summary(vs) -> dict:

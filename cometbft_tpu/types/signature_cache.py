@@ -2,10 +2,50 @@
 
 Reference: types/signature_cache.go — map sig → (valAddr, signBytes),
 shared across light-client adjacent/non-adjacent checks.
+
+Beyond the reference: the map is LRU-bounded (``base.
+signature_cache_size``, default 10k — the reference cache lives only
+for one verification pair, ours is reused across heights, so sustained
+traffic would otherwise grow it without limit), and hit/miss/evict
+counters are exported on the shared metrics registry
+(``cometbft_light_signature_cache_*``).
 """
 from __future__ import annotations
 
+from collections import OrderedDict
 from typing import NamedTuple, Optional
+
+# process default; the node overrides it from base.signature_cache_size
+DEFAULT_CAPACITY = 10_000
+
+_METRICS = None
+
+
+def _metrics():
+    """Lazily-registered counters on the process-global registry (the
+    same pattern as the crypto breaker state): sig caches are built in
+    light-client and validation paths that have no node registry."""
+    global _METRICS
+    if _METRICS is None:
+        from ..libs import metrics as libmetrics
+        m = libmetrics.DEFAULT
+        _METRICS = (
+            m.counter("light", "signature_cache_hits",
+                      "Signature-cache hits across commit "
+                      "verifications."),
+            m.counter("light", "signature_cache_misses",
+                      "Signature-cache misses."),
+            m.counter("light", "signature_cache_evictions",
+                      "Entries evicted by the signature-cache LRU "
+                      "cap."),
+        )
+    return _METRICS
+
+
+def set_default_capacity(n: int) -> None:
+    global DEFAULT_CAPACITY
+    if n > 0:
+        DEFAULT_CAPACITY = n
 
 
 class SignatureCacheValue(NamedTuple):
@@ -14,14 +54,34 @@ class SignatureCacheValue(NamedTuple):
 
 
 class SignatureCache:
-    def __init__(self):
-        self._m: dict[bytes, SignatureCacheValue] = {}
+    def __init__(self, capacity: int = 0):
+        self.capacity = capacity if capacity > 0 else DEFAULT_CAPACITY
+        self._m: OrderedDict[bytes, SignatureCacheValue] = \
+            OrderedDict()
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
 
     def get(self, sig: bytes) -> Optional[SignatureCacheValue]:
-        return self._m.get(sig)
+        v = self._m.get(sig)
+        hits, misses, _ = _metrics()
+        if v is not None:
+            self._m.move_to_end(sig)
+            self.hits += 1
+            hits.add()
+        else:
+            self.misses += 1
+            misses.add()
+        return v
 
     def add(self, sig: bytes, value: SignatureCacheValue) -> None:
+        if sig in self._m:
+            self._m.move_to_end(sig)
         self._m[sig] = value
+        if len(self._m) > self.capacity:
+            self._m.popitem(last=False)
+            self.evictions += 1
+            _metrics()[2].add()
 
     def __len__(self) -> int:
         return len(self._m)

@@ -301,16 +301,36 @@ class NemesisNet:
 
     def assert_no_conflicting_commits(self) -> None:
         """Safety: at every height, every node that committed a block
-        committed the SAME block."""
+        committed the SAME block.  A violation dumps the flight
+        recorder (libs/tracing.py) before failing — the black box a
+        post-mortem renders with tools/trace_report.py."""
+        conflicts: dict[int, dict[str, list[int]]] = {}
         for h in range(1, self.max_height() + 1):
             seen: dict[bytes, list[int]] = {}
             for n in self.nodes:
                 b = n.block_store.load_block(h)
                 if b is not None:
                     seen.setdefault(b.hash(), []).append(n.idx)
-            assert len(seen) <= 1, (
-                f"SAFETY VIOLATION: conflicting commits at height "
-                f"{h}: {{{', '.join(h_.hex()[:12] + ': ' + str(i) for h_, i in seen.items())}}}")
+            if len(seen) > 1:
+                conflicts[h] = {h_.hex(): idxs
+                                for h_, idxs in seen.items()}
+        if not conflicts:
+            return
+        from cometbft_tpu.libs import tracing
+        for h in sorted(conflicts):
+            tracing.instant(tracing.NEMESIS, "safety_violation",
+                            height=h, commits=conflicts[h])
+        dump_path = tracing.dump(
+            reason="nemesis_safety_violation",
+            extra={"conflicting_heights": sorted(conflicts),
+                   "conflicts": {str(h): c
+                                 for h, c in conflicts.items()}})
+        detail = ", ".join(
+            f"h{h}: {{{', '.join(hh[:12] + ': ' + str(i) for hh, i in c.items())}}}"
+            for h, c in sorted(conflicts.items()))
+        raise AssertionError(
+            f"SAFETY VIOLATION: conflicting commits — {detail}; "
+            f"flight record: {dump_path or '(dump failed)'}")
 
     # ------------------------------------------------------------------
     async def apply(self, step: tuple) -> None:

@@ -81,7 +81,10 @@ class TestBlsBatchVerifier:
         pk = _bls_keys(1)[0].pub_key()
         assert crypto_batch.supports_batch_verifier(pk)
         bv = crypto_batch.create_batch_verifier(pk)
-        assert isinstance(bv, bls12381.Bls12381BatchVerifier)
+        # dispatch wraps every verifier in the flight-recorder shim;
+        # the BLS engine sits inside it
+        assert isinstance(bv, crypto_batch.TracedBatchVerifier)
+        assert isinstance(bv._inner, bls12381.Bls12381BatchVerifier)
         # the locally spelled tag must track the real one
         assert crypto_batch._BLS_KEY_TYPE == bls12381.KEY_TYPE
 

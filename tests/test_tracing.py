@@ -1,0 +1,409 @@
+"""Flight recorder (libs/tracing.py): rings, dumps, report, RPC, and
+the live-testnet per-height timeline acceptance check.
+
+Covers:
+  * span/instant recording, strict monotonic ordering, height and
+    category filters, ring-buffer bounding;
+  * the disabled path as a true no-op (<1µs per span call — the
+    always-on budget);
+  * crash dumps: supervisor give-up and the nemesis safety-assertion
+    failure leave parseable JSON records (the nemesis one names the
+    conflicting-commit heights), rendered by tools/trace_report.py;
+  * the /trace RPC handler;
+  * the bounded signature cache (LRU cap + hit/evict counters);
+  * live 4-validator net: /trace?height=H returns consensus step
+    spans, a batch-verify dispatch span, and p2p send/recv events,
+    strictly ordered.
+"""
+import asyncio
+import importlib.util
+import json
+import os
+import time
+
+import pytest
+
+from cometbft_tpu.libs import tracing
+from cometbft_tpu.libs.supervisor import RestartPolicy, Supervisor
+from cometbft_tpu.libs.tracing import Recorder
+from cometbft_tpu.types.signature_cache import (
+    SignatureCache, SignatureCacheValue,
+)
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+
+
+def _load_trace_report():
+    spec = importlib.util.spec_from_file_location(
+        "trace_report", os.path.join(_ROOT, "tools",
+                                     "trace_report.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+@pytest.fixture
+def recorder(tmp_path):
+    """Fresh process-global recorder pointed at tmp; restores the old
+    one afterwards."""
+    old = tracing.set_recorder(
+        Recorder(buffer_size=65536, dump_dir=str(tmp_path)))
+    yield tracing.recorder()
+    tracing.set_recorder(old)
+
+
+class TestRecorder:
+    def test_spans_and_instants_strictly_ordered(self, recorder):
+        with tracing.span(tracing.CONSENSUS, "step:Propose",
+                          height=5, round=0):
+            tracing.instant(tracing.P2P, "recv", height=5, bytes=100)
+        tracing.instant(tracing.CONSENSUS, "commit", height=5)
+        evs = tracing.snapshot()
+        # spans sort by their START time: the span opened before the
+        # instant fired inside it
+        assert [e["name"] for e in evs] == \
+            ["step:Propose", "recv", "commit"]
+        ts = [e["ts_ns"] for e in evs]
+        assert ts == sorted(ts)
+        span_ev = next(e for e in evs if e["name"] == "step:Propose")
+        assert span_ev["dur_ns"] > 0
+        assert span_ev["attrs"]["round"] == 0
+
+    def test_height_and_category_filters(self, recorder):
+        tracing.instant(tracing.CONSENSUS, "a", height=1)
+        tracing.instant(tracing.CONSENSUS, "b", height=2)
+        tracing.instant(tracing.CRYPTO, "c", height=2)
+        assert {e["name"] for e in tracing.snapshot(height=2)} == \
+            {"b", "c"}
+        assert {e["name"]
+                for e in tracing.snapshot(category=tracing.CRYPTO)
+                } == {"c"}
+        assert len(tracing.snapshot(limit=1)) == 1
+
+    def test_height_context_inherited(self, recorder):
+        tracing.set_height(7)
+        tracing.instant(tracing.P2P, "send", bytes=1)
+        with tracing.span(tracing.CRYPTO, "batch_verify", batch=4):
+            pass
+        assert all(e["height"] == 7 for e in tracing.snapshot())
+
+    def test_ring_is_bounded(self, tmp_path):
+        old = tracing.set_recorder(
+            Recorder(buffer_size=16, dump_dir=str(tmp_path)))
+        try:
+            for i in range(100):
+                tracing.instant(tracing.P2P, "send", seq=i)
+            evs = tracing.snapshot()
+            assert len(evs) == 16
+            # the ring keeps the NEWEST events
+            assert evs[-1]["attrs"]["seq"] == 99
+        finally:
+            tracing.set_recorder(old)
+
+    def test_category_enable_list(self, tmp_path):
+        old = tracing.set_recorder(
+            Recorder(buffer_size=16, categories="consensus,crypto",
+                     dump_dir=str(tmp_path)))
+        try:
+            tracing.instant(tracing.CONSENSUS, "a")
+            tracing.instant(tracing.P2P, "b")
+            with tracing.span(tracing.P2P, "c"):
+                pass
+            assert [e["name"] for e in tracing.snapshot()] == ["a"]
+        finally:
+            tracing.set_recorder(old)
+
+    def test_span_records_error_attr(self, recorder):
+        with pytest.raises(ValueError):
+            with tracing.span(tracing.ABCI, "consensus/finalize"):
+                raise ValueError("boom")
+        (ev,) = tracing.snapshot()
+        assert ev["attrs"]["error"] == "ValueError"
+
+    def test_dump_is_parseable_and_atomic(self, recorder, tmp_path):
+        tracing.instant(tracing.CONSENSUS, "commit", height=3)
+        path = tracing.dump(reason="unit test!",
+                            extra={"k": "v"})
+        assert path and os.path.exists(path)
+        assert not os.path.exists(path + ".tmp")
+        with open(path) as f:
+            record = json.load(f)
+        assert record["reason"] == "unit test!"
+        assert record["extra"] == {"k": "v"}
+        assert record["events"][0]["name"] == "commit"
+
+
+class TestDisabledOverhead:
+    def test_noop_span_under_1us(self, tmp_path):
+        """The always-on budget: with tracing disabled, a span call
+        (create + enter + exit) must cost <1µs — the hot paths
+        (per-packet p2p, per-vote consensus) run it unconditionally."""
+        old = tracing.set_recorder(
+            Recorder(enabled=False, dump_dir=str(tmp_path)))
+        try:
+            span = tracing.span
+            n = 50_000
+            best = float("inf")
+            for _ in range(5):
+                t0 = time.perf_counter()
+                for _ in range(n):
+                    with span("consensus", "x"):
+                        pass
+                best = min(best, (time.perf_counter() - t0) / n)
+            assert best < 1e-6, f"{best * 1e9:.0f}ns per no-op span"
+            assert tracing.snapshot() == []
+        finally:
+            tracing.set_recorder(old)
+
+    def test_noop_instant_records_nothing(self, tmp_path):
+        old = tracing.set_recorder(
+            Recorder(enabled=False, dump_dir=str(tmp_path)))
+        try:
+            tracing.instant(tracing.P2P, "send", bytes=1)
+            tracing.record_span(tracing.P2P, "x", 0, 1)
+            assert tracing.snapshot() == []
+        finally:
+            tracing.set_recorder(old)
+
+
+class TestSupervisorGiveupDump:
+    def test_giveup_dumps_flight_record(self, recorder, tmp_path):
+        async def go():
+            sup = Supervisor("t")
+
+            async def boom():
+                raise RuntimeError("kaput")
+
+            st = sup.spawn(boom, name="boom", kind="boom",
+                           policy=RestartPolicy(max_restarts=0))
+            await st.wait()
+            return st
+
+        st = run(go())
+        assert st.gave_up
+        path = recorder.last_dump_path
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            record = json.load(f)
+        assert "supervisor_giveup" in record["reason"]
+        assert record["extra"]["kind"] == "boom"
+        assert any(e["name"] == "giveup"
+                   for e in record["events"])
+
+
+class TestNemesisSafetyDump:
+    def test_conflicting_commits_dump_heights(self, recorder,
+                                              tmp_path):
+        from nemesis import NemesisNet
+
+        class _Block:
+            def __init__(self, h):
+                self._h = h
+
+            def hash(self):
+                return self._h
+
+        class _Store:
+            def __init__(self, blocks):
+                self._b = blocks
+
+            def load_block(self, h):
+                return self._b.get(h)
+
+        class _Node:
+            def __init__(self, idx, blocks):
+                self.idx = idx
+                self.block_store = _Store(blocks)
+                self.height = max(blocks, default=0)
+
+        net = object.__new__(NemesisNet)
+        net.nodes = [
+            _Node(0, {1: _Block(b"\xaa" * 32), 2: _Block(b"\xcc" * 32)}),
+            _Node(1, {1: _Block(b"\xbb" * 32), 2: _Block(b"\xcc" * 32)}),
+        ]
+        with pytest.raises(AssertionError) as ei:
+            net.assert_no_conflicting_commits()
+        assert "SAFETY VIOLATION" in str(ei.value)
+        path = recorder.last_dump_path
+        assert path and os.path.exists(path)
+        with open(path) as f:
+            record = json.load(f)
+        # the dump names the conflicting heights (height 2 agreed)
+        assert record["extra"]["conflicting_heights"] == [1]
+        assert "aa" * 4 in json.dumps(record["extra"]["conflicts"])
+        # and the report renders it
+        report = _load_trace_report().render_report(record)
+        assert "conflicting-commit heights: [1]" in report
+
+    def test_agreeing_commits_do_not_dump(self, recorder):
+        from nemesis import NemesisNet
+
+        class _Node:
+            def __init__(self, idx):
+                self.idx = idx
+                self.height = 0
+                self.block_store = type(
+                    "S", (), {"load_block":
+                              staticmethod(lambda h: None)})()
+
+        net = object.__new__(NemesisNet)
+        net.nodes = [_Node(0), _Node(1)]
+        net.assert_no_conflicting_commits()
+        assert recorder.last_dump_path == ""
+
+
+class TestTraceReport:
+    def test_per_height_breakdown(self, recorder):
+        base = tracing.now_ns()
+        # height 4: propose step, proposal completes, crypto batch,
+        # abci finalize, save_block
+        tracing.record_span(tracing.CONSENSUS, "step:Propose",
+                            base, base + 10_000_000, height=4)
+        recorder.record_instant(tracing.CONSENSUS,
+                                "proposal_complete", 4, None)
+        tracing.record_span(tracing.CRYPTO, "batch_verify",
+                            base + 2_000_000, base + 5_000_000,
+                            height=4, batch=128, backend="cpu")
+        tracing.record_span(tracing.ABCI, "consensus/finalize_block",
+                            base + 6_000_000, base + 9_000_000,
+                            height=4)
+        tracing.record_span(tracing.CONSENSUS, "save_block",
+                            base + 9_000_000, base + 9_500_000,
+                            height=4)
+        mod = _load_trace_report()
+        record = {"events": tracing.snapshot()}
+        rows = mod.analyze(record)
+        assert 4 in rows
+        r = rows[4]
+        assert r["verify_ms"] == pytest.approx(3.0)
+        assert r["execute_ms"] == pytest.approx(3.0)
+        assert r["commit_ms"] == pytest.approx(0.5)
+        assert r["batches"][0]["batch"] == 128
+        assert r["batches"][0]["backend"] == "cpu"
+        text = mod.render_report(record)
+        assert "verify_ms" in text and "batch=128" in text
+
+    def test_heightless_events_attributed_by_window(self, recorder):
+        base = tracing.now_ns()
+        tracing.record_span(tracing.CONSENSUS, "step:Prevote",
+                            base, base + 10_000_000, height=9)
+        # a crypto span with NO height, inside height 9's window
+        recorder.record(tracing.CRYPTO, "kernel_execute",
+                        base + 1_000_000, base + 2_000_000, -1, None)
+        mod = _load_trace_report()
+        evs = tracing.snapshot()
+        for e in evs:       # strip the height for the crypto event
+            if e["category"] == "crypto":
+                e["height"] = 0
+        rows = mod.analyze({"events": evs})
+        assert rows[9]["verify_ms"] == pytest.approx(1.0)
+
+
+class TestTraceRPC:
+    def test_trace_route(self, recorder):
+        from cometbft_tpu.rpc import core
+        tracing.instant(tracing.CONSENSUS, "commit", height=12)
+        tracing.instant(tracing.P2P, "send", height=13)
+        routes = core.routes(None)
+        resp = run(routes["trace"](height="12"))
+        assert resp["enabled"] is True
+        assert resp["count"] == 1
+        (ev,) = resp["events"]
+        assert ev["name"] == "commit"
+        assert ev["height"] == "12"          # int64-as-string
+        resp_all = run(routes["trace"]())
+        assert resp_all["count"] == 2
+        resp_cat = run(routes["trace"](height="0", category="p2p"))
+        assert resp_cat["count"] == 1
+
+    def test_pprof_trace_dump(self, recorder, tmp_path):
+        from cometbft_tpu.libs.pprof import _trace_dump
+        tracing.instant(tracing.CONSENSUS, "commit", height=1)
+        body = json.loads(_trace_dump(False))
+        assert body["events"][0]["name"] == "commit"
+        body = json.loads(_trace_dump(True))
+        assert os.path.exists(body["dump_path"])
+
+
+class TestSignatureCacheLRU:
+    def test_lru_cap_and_counters(self):
+        c = SignatureCache(capacity=3)
+        for i in range(4):
+            c.add(bytes([i]) * 64,
+                  SignatureCacheValue(b"a", bytes([i])))
+        assert len(c) == 3
+        assert c.evictions == 1
+        assert c.get(b"\x00" * 64) is None       # evicted (oldest)
+        assert c.get(b"\x03" * 64) is not None
+        assert c.misses == 1 and c.hits == 1
+
+    def test_get_refreshes_recency(self):
+        c = SignatureCache(capacity=2)
+        c.add(b"a" * 64, SignatureCacheValue(b"a", b"1"))
+        c.add(b"b" * 64, SignatureCacheValue(b"b", b"2"))
+        assert c.get(b"a" * 64) is not None      # refresh a
+        c.add(b"c" * 64, SignatureCacheValue(b"c", b"3"))
+        assert c.get(b"b" * 64) is None          # b evicted, not a
+        assert c.get(b"a" * 64) is not None
+
+    def test_default_capacity_configurable(self):
+        from cometbft_tpu.types import signature_cache as sc
+        old = sc.DEFAULT_CAPACITY
+        try:
+            sc.set_default_capacity(5)
+            assert SignatureCache().capacity == 5
+        finally:
+            sc.set_default_capacity(old)
+
+
+# ---------------------------------------------------------------------
+# acceptance: live testnet timeline
+
+class TestLiveNetTrace:
+    def test_trace_height_timeline_on_live_net(self, recorder):
+        """/trace?height=H on a running 4-validator net over real
+        sockets: consensus step spans, >=1 batch-verify dispatch span
+        (with batch size and backend), and p2p send/recv events, all
+        strictly ordered by monotonic timestamp."""
+        from test_testnet import _make_net, _wait_all_height
+
+        from cometbft_tpu.rpc import core
+
+        async def go():
+            nodes = await _make_net(4)
+            try:
+                await _wait_all_height(nodes, 3)
+            finally:
+                for n in nodes:
+                    await n.stop()
+
+        run(go())
+        routes = core.routes(None)
+        # pick a height that fully played out
+        resp = run(routes["trace"](height="2"))
+        evs = resp["events"]
+        names = [(e["category"], e["name"]) for e in evs]
+        assert any(n.startswith("step:") for _, n in names
+                   if _ == "consensus"), names
+        batch = [e for e in evs if e["category"] == "crypto"
+                 and e["name"] == "batch_verify"]
+        assert batch, names
+        assert batch[0]["attrs"]["batch"] >= 2
+        assert batch[0]["attrs"]["backend"] in (
+            "cpu", "tpu", "bls_native")
+        assert any(c == "p2p" and n == "send" for c, n in names)
+        assert any(c == "p2p" and n == "recv" for c, n in names)
+        ts = [int(e["ts_ns"]) for e in evs]
+        assert ts == sorted(ts)
+        # the report renders a breakdown for this height
+        report = _load_trace_report().render_report(
+            {"events": tracing.snapshot()}, height=2)
+        assert "gossip_ms" in report
