@@ -188,6 +188,10 @@ class InstrumentationConfig:
     trace_enabled: bool = True
     trace_buffer_size: int = 4096
     trace_categories: str = ""
+    # where automatic flight dumps (supervisor give-up, nemesis
+    # safety violations, /debug/pprof/trace?dump=1) land; empty means
+    # the node's data dir (never the process CWD)
+    dump_dir: str = ""
 
 
 @dataclass

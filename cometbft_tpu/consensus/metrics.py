@@ -91,6 +91,28 @@ class Metrics:
             "Interval in seconds between the proposal timestamp and "
             "the timestamp of the latest prevote in a round where "
             "all validators voted.", labels=("proposer_address",))
+        # metrics v2: distribution views of the quorum/full delays.
+        # The reference gauges above only hold the LAST delay per
+        # proposer; the unlabeled histograms answer "what is the p99
+        # quorum delay" over time without a per-proposer bucket
+        # explosion.
+        _delay_buckets = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+                          1.0, 2.5, 5.0, 10.0)
+        self.quorum_prevote_delay_seconds = m.histogram(
+            "consensus", "quorum_prevote_delay_seconds",
+            "Histogram of the interval in seconds between the "
+            "proposal timestamp and the earliest quorum-achieving "
+            "prevote.", buckets=_delay_buckets)
+        self.full_prevote_delay_seconds = m.histogram(
+            "consensus", "full_prevote_delay_seconds",
+            "Histogram of the interval in seconds between the "
+            "proposal timestamp and the latest prevote in rounds "
+            "where all validators voted.", buckets=_delay_buckets)
+        self.rounds_per_height = m.histogram(
+            "consensus", "rounds_per_height",
+            "Histogram of the round number blocks commit in "
+            "(0 = first round).",
+            buckets=(0, 1, 2, 3, 5, 10, 20))
         self.vote_extension_receive_count = m.counter(
             "consensus", "vote_extension_receive_count",
             "Number of vote extensions received, annotated by "
@@ -141,12 +163,15 @@ class Metrics:
 
     def record_commit(self, block, last_validators,
                       current_validators,
-                      block_size: int = 0) -> None:
+                      block_size: int = 0,
+                      commit_round: int = -1) -> None:
         """Per-commit stats (reference: recordMetrics, state.go).
         last_validators signed block.last_commit; block_size is the
         full wire size (part-set byte size)."""
         now = time.monotonic()
         self.height.set(block.header.height)
+        if commit_round >= 0:
+            self.rounds_per_height.observe(commit_round)
         self.latest_block_height.set(block.header.height)
         self.num_txs.set(len(block.data.txs))
         self.total_txs.add(len(block.data.txs))

@@ -91,6 +91,13 @@ class ConsensusState:
 
         self.rs = RoundState()
         self.sm_state: Optional[SMState] = None
+        # highest (height, round) whose quorum-prevote delay was
+        # observed: two_thirds_majority() stays true for every prevote
+        # trailing the quorum — including stragglers from EARLIER
+        # rounds arriving after a later round already observed — and
+        # the histogram must record only the earliest quorum-achieving
+        # prevote of each round, once, so the latch is monotonic
+        self._quorum_delay_observed: tuple = (-1, -1)
 
         # one merged input queue (Go's select over the three channels is
         # unbiased, so FIFO merging preserves the semantics)
@@ -1080,7 +1087,8 @@ class ConsensusState:
 
         self.metrics.record_commit(block, rs.last_validators,
                                    rs.validators,
-                                   block_size=block_parts.byte_size)
+                                   block_size=block_parts.byte_size,
+                                   commit_round=rs.commit_round)
         state_copy = self.sm_state.copy()
         with tracing.span(tracing.CONSENSUS, "apply_block",
                           height=height, num_txs=len(block.data.txs)):
@@ -1209,9 +1217,15 @@ class ConsensusState:
                     rs.proposal.timestamp) / 1e9
                 self.metrics.quorum_prevote_delay.with_labels(
                     proposer).set(delay_s)
+                if (height, vote.round) > self._quorum_delay_observed:
+                    self._quorum_delay_observed = (height, vote.round)
+                    self.metrics.quorum_prevote_delay_seconds.observe(
+                        max(0.0, delay_s))
                 if prevotes.has_all():
                     self.metrics.full_prevote_delay.with_labels(
                         proposer).set(delay_s)
+                    self.metrics.full_prevote_delay_seconds.observe(
+                        max(0.0, delay_s))
             if ok and not block_id.is_nil():
                 # update valid block
                 if rs.valid_round < vote.round and \

@@ -14,11 +14,55 @@ from __future__ import annotations
 
 import os
 import threading
+import time
 from typing import Optional
 
 from ..libs import tracing
 from . import ed25519
 from .keys import BatchVerifier, PubKey
+
+# ---------------------------------------------------------------------
+# metrics v2: batch-verify latency distribution, labeled by backend and
+# pad bucket.  Registered lazily on the process-global registry
+# (libs.metrics.DEFAULT) because verifiers are created deep in the
+# verification paths with no node context; the node's /metrics merges
+# DEFAULT in.  The pad buckets mirror ops/ed25519_jax._BUCKETS — the
+# power-of-two-ish shapes the kernel compiles once per — so CPU and
+# TPU observations of the same batch size share a label value.
+
+PAD_BUCKETS = (64, 1024, 4096, 10240, 16384)
+
+_VERIFY_HIST = None
+
+
+def pad_bucket(n: int) -> int:
+    """The padded lane count a batch of n signatures dispatches at
+    (mirrors ops/ed25519_jax._bucket; asserted equal in
+    tests/test_metrics_contract.py)."""
+    for b in PAD_BUCKETS:
+        if n <= b:
+            return b
+    return PAD_BUCKETS[-1]
+
+
+def verify_seconds_histogram():
+    """The process-global batch-verify latency histogram."""
+    global _VERIFY_HIST
+    if _VERIFY_HIST is None:
+        from ..libs import metrics as libmetrics
+        _VERIFY_HIST = libmetrics.DEFAULT.histogram(
+            "crypto", "batch_verify_seconds",
+            "Batch signature verification latency in seconds, by "
+            "dispatch backend and kernel pad bucket.",
+            labels=("backend", "pad_bucket"),
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
+    return _VERIFY_HIST
+
+
+def _observe_verify(backend: str, n: int, elapsed_s: float) -> None:
+    verify_seconds_histogram().with_labels(
+        backend, str(pad_bucket(n))).observe(elapsed_s)
 
 _backend: Optional[str] = None
 _auto_probe: Optional[str] = None   # cached auto-detection result
@@ -207,6 +251,7 @@ class GuardedTpuBatchVerifier(BatchVerifier):
         attempted_tpu = False
         if br.allow():
             attempted_tpu = True
+            t0 = time.perf_counter()
             try:
                 with tracing.span(tracing.CRYPTO, "batch_verify",
                                   batch=len(self._items),
@@ -219,14 +264,20 @@ class GuardedTpuBatchVerifier(BatchVerifier):
                     latch=not _is_transient_kernel_error(e))
             else:
                 br.record_success()
+                _observe_verify("tpu", len(self._items),
+                                time.perf_counter() - t0)
                 return out
+        t0 = time.perf_counter()
         with tracing.span(tracing.CRYPTO, "batch_verify",
                           batch=len(self._items), backend="cpu",
                           fallback=attempted_tpu):
             cpu = ed25519.CpuBatchVerifier()
             for pk, m, s in self._items:
                 cpu.add(pk, m, s)
-            return cpu.verify()
+            out = cpu.verify()
+        _observe_verify("cpu", len(self._items),
+                        time.perf_counter() - t0)
+        return out
 
 
 class TracedBatchVerifier(BatchVerifier):
@@ -247,9 +298,13 @@ class TracedBatchVerifier(BatchVerifier):
             return len(getattr(self._inner, "_items", ()))
 
     def verify(self):
+        n = len(self)
+        t0 = time.perf_counter()
         with tracing.span(tracing.CRYPTO, "batch_verify",
-                          batch=len(self), backend=self._backend):
-            return self._inner.verify()
+                          batch=n, backend=self._backend):
+            out = self._inner.verify()
+        _observe_verify(self._backend, n, time.perf_counter() - t0)
+        return out
 
 
 def create_batch_verifier(pub_key: PubKey) -> BatchVerifier:

@@ -1,9 +1,32 @@
-"""Prometheus-style metrics: registry + text exposition.
+"""Prometheus-style metrics: registry + text exposition (metrics v2).
 
 Reference: libs/metrics (go-kit metrics with a Prometheus provider) and
 the per-package metrics.go files (internal/consensus/metrics.go:190,
 mempool, p2p, state, blocksync, statesync, proxy).  Served at /metrics
 by the instrumentation listener (node/node.go prometheusSrv).
+
+v2 additions (the "metrics v2 + perf lab" layer):
+  * Prometheus-text-format-correct exposition — label values and HELP
+    text are escaped per the exposition format spec, so a peer moniker
+    containing a quote or newline cannot break a scrape;
+  * histogram trace exemplars — every bucket remembers its most recent
+    observation together with the flight-recorder height in progress
+    (libs/tracing.py ``current_height``), so a p99 outlier in a scrape
+    links straight to ``/trace?height=H``.  Exemplars ride the
+    OpenMetrics ``# {...}`` syntax and are OFF in the default render
+    (plain text-format scrapers reject them) — pass ``exemplars=True``
+    (``GET /metrics?exemplars=1``);
+  * bounded label cardinality — a metric family never materializes
+    more than ``max_children`` label sets; excess label values (e.g.
+    peer-controlled ids under churn) collapse into one ``overflow``
+    series instead of growing the registry without bound;
+  * ``Registry.collect()`` — machine-readable family descriptors
+    (name, kind, help, labels, live series) feeding the generated
+    metrics catalog in docs/observability.md and the tier-1
+    cardinality/help guard;
+  * ``render_merged()`` — one exposition page over several registries
+    (the node registry + the process-global DEFAULT that the crypto
+    layer's backend-dispatch histograms live on).
 """
 from __future__ import annotations
 
@@ -11,11 +34,26 @@ import threading
 import time
 from typing import Optional, Sequence
 
+from . import tracing
+
+
+def _escape_label_value(v: str) -> str:
+    """Exposition-format label escaping: backslash, double-quote and
+    newline (in that order — escaping the escape char first)."""
+    return v.replace("\\", "\\\\").replace('"', '\\"') \
+            .replace("\n", "\\n")
+
+
+def _escape_help(h: str) -> str:
+    """HELP lines escape backslash and newline only."""
+    return h.replace("\\", "\\\\").replace("\n", "\\n")
+
 
 def _fmt_labels(names: Sequence[str], values: Sequence[str]) -> str:
     if not names:
         return ""
-    inner = ",".join(f'{n}="{v}"' for n, v in zip(names, values))
+    inner = ",".join(f'{n}="{_escape_label_value(v)}"'
+                     for n, v in zip(names, values))
     return "{" + inner + "}"
 
 
@@ -25,7 +63,22 @@ def _fmt_value(v: float) -> str:
     return repr(v)
 
 
+def _fmt_exemplar(ex) -> str:
+    """OpenMetrics exemplar: ``# {labels} value timestamp``."""
+    value, ts, labels = ex
+    inner = ",".join(f'{k}="{_escape_label_value(str(v))}"'
+                     for k, v in labels.items())
+    return f" # {{{inner}}} {_fmt_value(value)} {ts:.3f}"
+
+
 _MEMO_MAX = 1024
+# Hard ceiling on label sets per family: beyond this, new label values
+# collapse into one "overflow" series.  Peer-controlled label values
+# (peer ids under churn, lane names from a byzantine app) therefore
+# cannot grow a family without bound — the tier-1 cardinality guard
+# (tests/test_metrics_contract.py) locks this invariant.
+_CHILDREN_MAX = 2048
+_OVERFLOW = "overflow"
 
 
 class _Metric:
@@ -36,6 +89,7 @@ class _Metric:
         self.name = name
         self.help = help_
         self.label_names = tuple(label_names)
+        self.max_children = _CHILDREN_MAX
         self._children: dict[tuple, "_Metric"] = {}
         self._memo: dict[tuple, "_Metric"] = {}
         self._lock = threading.Lock()
@@ -66,8 +120,15 @@ class _Metric:
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._new_child(key)
-                self._children[key] = child
+                if len(self._children) >= self.max_children:
+                    # cardinality ceiling: collapse into the shared
+                    # overflow series rather than growing unboundedly
+                    key = tuple(_OVERFLOW
+                                for _ in self.label_names)
+                    child = self._children.get(key)
+                if child is None:
+                    child = self._new_child(key)
+                    self._children[key] = child
             if memoizable:
                 if len(self._memo) >= _MEMO_MAX:
                     self._memo.pop(next(iter(self._memo)))
@@ -77,15 +138,26 @@ class _Metric:
     def _new_child(self, key: tuple):  # pragma: no cover - abstract
         raise NotImplementedError
 
-    def _samples(self):  # -> list[(labels, value)]
+    def _samples(self):  # -> list[(suffix, labels, value, exemplar)]
         raise NotImplementedError
 
-    def render(self) -> str:
-        lines = [f"# HELP {self.name} {self.help}",
+    def series_count(self) -> int:
+        return len(self._children) if self.label_names else 1
+
+    def describe(self) -> dict:
+        """Family descriptor for Registry.collect()."""
+        return {"name": self.name, "kind": self.kind,
+                "help": self.help, "labels": list(self.label_names),
+                "series": self.series_count()}
+
+    def render(self, exemplars: bool = False) -> str:
+        lines = [f"# HELP {self.name} {_escape_help(self.help)}",
                  f"# TYPE {self.name} {self.kind}"]
-        for suffix, labels, value in self._samples():
+        for suffix, labels, value, ex in self._samples():
+            tail = _fmt_exemplar(ex) if exemplars and ex else ""
             lines.append(
-                f"{self.name}{suffix}{labels} {_fmt_value(value)}")
+                f"{self.name}{suffix}{labels} "
+                f"{_fmt_value(value)}{tail}")
         return "\n".join(lines)
 
 
@@ -113,9 +185,25 @@ class Counter(_Metric):
 
     def _samples(self):
         if self.label_names:
-            return [("", _fmt_labels(self.label_names, k), c._value)
+            return [("", _fmt_labels(self.label_names, k), c._value,
+                     None)
                     for k, c in sorted(self._children.items())]
-        return [("", "", self._value)]
+        return [("", "", self._value, None)]
+
+    def render(self, exemplars: bool = False) -> str:
+        if not exemplars:
+            return super().render()
+        # OpenMetrics mode (the exemplar page): counter sample names
+        # MUST carry the _total suffix and the family name drops it —
+        # a conforming parser rejects the page otherwise
+        family = self.name[:-len("_total")] \
+            if self.name.endswith("_total") else self.name
+        lines = [f"# HELP {family} {_escape_help(self.help)}",
+                 f"# TYPE {family} counter"]
+        for _suffix, labels, value, _ex in self._samples():
+            lines.append(f"{family}_total{labels} "
+                         f"{_fmt_value(value)}")
+        return "\n".join(lines)
 
 
 class Gauge(_Metric):
@@ -144,9 +232,10 @@ class Gauge(_Metric):
 
     def _samples(self):
         if self.label_names:
-            return [("", _fmt_labels(self.label_names, k), g._value)
+            return [("", _fmt_labels(self.label_names, k), g._value,
+                     None)
                     for k, g in sorted(self._children.items())]
-        return [("", "", self._value)]
+        return [("", "", self._value, None)]
 
 
 _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
@@ -154,6 +243,13 @@ _DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 
 
 class Histogram(_Metric):
+    """Prometheus-correct cumulative histogram.
+
+    ``observe`` feeds ``_bucket``/``_sum``/``_count``; each bucket also
+    remembers its latest observation as an OpenMetrics exemplar
+    annotated with the flight-recorder height in progress, linking a
+    scrape outlier to ``/trace?height=H``."""
+
     kind = "histogram"
 
     def __init__(self, name: str, help_: str,
@@ -164,33 +260,48 @@ class Histogram(_Metric):
         self._counts = [0] * len(self.buckets)
         self._sum = 0.0
         self._count = 0
+        # per-bucket (value, unix_ts, labels) — index len(buckets) is
+        # the +Inf bucket
+        self._exemplars: dict[int, tuple] = {}
 
     def _new_child(self, key):
         return Histogram(self.name, self.help, buckets=self.buckets)
 
-    def observe(self, v: float) -> None:
+    def observe(self, v: float,
+                exemplar: Optional[dict] = None) -> None:
         self._sum += v
         self._count += 1
+        idx = len(self.buckets)        # +Inf unless a bucket matches
         for i, b in enumerate(self.buckets):
             if v <= b:
                 self._counts[i] += 1
+                if i < idx:
+                    idx = i
+        if exemplar is None:
+            # trace exemplar: stamp the height the consensus machine
+            # is working on so the observation links to /trace
+            h = tracing.recorder().current_height
+            if h:
+                exemplar = {"trace_height": h}
+        if exemplar:
+            self._exemplars[idx] = (v, time.time(), exemplar)
 
     def _child_samples(self, labels_prefix: str):
         out = []
-        cum = 0
-        for b, c in zip(self.buckets, self._counts):
-            cum = c
+        for i, b in enumerate(self.buckets):
+            c = self._counts[i]
             le = _fmt_value(b)
             if labels_prefix:
                 lab = labels_prefix[:-1] + f',le="{le}"}}'
             else:
                 lab = f'{{le="{le}"}}'
-            out.append(("_bucket", lab, cum))
+            out.append(("_bucket", lab, c, self._exemplars.get(i)))
         inf_lab = (labels_prefix[:-1] + ',le="+Inf"}') \
             if labels_prefix else '{le="+Inf"}'
-        out.append(("_bucket", inf_lab, self._count))
-        out.append(("_sum", labels_prefix, self._sum))
-        out.append(("_count", labels_prefix, self._count))
+        out.append(("_bucket", inf_lab, self._count,
+                    self._exemplars.get(len(self.buckets))))
+        out.append(("_sum", labels_prefix, self._sum, None))
+        out.append(("_count", labels_prefix, self._count, None))
         return out
 
     def _samples(self):
@@ -234,15 +345,49 @@ class Registry:
             f"{self.namespace}_{subsystem}_{name}", help_, labels,
             buckets))
 
-    def render(self) -> str:
+    def collect(self) -> list[dict]:
+        """Sorted family descriptors — the generated metrics catalog
+        (docs/observability.md) and the tier-1 cardinality/help guard
+        read the registry through this."""
         with self._lock:
             metrics = sorted(self._metrics.values(),
                              key=lambda m: m.name)
-        return "\n".join(m.render() for m in metrics) + "\n"
+        return [m.describe() for m in metrics]
+
+    def families(self) -> list[_Metric]:
+        with self._lock:
+            return sorted(self._metrics.values(),
+                          key=lambda m: m.name)
+
+    def render(self, exemplars: bool = False) -> str:
+        return "\n".join(m.render(exemplars=exemplars)
+                         for m in self.families()) + "\n"
+
+
+def render_merged(*registries: Registry,
+                  exemplars: bool = False) -> str:
+    """One exposition page over several registries (node registry
+    first, then e.g. the process-global DEFAULT).  A family name
+    already emitted is skipped so the page never carries duplicate
+    TYPE lines."""
+    seen: set[str] = set()
+    out: list[str] = []
+    for reg in registries:
+        if reg is None:
+            continue
+        for m in reg.families():
+            if m.name in seen:
+                continue
+            seen.add(m.name)
+            out.append(m.render(exemplars=exemplars))
+    return "\n".join(out) + "\n"
 
 
 # The process-global registry (reference: the Prometheus default
 # registerer); nodes may also construct private registries in tests.
+# The crypto layer's batch-verify histograms and the TPU-dispatch
+# breaker state live here (they have no node context) — the node's
+# /metrics endpoint merges this registry in via render_merged().
 DEFAULT = Registry()
 
 

@@ -63,7 +63,7 @@ class Recorder:
 
     def __init__(self, buffer_size: int = 4096, enabled: bool = True,
                  categories: Optional[str] = None,
-                 dump_dir: str = "."):
+                 dump_dir: str = ""):
         self.buffer_size = max(1, int(buffer_size))
         self.enabled = enabled
         # None = every category; else the enabled set
@@ -143,6 +143,21 @@ class Recorder:
             self._rings.clear()
 
     # -- the black box -----------------------------------------------
+    def resolved_dump_dir(self) -> str:
+        """Where automatic dumps land.  A node wires its data dir (or
+        the explicit ``instrumentation.dump_dir``); a bare recorder —
+        unit tests, tools, library embedders that never call
+        configure() — falls back to $COMETBFT_TPU_DUMP_DIR, then the
+        system temp dir.  Never the process CWD: supervisor give-up
+        dumps from test runs used to litter the repository root."""
+        if self.dump_dir:
+            return self.dump_dir
+        env = os.environ.get("COMETBFT_TPU_DUMP_DIR", "")
+        if env:
+            return env
+        import tempfile
+        return tempfile.gettempdir()
+
     def dump(self, reason: str = "", path: str = "",
              extra: Optional[dict] = None) -> str:
         """Write the whole flight record to a JSON file and return its
@@ -156,7 +171,7 @@ class Recorder:
                 slug = "".join(c if c.isalnum() or c in "-_" else "-"
                                for c in reason)[:48] or "flight"
                 path = os.path.join(
-                    self.dump_dir or ".",
+                    self.resolved_dump_dir(),
                     f"flight-{os.getpid()}-{seq:03d}-{slug}.json")
             record = {
                 "reason": reason,
@@ -299,7 +314,7 @@ def clear() -> None:
 
 def configure(enabled: bool = True, buffer_size: int = 4096,
               categories: Optional[str] = None,
-              dump_dir: str = ".") -> Recorder:
+              dump_dir: str = "") -> Recorder:
     """(Re)configure the process-global recorder — called by the node
     from instrumentation.trace_* config.  Existing rings are dropped
     so the new buffer size takes effect."""

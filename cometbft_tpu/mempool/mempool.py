@@ -261,11 +261,15 @@ class CListMempool(Mempool):
             self.metrics.already_received_txs.add()
             raise TxInCacheError("tx already exists in cache")
         try:
+            import time as _time
+            _t0 = _time.perf_counter()
             with tracing.span(tracing.MEMPOOL, "checktx",
                               height=self.height, bytes=len(tx)):
                 res = await self.proxy_app.check_tx(
                     abci.CheckTxRequest(
                         tx=tx, type=abci.CHECK_TX_TYPE_CHECK))
+            self.metrics.checktx_duration_seconds.observe(
+                _time.perf_counter() - _t0)
         except Exception:
             self.cache.remove(key)
             raise
@@ -417,8 +421,9 @@ class CListMempool(Mempool):
             with tracing.span(tracing.MEMPOOL, "recheck",
                               height=height, txs=self.size()):
                 await self._recheck_txs()
-            self.metrics.recheck_duration_seconds.set(
-                _time.perf_counter() - t0)
+            dt = _time.perf_counter() - t0
+            self.metrics.recheck_duration_seconds.set(dt)
+            self.metrics.recheck_pass_duration_seconds.observe(dt)
         self.metrics.update_sizes(self)
         self._notify_txs_available()
 

@@ -44,6 +44,20 @@ class Metrics:
         self.recheck_duration_seconds = m.gauge(
             "mempool", "recheck_duration_seconds",
             "Duration of the last recheck pass.")
+        # metrics v2: latency distributions for the two mempool hot
+        # paths — per-CheckTx app round-trips and whole recheck passes
+        # (the last-value gauge above stays for reference parity)
+        self.checktx_duration_seconds = m.histogram(
+            "mempool", "checktx_duration_seconds",
+            "Histogram of CheckTx app round-trip latency in seconds.",
+            buckets=(0.0001, 0.0005, 0.001, 0.005, 0.01, 0.05, 0.1,
+                     0.5, 1.0, 5.0))
+        self.recheck_pass_duration_seconds = m.histogram(
+            "mempool", "recheck_pass_duration_seconds",
+            "Histogram of full post-commit recheck pass duration in "
+            "seconds.",
+            buckets=(0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 2.5,
+                     5.0, 10.0))
         self.already_received_txs = m.counter(
             "mempool", "already_received_txs",
             "Number of duplicate transaction receptions (cache "

@@ -83,12 +83,15 @@ class Node:
 
         # flight recorder (libs/tracing.py): the always-on span rings
         # every subsystem appends to; crash dumps land in the data dir
+        # unless instrumentation.dump_dir points elsewhere
         from ..libs import tracing
+        dump_dir = config.instrumentation.dump_dir
         tracing.configure(
             enabled=config.instrumentation.trace_enabled,
             buffer_size=config.instrumentation.trace_buffer_size,
             categories=config.instrumentation.trace_categories or None,
-            dump_dir=db_dir)
+            dump_dir=config.base.path(dump_dir) if dump_dir
+            else db_dir)
         from ..types import signature_cache
         signature_cache.set_default_capacity(
             config.base.signature_cache_size)

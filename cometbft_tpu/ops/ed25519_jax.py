@@ -39,6 +39,28 @@ _CACHE_CONFIGURED = False
 # flight-recorder span carries warm=False for it
 _SEEN_SHAPES: set[tuple[str, int]] = set()
 
+_DISPATCH_HIST = None
+
+
+def _dispatch_histogram():
+    """metrics v2: host_prep vs kernel_execute latency split per pad
+    bucket, on the process-global registry (the chunk dispatcher has
+    no node context; /metrics merges DEFAULT in).  ``warm`` separates
+    first-dispatch compiles from steady-state execution so the
+    execute distribution is not polluted by one-off trace+compile."""
+    global _DISPATCH_HIST
+    if _DISPATCH_HIST is None:
+        from ..libs import metrics as libmetrics
+        _DISPATCH_HIST = libmetrics.DEFAULT.histogram(
+            "crypto", "kernel_dispatch_seconds",
+            "ed25519 kernel dispatch phases (host_prep / "
+            "kernel_execute) in seconds, by kernel, pad bucket and "
+            "warm-shape flag.",
+            labels=("phase", "kernel", "pad_bucket", "warm"),
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 5.0, 30.0, 120.0))
+    return _DISPATCH_HIST
+
 
 def enable_compilation_cache() -> None:
     """Point JAX's persistent compilation cache at a repo-local,
@@ -371,17 +393,26 @@ def _verify_chunk(items) -> np.ndarray:
     choice = _kernel_choice()
     if choice.startswith("pallas"):
         m = max(m, _pallas_module(choice).BLOCK)
+    import time as _time
+    warm = (choice, m) in _SEEN_SHAPES
+    hist = _dispatch_histogram()
+    t0 = _time.perf_counter()
     with tracing.span(tracing.CRYPTO, "host_prep", batch=n,
                       bucket=m):
         a_b, r_b, s_win, k_win, pre_bad = prep_arrays(items, m)
+    t1 = _time.perf_counter()
     # compile-vs-execute attribution: the first dispatch of a
     # (kernel, bucket) shape includes trace+compile (unless the AOT
     # artifact or persistent cache serves it); warm dispatches are
     # pure execution
-    warm = (choice, m) in _SEEN_SHAPES
     with tracing.span(tracing.CRYPTO, "kernel_execute", batch=n,
                       bucket=m, kernel=choice, warm=warm):
         out = _dispatch(n, a_b, r_b, s_win, k_win, pre_bad)
+    t2 = _time.perf_counter()
+    w = "1" if warm else "0"
+    hist.with_labels("host_prep", choice, str(m), w).observe(t1 - t0)
+    hist.with_labels("kernel_execute", choice, str(m),
+                     w).observe(t2 - t1)
     _SEEN_SHAPES.add((choice, m))
     return out
 

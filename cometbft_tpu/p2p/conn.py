@@ -104,6 +104,8 @@ class MConnection:
         self._pending_bytes = 0
         self._sconn = sconn
         self._channels = {d.id: _Channel(d) for d in channels}
+        for d in channels:
+            self.metrics.touch_channel(f"{d.id:#x}")
         self._on_receive = on_receive
         self._on_error = on_error
         # token-bucket flow control, 5 MB/s defaults (reference:
@@ -150,6 +152,8 @@ class MConnection:
             # which peer/channel backpressured a height
             tracing.instant(tracing.P2P, "send_queue_full",
                             chan=channel_id, peer=self.peer_id[:12])
+            self.metrics.send_queue_drops.with_labels(
+                f"{channel_id:#x}").add()
             return False
         self._pending_bytes += len(msg)
         self.metrics.peer_pending_send_bytes.with_labels(
@@ -161,7 +165,16 @@ class MConnection:
         ch = self._channels.get(channel_id)
         if ch is None or self._closed:
             return False
-        await ch.send_queue.put(msg)
+        if ch.send_queue.full():
+            # the queue-stall distribution: how long a blocking send
+            # waited for queue space on this channel
+            _t0 = asyncio.get_running_loop().time()
+            await ch.send_queue.put(msg)
+            self.metrics.queue_stall_seconds.with_labels(
+                f"{channel_id:#x}").observe(
+                asyncio.get_running_loop().time() - _t0)
+        else:
+            await ch.send_queue.put(msg)
         self._pending_bytes += len(msg)
         self.metrics.peer_pending_send_bytes.with_labels(
             self.peer_id).set(self._pending_bytes)
@@ -198,6 +211,8 @@ class MConnection:
                 if _dt > 0:
                     self.metrics.send_rate_limiter_delay.with_labels(
                         self.peer_id).add(_dt)
+                    self.metrics.queue_stall_seconds.with_labels(
+                        f"{ch.desc.id:#x}").observe(_dt)
                     tracing.instant(tracing.P2P, "send_rate_stall",
                                     chan=ch.desc.id,
                                     peer=self.peer_id[:12],
@@ -209,6 +224,8 @@ class MConnection:
                                     chan=ch.desc.id,
                                     peer=self.peer_id[:12],
                                     bytes=ch.last_msg_len)
+                    self.metrics.message_send_size_bytes.with_labels(
+                        f"{ch.desc.id:#x}").observe(ch.last_msg_len)
                 self.metrics.message_send_bytes_total.with_labels(
                     f"{ch.desc.id:#x}").add(len(pkt))
                 self._pending_bytes = max(
@@ -263,6 +280,9 @@ class MConnection:
                                         chan=chan_id,
                                         peer=self.peer_id[:12],
                                         bytes=len(complete))
+                        self.metrics.message_recv_size_bytes \
+                            .with_labels(f"{chan_id:#x}").observe(
+                                len(complete))
                         await self._on_receive(chan_id, complete)
                 else:
                     raise MConnectionError(

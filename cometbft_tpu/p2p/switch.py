@@ -185,6 +185,10 @@ class Switch:
                     f"channel {desc.id:#x} already claimed")
             self._chan_to_reactor[desc.id] = reactor
             self._channel_descs.append(desc)
+            # per-channel size/stall distributions exist from reactor
+            # registration on, not from the first peer — a zero-peer
+            # node still scrapes the full bucket ladders
+            self.metrics.touch_channel(f"{desc.id:#x}")
         self.reactors[reactor.name] = reactor
         reactor.switch = self
 

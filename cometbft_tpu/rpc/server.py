@@ -79,12 +79,10 @@ class RPCServer:
                     k, _, v = line.decode().partition(":")
                     headers[k.strip().lower()] = v.strip()
                 if http_method_is_metrics(method, target):
-                    payload = self.node.metrics_registry.render().encode() \
-                        if getattr(self.node, "metrics_registry", None) \
-                        else b"# metrics disabled\n"
+                    payload, ctype = self._render_metrics(target)
                     writer.write(
                         b"HTTP/1.1 200 OK\r\n"
-                        b"Content-Type: text/plain; version=0.0.4\r\n"
+                        b"Content-Type: " + ctype + b"\r\n"
                         b"Content-Length: " +
                         str(len(payload)).encode() + b"\r\n"
                         b"Connection: keep-alive\r\n\r\n" + payload)
@@ -125,6 +123,30 @@ class RPCServer:
                 writer.close()
             except Exception:
                 pass
+
+    def _render_metrics(self, target: str) -> tuple[bytes, bytes]:
+        """The Prometheus exposition page: the node registry merged
+        with the process-global DEFAULT (crypto batch-verify /
+        kernel-dispatch histograms, breaker state — families fed below
+        the node seam).  ``?exemplars=1`` switches to OpenMetrics with
+        per-bucket trace-height exemplars."""
+        reg = getattr(self.node, "metrics_registry", None)
+        if reg is None:
+            return b"# metrics disabled\n", b"text/plain; version=0.0.4"
+        from ..libs import metrics as libmetrics
+        try:
+            params = dict(parse_qsl(urlsplit(target).query))
+        except ValueError:
+            params = {}
+        exemplars = params.get("exemplars", "") in ("1", "true")
+        payload = libmetrics.render_merged(
+            reg, libmetrics.DEFAULT, exemplars=exemplars).encode()
+        if exemplars:
+            # OpenMetrics requires the explicit EOF terminator —
+            # conforming parsers reject a page without it as truncated
+            return payload + b"# EOF\n", \
+                b"application/openmetrics-text; version=1.0.0"
+        return payload, b"text/plain; version=0.0.4"
 
     async def _dispatch(self, http_method: str, target: str,
                         body: bytes) -> dict:

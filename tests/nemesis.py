@@ -384,30 +384,69 @@ class Scenario:
     recovery_timeout_s: float = 90.0
 
 
+def archive_dir() -> str:
+    """Where failed-scenario flight records are archived.  Default is
+    a repo-ignored ./nemesis-archive so CI can upload the directory as
+    an artifact; override with COMETBFT_TPU_NEMESIS_ARCHIVE_DIR."""
+    import os
+    return os.environ.get("COMETBFT_TPU_NEMESIS_ARCHIVE_DIR",
+                          "nemesis-archive")
+
+
+def _archive_flight_record(s: Scenario, exc: BaseException) -> str:
+    """A failing scenario (liveness miss, safety violation, runner
+    crash) archives the whole flight recorder, named after the
+    scenario and seed — liveness regressions in the slow sweeps come
+    with per-height timelines attached (ROADMAP open item).  Never
+    raises; returns the path or ""."""
+    import os
+
+    from cometbft_tpu.libs import tracing
+    slug = "".join(c if c.isalnum() or c in "-_" else "-"
+                   for c in s.name)[:64] or "scenario"
+    path = os.path.join(archive_dir(),
+                        f"nemesis-{slug}-seed{s.seed}.json")
+    return tracing.dump(
+        reason=f"nemesis_scenario_failure_{slug}", path=path,
+        extra={"scenario": s.name, "seed": s.seed, "n": s.n,
+               "fuzz": s.fuzz, "steps": [list(map(str, st))
+                                         for st in s.steps],
+               "error": repr(exc)[:500]})
+
+
 async def run_scenario(s: Scenario) -> NemesisNet:
     net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz)
     await net.start()
     try:
-        for step in s.steps:
-            await net.apply(step)
-        # quiesce the load so the (single-core) recovery check
-        # measures consensus catchup, not tx-throughput contention
-        net._load_stop.set()
-        # heal the world, then require recovery
-        await net.heal_links()
-        if s.fuzz is not None:
-            # link noise "heals" too: new connections are clean, and
-            # the old (noise-poisoned) ones are replaced
-            net.fuzz_profile = None
-            await net.reset_all_links()
-        for node in net.nodes:
-            if not node.running:
-                await node.start()
-        await net.connect_full_mesh()
-        h0 = net.max_height()
-        await net.wait_all_height(h0 + s.recovery_blocks,
-                                  s.recovery_timeout_s)
-        net.assert_no_conflicting_commits()
+        try:
+            for step in s.steps:
+                await net.apply(step)
+            # quiesce the load so the (single-core) recovery check
+            # measures consensus catchup, not tx-throughput contention
+            net._load_stop.set()
+            # heal the world, then require recovery
+            await net.heal_links()
+            if s.fuzz is not None:
+                # link noise "heals" too: new connections are clean,
+                # and the old (noise-poisoned) ones are replaced
+                net.fuzz_profile = None
+                await net.reset_all_links()
+            for node in net.nodes:
+                if not node.running:
+                    await node.start()
+            await net.connect_full_mesh()
+            h0 = net.max_height()
+            await net.wait_all_height(h0 + s.recovery_blocks,
+                                      s.recovery_timeout_s)
+            net.assert_no_conflicting_commits()
+        except BaseException as e:
+            if not isinstance(e, asyncio.CancelledError):
+                path = _archive_flight_record(s, e)
+                if path and isinstance(e, AssertionError):
+                    raise AssertionError(
+                        f"{e}\nflight record archived: {path}") \
+                        from e
+            raise
     finally:
         await net.stop()
     return net

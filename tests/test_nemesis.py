@@ -102,6 +102,44 @@ class TestNemesisScenarios:
             recovery_blocks=2)))
 
 
+class TestFailureArchive:
+    def test_failed_scenario_archives_flight_record(
+            self, tmp_path, monkeypatch):
+        """A scenario that misses its liveness budget must leave a
+        flight-record archive named after the scenario+seed (ROADMAP
+        open item: liveness regressions come with timelines
+        attached)."""
+        import json
+        import os
+
+        from cometbft_tpu.libs import tracing
+
+        monkeypatch.setenv("COMETBFT_TPU_NEMESIS_ARCHIVE_DIR",
+                           str(tmp_path))
+        old = tracing.set_recorder(tracing.Recorder())
+        try:
+            with pytest.raises(AssertionError) as exc_info:
+                run(run_scenario(Scenario(
+                    name="archive-probe",
+                    seed=41,
+                    # unreachable liveness target: fail fast
+                    recovery_blocks=10_000,
+                    recovery_timeout_s=0.2)))
+        finally:
+            tracing.set_recorder(old)
+        path = os.path.join(
+            str(tmp_path), "nemesis-archive-probe-seed41.json")
+        assert os.path.exists(path), os.listdir(str(tmp_path))
+        assert str(path) in str(exc_info.value)
+        with open(path) as f:
+            record = json.load(f)
+        assert record["extra"]["scenario"] == "archive-probe"
+        assert record["extra"]["seed"] == 41
+        assert "liveness" in record["extra"]["error"]
+        # the archive carries a real timeline, not an empty ring
+        assert record["events"], "archived flight record is empty"
+
+
 @pytest.mark.slow
 class TestNemesisSweeps:
     def test_partition_sweep_seeded(self):

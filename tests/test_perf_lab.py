@@ -1,0 +1,159 @@
+"""Perf lab: the regression-gated microbenchmark suite
+(tools/perf_lab.py + the committed perf_baseline.json).
+
+Tier-1 runs the fast subset against the committed baseline so a perf
+regression in a hot primitive fails CI, and proves the gate actually
+trips by injecting a slowed path.
+"""
+import importlib.util
+import json
+import os
+
+import pytest
+
+_ROOT = os.path.join(os.path.dirname(__file__), "..")
+_BASELINE = os.path.join(_ROOT, "perf_baseline.json")
+
+
+def _load_perf_lab():
+    spec = importlib.util.spec_from_file_location(
+        "perf_lab", os.path.join(_ROOT, "tools", "perf_lab.py"))
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestBaselineFile:
+    def test_committed_baseline_is_valid(self):
+        pl = _load_perf_lab()
+        base = pl.load_baseline(_BASELINE)
+        assert base["schema"] == pl.SCHEMA
+        assert base["benchmarks"], "baseline has no benchmarks"
+        for name, b in base["benchmarks"].items():
+            assert name in pl.BENCHMARKS, \
+                f"baseline names unknown benchmark {name!r}"
+            assert b["min_ms"] > 0
+        # every benchmark in the suite is gated
+        missing = set(pl.BENCHMARKS) - set(base["benchmarks"])
+        assert not missing, \
+            f"benchmarks not in baseline (rebaseline): {missing}"
+
+    def test_fast_subset_covers_tier1_surfaces(self):
+        pl = _load_perf_lab()
+        fast = {n for n, (_, in_fast) in pl.BENCHMARKS.items()
+                if in_fast}
+        # the tier-1 gate must cover the verify, hash, encode,
+        # observability-overhead and p2p surfaces
+        for want in ("batch_verify_cpu_pad64", "merkle_root_1024",
+                     "vote_sign_bytes", "signature_cache_hit",
+                     "metrics_observe", "tracing_disabled_span",
+                     "p2p_loopback_send"):
+            assert want in fast
+
+
+class TestRegressionGate:
+    def test_check_fast_passes_against_committed_baseline(self):
+        """The tier-1 perf gate: the fast subset on this container
+        must be within tolerance of the committed baseline."""
+        pl = _load_perf_lab()
+        report = pl.run_suite(fast=True)
+        ok, lines = pl.check_report(
+            report, pl.load_baseline(_BASELINE))
+        assert ok, "perf regression beyond tolerance:\n" + \
+            "\n".join(lines)
+
+    def test_injected_slow_path_fails_check(self):
+        """The gate demonstrably trips: slow one benchmark past its
+        tolerance and check must FAIL on exactly that benchmark."""
+        import time
+
+        pl = _load_perf_lab()
+        base = pl.load_baseline(_BASELINE)
+        tol = float(base["benchmarks"]["merkle_root_1024"].get(
+            "tolerance", base["default_tolerance"]))
+        slow_s = base["benchmarks"]["merkle_root_1024"]["min_ms"] \
+            * tol * 2 / 1e3
+
+        real_fn, in_fast = pl.BENCHMARKS["merkle_root_1024"]
+
+        def slowed(fast):
+            from cometbft_tpu.crypto.merkle import (
+                hash_from_byte_slices,
+            )
+            leaves = [(b"%08d" % i) * 32 for i in range(1024)]
+
+            def run():
+                time.sleep(slow_s)          # the injected regression
+                hash_from_byte_slices(leaves)
+            return pl.measure(run, reps=2, warmup=0)
+
+        pl.BENCHMARKS["merkle_root_1024"] = (slowed, in_fast)
+        try:
+            report = pl.run_suite(
+                fast=True, only={"merkle_root_1024",
+                                 "vote_sign_bytes"})
+            ok, lines = pl.check_report(report, base)
+        finally:
+            pl.BENCHMARKS["merkle_root_1024"] = (real_fn, in_fast)
+        assert not ok
+        assert any("REGRESSED merkle_root_1024" in ln
+                   for ln in lines), lines
+
+    def test_missing_benchmark_fails_full_check(self):
+        pl = _load_perf_lab()
+        base = pl.load_baseline(_BASELINE)
+        report = pl.run_suite(fast=True,
+                              only={"tracing_disabled_span"})
+        report["mode"] = "full"     # claim full coverage, deliver one
+        report.pop("only")          # ...without declaring a subset
+        ok, lines = pl.check_report(report, base)
+        assert not ok
+        assert any(ln.startswith("MISSING") for ln in lines)
+
+    def test_only_subset_gates_only_what_ran(self):
+        """`check --only X` must not fail on benchmarks it was told
+        not to run."""
+        pl = _load_perf_lab()
+        base = pl.load_baseline(_BASELINE)
+        report = pl.run_suite(fast=True,
+                              only={"tracing_disabled_span"})
+        ok, lines = pl.check_report(report, base)
+        assert ok, lines
+        assert not any(ln.startswith("MISSING") for ln in lines)
+
+
+class TestReportShape:
+    def test_report_json_is_stable_and_complete(self, tmp_path):
+        pl = _load_perf_lab()
+        report = pl.run_suite(fast=True,
+                              only={"metrics_observe",
+                                    "tracing_disabled_span"})
+        assert report["schema"] == pl.SCHEMA
+        for stats in report["benchmarks"].values():
+            for k in ("p50_ms", "min_ms", "mean_ms", "reps",
+                      "inner"):
+                assert k in stats
+        # rebaseline writes a loadable baseline preserving per-bench
+        # tolerances
+        out = tmp_path / "base.json"
+        with open(out, "w") as f:
+            json.dump({"schema": pl.SCHEMA, "default_tolerance": 6.0,
+                       "benchmarks": {"metrics_observe": {
+                           "min_ms": 1.0, "tolerance": 2.5}}}, f)
+        new = pl.rebaseline(report, str(out))
+        assert new["benchmarks"]["metrics_observe"]["tolerance"] \
+            == 2.5
+        reread = pl.load_baseline(str(out))
+        assert set(reread["benchmarks"]) == set(report["benchmarks"])
+
+
+@pytest.mark.slow
+class TestFullSuite:
+    def test_full_check_passes(self):
+        """The full suite (incl. the pad-1024 batch shape) against
+        the committed baseline — what perf PRs run before/after."""
+        pl = _load_perf_lab()
+        report = pl.run_suite(fast=False)
+        ok, lines = pl.check_report(
+            report, pl.load_baseline(_BASELINE))
+        assert ok, "\n".join(lines)

@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Generate the metrics catalog for docs/observability.md.
+
+Assembles every metric family a node registers (all per-subsystem
+Metrics classes on one registry, plus the lazily-registered families
+on the process-global DEFAULT: crypto batch-verify / kernel-dispatch
+histograms, breaker state, signature-cache counters) and prints a
+markdown table of name, type, labels and help — the docs section is
+pasted from this output, and the exposition contract test keeps the
+registry honest (non-empty help, bounded labels).
+
+Usage: python tools/metrics_catalog.py [--markdown|--json]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+
+def collect_catalog() -> list[dict]:
+    from cometbft_tpu.abci.metrics import Metrics as ProxyMetrics
+    from cometbft_tpu.blocksync.metrics import (
+        Metrics as BlocksyncMetrics,
+    )
+    from cometbft_tpu.consensus.metrics import (
+        Metrics as ConsensusMetrics,
+    )
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.libs import metrics as libmetrics
+    from cometbft_tpu.libs.supervisor import (
+        Metrics as SupervisorMetrics,
+    )
+    from cometbft_tpu.mempool.metrics import Metrics as MempoolMetrics
+    from cometbft_tpu.ops import ed25519_jax
+    from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
+    from cometbft_tpu.state.metrics import Metrics as StateMetrics
+    from cometbft_tpu.statesync.metrics import (
+        Metrics as StatesyncMetrics,
+    )
+    from cometbft_tpu.types import signature_cache
+
+    reg = libmetrics.Registry()
+    for cls in (ConsensusMetrics, MempoolMetrics, P2PMetrics,
+                BlocksyncMetrics, StatesyncMetrics, StateMetrics,
+                ProxyMetrics, SupervisorMetrics):
+        cls(reg)
+    # force the lazy process-global families into existence
+    crypto_batch.verify_seconds_histogram()
+    crypto_batch.tpu_breaker()
+    ed25519_jax._dispatch_histogram()
+    signature_cache._metrics()
+
+    seen = set()
+    out = []
+    for fam in reg.collect() + libmetrics.DEFAULT.collect():
+        if fam["name"] in seen:
+            continue
+        seen.add(fam["name"])
+        out.append(fam)
+    return sorted(out, key=lambda f: f["name"])
+
+
+def to_markdown(catalog: list[dict]) -> str:
+    lines = ["| Name | Type | Labels | Help |",
+             "|------|------|--------|------|"]
+    for fam in catalog:
+        labels = ", ".join(f"`{l}`" for l in fam["labels"]) or "—"
+        help_ = fam["help"].replace("\n", " ").replace("|", "\\|")
+        lines.append(
+            f"| `{fam['name']}` | {fam['kind']} | {labels} "
+            f"| {help_} |")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--json", action="store_true",
+                    help="JSON instead of markdown")
+    args = ap.parse_args(argv)
+    catalog = collect_catalog()
+    if args.json:
+        print(json.dumps(catalog, indent=2))
+    else:
+        print(to_markdown(catalog))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

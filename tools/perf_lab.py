@@ -1,0 +1,425 @@
+#!/usr/bin/env python
+"""Perf lab: pinned microbenchmark suite with regression gating.
+
+Every ROADMAP perf-backlog item must land "with before/after
+breakdowns" — this is the harness that produces them.  The suite pins
+the hot primitives the node's latency decomposes into (the same
+decomposition the flight recorder attributes per height):
+
+  * ``batch_verify_cpu_pad*``  — CPU ed25519 batch verification at the
+    kernel pad-bucket batch shapes (crypto/batch.py PAD_BUCKETS);
+  * ``merkle_root_1024``       — the block-hash primitive;
+  * ``vote_sign_bytes``        — canonical vote encoding (every sign
+    and every verify path builds these bytes);
+  * ``signature_cache_hit``    — the verification fast path;
+  * ``metrics_observe``        — histogram+labeled-counter cost per
+    observation (the metrics-v2 overhead budget);
+  * ``tracing_disabled_span``  — the flight-recorder disabled path
+    (tier-1 separately guards < 1µs);
+  * ``p2p_loopback_send``      — MConnection framing/scheduling cost
+    per message over an in-memory pipe (no sockets, no crypto).
+
+Modes:
+  run                 run the suite, print a JSON report
+  check               run + diff against the committed baseline;
+                      exit 1 when any benchmark regresses beyond its
+                      tolerance (per-benchmark ``tolerance`` in the
+                      baseline, else ``default_tolerance``)
+  rebaseline          run + rewrite the baseline file
+
+``--fast`` runs the tier-1 subset (seconds, not minutes); the full
+suite is what perf PRs attach before/after reports from.  The gate
+compares per-op ``min_ms`` (the most noise-robust statistic on a
+shared CI box; p50/mean ride along in reports for humans) with
+generous multiplier tolerances — it catches order-of-magnitude
+regressions (an accidental O(n^2), a dropped cache), not 10% drift.
+
+Usage for a perf PR: ``python tools/perf_lab.py run > before.json``,
+apply the change, run again, put both numbers in the PR description,
+and ``rebaseline`` if the improvement should become the new floor.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO_ROOT not in sys.path:
+    sys.path.insert(0, _REPO_ROOT)
+
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "perf_baseline.json")
+SCHEMA = 1
+DEFAULT_TOLERANCE = 6.0
+
+
+# ---------------------------------------------------------------------
+# measurement core
+
+def measure(fn, reps: int, inner: int = 1,
+            setup=None, warmup: int = 1) -> dict:
+    """Time ``fn`` (called with the value returned by ``setup``, if
+    any) ``reps`` times, ``inner`` calls per rep; returns per-op
+    millisecond stats.  ``warmup`` leading reps are discarded — on a
+    throttled shared box the first iterations of a native-heavy loop
+    run several times slower than steady state (cold caches, branch
+    predictors, CPU frequency ramp)."""
+    arg = setup() if setup is not None else None
+    call = (lambda: fn(arg)) if setup is not None else fn
+    durations = []
+    for rep in range(reps + warmup):
+        t0 = time.perf_counter()
+        for _ in range(inner):
+            call()
+        dt = (time.perf_counter() - t0) / inner
+        if rep >= warmup:
+            durations.append(dt)
+    durations.sort()
+    return {
+        "p50_ms": round(statistics.median(durations) * 1e3, 6),
+        "min_ms": round(durations[0] * 1e3, 6),
+        "mean_ms": round(statistics.fmean(durations) * 1e3, 6),
+        "reps": reps,
+        "inner": inner,
+    }
+
+
+# ---------------------------------------------------------------------
+# benchmarks.  Each entry: name -> (fn(fast: bool) -> stats dict,
+# in_fast_subset).  tests/test_perf_lab.py monkeypatches this table to
+# prove the regression gate trips.
+
+def _make_sigs(n: int):
+    from cometbft_tpu.crypto import ed25519
+    sk = ed25519.gen_priv_key()
+    pk = sk.pub_key()
+    msgs = [b"perf-lab-msg-%d" % i for i in range(n)]
+    return [(pk, m, sk.sign(m)) for pk, m in
+            ((pk, m) for m in msgs)]
+
+
+def bench_batch_verify_cpu(batch: int, reps: int):
+    from cometbft_tpu.crypto import ed25519
+
+    def setup():
+        return _make_sigs(batch)
+
+    def run(items):
+        bv = ed25519.CpuBatchVerifier()
+        for pk, m, s in items:
+            bv.add(pk, m, s)
+        ok, _ = bv.verify()
+        if not ok:
+            raise RuntimeError("benchmark signatures failed to verify")
+
+    stats = measure(run, reps=reps, setup=setup, warmup=4)
+    stats["batch"] = batch
+    return stats
+
+
+def bench_batch_verify_pad64(fast: bool):
+    return bench_batch_verify_cpu(batch=64, reps=4 if fast else 6)
+
+
+def bench_batch_verify_pad1024(fast: bool):
+    # 256 signatures dispatch at the 1024 pad bucket
+    return bench_batch_verify_cpu(batch=256, reps=3)
+
+
+def bench_merkle_root(fast: bool):
+    from cometbft_tpu.crypto.merkle import hash_from_byte_slices
+    leaves = [(b"%08d" % i) * 32 for i in range(1024)]
+    return measure(lambda: hash_from_byte_slices(leaves),
+                   reps=10 if fast else 30, inner=3)
+
+
+def bench_vote_sign_bytes(fast: bool):
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+    bid = BlockID(hash=b"\xab" * 32,
+                  part_set_header=PartSetHeader(total=1,
+                                                hash=b"\xcd" * 32))
+    ts = Timestamp(1700000000, 123456789)
+    return measure(
+        lambda: canonical.vote_sign_bytes(
+            "perf-lab-chain", canonical.PRECOMMIT_TYPE, 12345, 2,
+            bid, ts),
+        reps=5 if fast else 15, inner=500)
+
+
+def bench_signature_cache_hit(fast: bool):
+    from cometbft_tpu.types.signature_cache import (
+        SignatureCache, SignatureCacheValue,
+    )
+    cache = SignatureCache(capacity=4096)
+    sigs = [os.urandom(64) for _ in range(512)]
+    for s in sigs:
+        cache.add(s, SignatureCacheValue(s[:20], s[:32]))
+
+    def run():
+        for s in sigs:
+            if cache.get(s) is None:
+                raise RuntimeError("expected a cache hit")
+
+    stats = measure(run, reps=5 if fast else 15, inner=4)
+    # per-op: each run() call does len(sigs) lookups
+    for k in ("p50_ms", "min_ms", "mean_ms"):
+        stats[k] = round(stats[k] / len(sigs), 6)
+    return stats
+
+
+def bench_metrics_observe(fast: bool):
+    from cometbft_tpu.libs.metrics import Registry
+    reg = Registry()
+    hist = reg.histogram("perf", "lat", "perf-lab latency histogram",
+                         labels=("backend",))
+    ctr = reg.counter("perf", "ops", "perf-lab labeled counter",
+                      labels=("kind",))
+
+    def run():
+        hist.with_labels("cpu").observe(0.0123)
+        ctr.with_labels("bench").add()
+
+    return measure(run, reps=5 if fast else 15, inner=5000)
+
+
+def bench_tracing_disabled_span(fast: bool):
+    from cometbft_tpu.libs import tracing
+    old = tracing.set_recorder(tracing.Recorder(enabled=False))
+    try:
+        def run():
+            with tracing.span(tracing.CRYPTO, "bench"):
+                pass
+        return measure(run, reps=5 if fast else 15, inner=5000)
+    finally:
+        tracing.set_recorder(old)
+
+
+def bench_p2p_loopback_send(fast: bool):
+    import asyncio
+
+    from cometbft_tpu.p2p.conn import ChannelDescriptor, MConnection
+
+    n_msgs = 100 if fast else 400
+    payload = b"\x5a" * 1024
+
+    class _Pipe:
+        def __init__(self):
+            self._q: asyncio.Queue = asyncio.Queue()
+            self.peer: "_Pipe" = None          # type: ignore
+
+        async def write_msg(self, data: bytes) -> None:
+            await self.peer._q.put(bytes(data))
+
+        async def read_msg(self) -> bytes:
+            return await self._q.get()
+
+        def close(self) -> None:
+            pass
+
+    async def run_once() -> float:
+        a, b = _Pipe(), _Pipe()
+        a.peer, b.peer = b, a
+        got = asyncio.Event()
+        count = 0
+
+        async def on_recv(chan, msg):
+            nonlocal count
+            count += 1
+            if count >= n_msgs:
+                got.set()
+
+        async def nop_recv(chan, msg):
+            pass
+
+        descs = [ChannelDescriptor(id=0x30,
+                                   send_queue_capacity=n_msgs + 8)]
+        # rate 0 = unlimited: measure framing + scheduling, not the
+        # token bucket
+        tx = MConnection(a, descs, nop_recv, lambda e: None,
+                         send_rate=0, recv_rate=0, peer_id="tx")
+        rx = MConnection(b, descs, on_recv, lambda e: None,
+                         send_rate=0, recv_rate=0, peer_id="rx")
+        tx.start()
+        rx.start()
+        try:
+            t0 = time.perf_counter()
+            for _ in range(n_msgs):
+                await tx.send_blocking(0x30, payload)
+            await asyncio.wait_for(got.wait(), 30)
+            return (time.perf_counter() - t0) / n_msgs
+        finally:
+            tx.close()
+            rx.close()
+
+    reps = 3 if fast else 5
+    durations = sorted(asyncio.run(run_once())
+                       for _ in range(reps + 1))[: reps]
+    return {
+        "p50_ms": round(statistics.median(durations) * 1e3, 6),
+        "min_ms": round(durations[0] * 1e3, 6),
+        "mean_ms": round(statistics.fmean(durations) * 1e3, 6),
+        "reps": reps,
+        "inner": n_msgs,
+    }
+
+
+# name -> (fn, in_fast_subset)
+BENCHMARKS = {
+    "batch_verify_cpu_pad64": (bench_batch_verify_pad64, True),
+    "batch_verify_cpu_pad1024": (bench_batch_verify_pad1024, False),
+    "merkle_root_1024": (bench_merkle_root, True),
+    "vote_sign_bytes": (bench_vote_sign_bytes, True),
+    "signature_cache_hit": (bench_signature_cache_hit, True),
+    "metrics_observe": (bench_metrics_observe, True),
+    "tracing_disabled_span": (bench_tracing_disabled_span, True),
+    "p2p_loopback_send": (bench_p2p_loopback_send, True),
+}
+
+
+# ---------------------------------------------------------------------
+# modes
+
+def run_suite(fast: bool = False, only=None) -> dict:
+    results = {}
+    for name, (fn, in_fast) in BENCHMARKS.items():
+        if only and name not in only:
+            continue
+        if fast and not in_fast:
+            continue
+        results[name] = fn(fast)
+    return {
+        "schema": SCHEMA,
+        "mode": "fast" if fast else "full",
+        **({"only": sorted(only)} if only else {}),
+        "env": {
+            "python": sys.version.split()[0],
+            "platform": sys.platform,
+            "cpus": os.cpu_count(),
+        },
+        "benchmarks": results,
+    }
+
+
+def load_baseline(path: str) -> dict:
+    with open(path) as f:
+        base = json.load(f)
+    if base.get("schema") != SCHEMA:
+        raise ValueError(
+            f"baseline schema {base.get('schema')} != {SCHEMA}; "
+            f"rerun `perf_lab.py rebaseline`")
+    return base
+
+
+def check_report(report: dict, baseline: dict) -> tuple[bool, list]:
+    """Diff a run report against the baseline.  Returns (ok, lines).
+    A benchmark regresses when its current min_ms exceeds the
+    baseline min_ms times its tolerance; a benchmark in the baseline
+    but missing from the (non-fast-filtered) report fails too."""
+    default_tol = float(baseline.get("default_tolerance",
+                                     DEFAULT_TOLERANCE))
+    base_benches = baseline.get("benchmarks", {})
+    ok = True
+    lines = []
+    for name, stats in sorted(report["benchmarks"].items()):
+        base = base_benches.get(name)
+        if base is None:
+            lines.append(f"NEW   {name}: min {stats['min_ms']}ms "
+                         f"(not in baseline — rebaseline to gate it)")
+            continue
+        tol = float(base.get("tolerance", default_tol))
+        limit = base["min_ms"] * tol
+        cur = stats["min_ms"]
+        ratio = cur / base["min_ms"] if base["min_ms"] > 0 else 0.0
+        verdict = "ok   " if cur <= limit else "REGRESSED"
+        if cur > limit:
+            ok = False
+        lines.append(
+            f"{verdict} {name}: min {cur}ms vs baseline "
+            f"{base['min_ms']}ms (x{ratio:.2f}, limit x{tol:g})")
+    wanted = {n for n, (fn, in_fast) in BENCHMARKS.items()
+              if report["mode"] == "full" or in_fast}
+    if report.get("only"):
+        # an explicit --only subset only gates what it ran
+        wanted &= set(report["only"])
+    for name in sorted(set(base_benches) & wanted
+                       - set(report["benchmarks"])):
+        ok = False
+        lines.append(f"MISSING {name}: in baseline but did not run")
+    return ok, lines
+
+
+def rebaseline(report: dict, path: str,
+               default_tolerance: float = DEFAULT_TOLERANCE) -> dict:
+    prev_tols = {}
+    if os.path.exists(path):
+        try:
+            prev = load_baseline(path)
+            prev_tols = {n: b["tolerance"]
+                         for n, b in prev.get("benchmarks", {}).items()
+                         if "tolerance" in b}
+        except Exception:
+            pass
+    base = {
+        "schema": SCHEMA,
+        "default_tolerance": default_tolerance,
+        "generated_by": "tools/perf_lab.py rebaseline",
+        "env": report["env"],
+        "benchmarks": {
+            name: {"min_ms": stats["min_ms"],
+                   "p50_ms": stats["p50_ms"],
+                   **({"tolerance": prev_tols[name]}
+                      if name in prev_tols else {})}
+            for name, stats in sorted(report["benchmarks"].items())
+        },
+    }
+    with open(path, "w") as f:
+        json.dump(base, f, indent=2, sort_keys=True)
+        f.write("\n")
+    return base
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("mode", choices=("run", "check", "rebaseline"),
+                    nargs="?", default="run")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 subset (seconds, not minutes)")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--out", default="",
+                    help="also write the JSON report here")
+    ap.add_argument("--only", default="",
+                    help="comma-separated benchmark subset")
+    args = ap.parse_args(argv)
+
+    only = {s.strip() for s in args.only.split(",") if s.strip()} \
+        or None
+    report = run_suite(fast=args.fast, only=only)
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+
+    if args.mode == "run":
+        print(json.dumps(report, indent=2, sort_keys=True))
+        return 0
+    if args.mode == "rebaseline":
+        base = rebaseline(report, args.baseline)
+        print(f"baseline written: {args.baseline} "
+              f"({len(base['benchmarks'])} benchmarks)")
+        return 0
+    # check
+    baseline = load_baseline(args.baseline)
+    ok, lines = check_report(report, baseline)
+    print("\n".join(lines))
+    print("PASS" if ok else "FAIL: perf regression beyond tolerance")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
