@@ -137,8 +137,8 @@ class ConsensusState:
             # standalone (tests / light wiring): the receive routine
             # still runs supervisor-owned — a bare create_task would
             # die silently on the first uncaught exception, and the
-            # tier-1 AST check (tests/test_supervised_tasks_ast.py)
-            # locks that invariant for all reactor/node loops
+            # tier-1 bftlint supervised-spawn rule locks that
+            # invariant for all reactor/node loops
             from ..libs.supervisor import Supervisor
             self.supervisor = Supervisor("consensus",
                                          logger=self.logger)
@@ -248,8 +248,7 @@ class ConsensusState:
         if len(entries) >= 2:
             vote_mod.preverify_signatures(entries)
 
-    @staticmethod
-    def _append_vote_entries(entries, vote, pub_key,
+    def _append_vote_entries(self, entries, vote, pub_key,
                              chain_id: str) -> None:
         """Append a vote's signature triples (main + both extension
         signatures for non-nil precommits) for advisory batch
@@ -269,7 +268,9 @@ class ConsensusState:
                                 vote.non_rp_extension_sign_bytes(),
                                 vote.non_rp_extension_signature))
         except Exception:
-            pass
+            self.logger.debug(
+                "vote preverify: skipping malformed vote "
+                "(serial tally will report it)", exc_info=True)
 
     async def _handle_msg(self, msg, peer_id: str, internal: bool) -> None:
         # WAL-before-process (reference: state.go:886 handleMsg; internal
@@ -437,6 +438,10 @@ class ConsensusState:
         try:
             vals = self.block_exec.store.load_validators(commit.height)
         except Exception:
+            self.logger.debug(
+                "no stored validator set; falling back to "
+                "state.last_validators", height=commit.height,
+                exc_info=True)
             vals = state.last_validators
         votes = [commit.get_vote(i)
                  for i, cs in enumerate(commit.signatures)
@@ -463,6 +468,9 @@ class ConsensusState:
                 self._append_vote_entries(entries, v, val.pub_key,
                                           chain_id)
             except Exception:
+                self.logger.debug(
+                    "vote preverify: validator lookup failed "
+                    "(serial tally will report it)", exc_info=True)
                 continue
         if len(entries) >= 2:
             vote_mod.preverify_signatures(entries)

@@ -33,14 +33,17 @@ class Logger:
 
     def debug(self, msg: str, **kv: Any) -> None:
         if self._logger.isEnabledFor(logging.DEBUG):
-            self._logger.debug(self._fmt(msg, kv))
+            exc_info = kv.pop("exc_info", None)
+            self._logger.debug(self._fmt(msg, kv), exc_info=exc_info)
 
     def info(self, msg: str, **kv: Any) -> None:
         if self._logger.isEnabledFor(logging.INFO):
-            self._logger.info(self._fmt(msg, kv))
+            exc_info = kv.pop("exc_info", None)
+            self._logger.info(self._fmt(msg, kv), exc_info=exc_info)
 
     def warn(self, msg: str, **kv: Any) -> None:
-        self._logger.warning(self._fmt(msg, kv))
+        exc_info = kv.pop("exc_info", None)
+        self._logger.warning(self._fmt(msg, kv), exc_info=exc_info)
 
     def error(self, msg: str, **kv: Any) -> None:
         # exc_info is a directive for the underlying logger (log the

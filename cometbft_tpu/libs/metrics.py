@@ -284,6 +284,9 @@ class Histogram(_Metric):
             if h:
                 exemplar = {"trace_height": h}
         if exemplar:
+            # exemplar timestamps are exposition metadata — OpenMetrics
+            # requires wall clock — not interval arithmetic
+            # bftlint: disable=monotonic-clock
             self._exemplars[idx] = (v, time.time(), exemplar)
 
     def _child_samples(self, labels_prefix: str):

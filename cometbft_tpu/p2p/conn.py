@@ -318,4 +318,6 @@ class MConnection:
         try:
             self._on_error(e)
         except Exception:
-            pass
+            self.logger.error("on_error callback raised while "
+                              "handling connection failure",
+                              peer=self.peer_id, exc_info=True)

@@ -302,7 +302,10 @@ class PexReactor(Reactor):
                     try:
                         await sw.dial_peer(ka.dial_addr)
                         self.book.mark_good(ka.node_id)
-                    except Exception:
+                    except Exception as e:
+                        self.logger.debug(
+                            "pex dial failed", addr=ka.dial_addr,
+                            attempts=ka.attempts, err=str(e))
                         if ka.attempts > 10:
                             self.book.remove(ka.node_id)
         except asyncio.CancelledError:

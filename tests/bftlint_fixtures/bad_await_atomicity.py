@@ -1,0 +1,14 @@
+# bftlint: path=cometbft_tpu/consensus/fixture_state.py
+class ConsensusState:
+    async def enter_round(self, height, round_):
+        committed = self.rs.height
+        # the await is a suspension point: the ticker or a stop-peer
+        # one-shot may advance the round state before we resume
+        await self.signer.sign(committed)
+        self.rs.height = committed + 1
+
+    async def enter_step_aliased(self, round_):
+        rs = self.rs
+        proposal = rs.step
+        await self.signer.sign(proposal)
+        rs.step = proposal + 1

@@ -1,0 +1,12 @@
+# bftlint: path=cometbft_tpu/p2p/fixture.py
+import time
+
+
+class Conn:
+    async def backoff(self):
+        # one blocking sleep freezes every reactor on the loop
+        time.sleep(0.5)
+
+    async def snapshot(self, path):
+        with open(path, "w") as f:
+            f.write("state")

@@ -1,0 +1,15 @@
+# bftlint: path=cometbft_tpu/libs/fixture.py
+from pathlib import Path
+
+
+def dump(record, height):
+    # relative paths land in whatever CWD the node started from
+    with open(f"flight-{height}.json", "w") as f:
+        f.write(record)
+    Path("crash-report.txt").write_text(record)
+
+
+def patch(record):
+    # update mode writes too — "r+" has no w/a/x but lands in CWD
+    with open("state.json", "r+") as f:
+        f.write(record)

@@ -1,0 +1,11 @@
+# bftlint: path=cometbft_tpu/p2p/fixture.py
+import time
+
+
+class Tracker:
+    def touch(self):
+        # wall clock feeding interval arithmetic: NTP slew corrupts it
+        self.last_seen = time.time()
+
+    def stale(self, now):
+        return now - self.last_seen > 30.0

@@ -1,0 +1,15 @@
+# bftlint: path=cometbft_tpu/fixture/reactor.py
+import asyncio
+
+
+class Reactor:
+    async def start(self):
+        # bare spawn in reactor scope: crashes die silently
+        self._task = asyncio.create_task(self._routine())
+        asyncio.ensure_future(self._other())
+
+    async def _routine(self):
+        pass
+
+    async def _other(self):
+        pass

@@ -1,0 +1,26 @@
+def fetch(store, height):
+    try:
+        return store.load(height)
+    except Exception:
+        pass
+
+
+def tally(votes):
+    for v in votes:
+        try:
+            v.verify()
+        except:  # noqa: E722
+            continue
+
+
+def stop(task, stopped, seen, peer_id):
+    # .set()/.add() on non-metric receivers is still a swallow:
+    # signalling an event or caching an id does not surface the error
+    try:
+        task.cancel()
+    except Exception:
+        stopped.set()
+    try:
+        task.join()
+    except Exception:
+        seen.add(peer_id)

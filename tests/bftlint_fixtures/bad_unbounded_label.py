@@ -1,0 +1,6 @@
+class Reactor:
+    def on_recv(self, peer, msg, err):
+        # error strings and raw peer input are unbounded label values
+        self.metrics.recv_errors.with_labels(str(err)).inc()
+        self.metrics.recv_bytes.with_labels(
+            f"peer-{peer.remote_addr}").add(len(msg))

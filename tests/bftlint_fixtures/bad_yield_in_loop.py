@@ -1,0 +1,8 @@
+class Reactor:
+    async def _gossip_routine(self, peer):
+        while True:
+            # the PR 1 livelock shape: a persistently-true branch
+            # continues without ever yielding to the event loop
+            if peer.send_queue_full():
+                continue
+            await peer.send(self.next_part())

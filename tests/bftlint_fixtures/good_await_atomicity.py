@@ -1,0 +1,17 @@
+# bftlint: path=cometbft_tpu/consensus/fixture_state.py
+class ConsensusState:
+    async def enter_round(self, height, round_):
+        committed = self.rs.height
+        await self.signer.sign(committed)
+        # re-validation after the suspension point: the write only
+        # lands if the state is still the one we computed against
+        if self.rs.height != committed:
+            return
+        self.rs.height = committed + 1
+
+    async def enter_step_suppressed(self, round_):
+        step = self.rs.step
+        await self.signer.sign(step)
+        # single-writer architecture; see the baseline rationale
+        # bftlint: disable=await-atomicity
+        self.rs.step = step + 1

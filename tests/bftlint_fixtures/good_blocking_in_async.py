@@ -1,0 +1,19 @@
+# bftlint: path=cometbft_tpu/p2p/fixture.py
+import asyncio
+
+
+class Conn:
+    async def backoff(self):
+        await asyncio.sleep(0.5)
+
+    def snapshot_sync(self, path):
+        # sync context: blocking I/O is fine here
+        with open(path, "w") as f:
+            f.write("state")
+
+    async def flush_wal(self, path):
+        # synchronous durability point: the write-through fsync IS
+        # the correctness requirement
+        # bftlint: disable=blocking-in-async
+        with open(path, "a") as f:
+            f.write("entry")

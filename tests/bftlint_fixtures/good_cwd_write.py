@@ -1,0 +1,17 @@
+# bftlint: path=cometbft_tpu/libs/fixture.py
+import os
+import tempfile
+
+
+def dump(record, height, dump_dir):
+    path = os.path.join(dump_dir or tempfile.gettempdir(),
+                        f"flight-{height}.json")
+    with open(path, "w") as f:
+        f.write(record)
+
+
+def dump_here_on_purpose(record):
+    # a CLI report written to the invoker's CWD by contract
+    # bftlint: disable=cwd-write
+    with open("report.json", "w") as f:
+        f.write(record)

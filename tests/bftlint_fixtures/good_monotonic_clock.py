@@ -1,0 +1,13 @@
+# bftlint: path=cometbft_tpu/p2p/fixture.py
+import time
+
+
+class Tracker:
+    def touch(self):
+        self.last_seen = time.monotonic()
+
+    def save(self, f):
+        # persistence boundary: wall time is the point here
+        # bftlint: disable=monotonic-clock
+        now_w = time.time()
+        f.write(str(now_w - (time.monotonic() - self.last_seen)))

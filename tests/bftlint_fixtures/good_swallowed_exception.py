@@ -1,0 +1,44 @@
+import logging
+
+log = logging.getLogger(__name__)
+
+
+def fetch(store, height, metrics):
+    try:
+        return store.load(height)
+    except Exception:
+        log.error("load failed", exc_info=True)
+        raise
+
+
+def tally(votes, metrics):
+    for v in votes:
+        try:
+            v.verify()
+        except Exception:
+            metrics.invalid_votes.inc()
+
+
+def gauge_failure(probe, metrics, family, backend):
+    # set/add on a recognizable metric receiver is handling
+    try:
+        probe.run()
+    except Exception:
+        metrics.breaker_gauge.set(1)
+        family.with_labels(backend=backend).add(1)
+
+
+def delegate(conn, on_error):
+    try:
+        conn.flush()
+    except Exception as e:
+        on_error(e)
+
+
+def probe():
+    # availability probe: absence is the expected outcome
+    try:
+        import _missing_native_module  # noqa: F401
+    except Exception:  # bftlint: disable=swallowed-exception
+        return False
+    return True

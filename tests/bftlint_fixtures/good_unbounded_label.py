@@ -1,0 +1,11 @@
+class Reactor:
+    def on_recv(self, peer, msg, ok, backend):
+        self.metrics.recv_msgs.with_labels("p2p").inc()
+        self.metrics.recv_verdict.with_labels(
+            "accepted" if ok else "rejected").inc()
+        # `backend` is in the reviewed-bounded allowlist
+        self.metrics.recv_backend.with_labels(backend).inc()
+        # peer label: bounded by max peer count, runtime overflow
+        # collapse backstops — reviewed at this call site
+        # bftlint: disable=unbounded-label
+        self.metrics.recv_peer.with_labels(peer.id).add(len(msg))

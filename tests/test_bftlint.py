@@ -1,0 +1,713 @@
+"""bftlint: the AST invariant linter gates tier-1.
+
+Three duties:
+
+  * run ``bftlint check`` clean over cometbft_tpu/ — the tier-1 gate
+    (new findings fail CI; grandfathered ones live in
+    bftlint_baseline.json with justifications);
+  * prove every rule fires on its known-bad fixture and stays quiet
+    on its known-good (incl. suppressed) fixture;
+  * carry the invariant of the retired
+    tests/test_supervised_tasks_ast.py: the supervised-spawn scope
+    still covers every reactor, and an injected bare ``create_task``
+    still trips.
+"""
+import glob
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO_ROOT = os.path.abspath(
+    os.path.join(os.path.dirname(__file__), ".."))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+from tools.bftlint import baseline as baseline_mod  # noqa: E402
+from tools.bftlint import lint_paths  # noqa: E402
+from tools.bftlint.checkers import ALL_CHECKERS  # noqa: E402
+from tools.bftlint.core import FileContext  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__),
+                        "bftlint_fixtures")
+PKG = os.path.join(REPO_ROOT, "cometbft_tpu")
+BASELINE = os.path.join(REPO_ROOT, "bftlint_baseline.json")
+RULES = sorted(c.rule for c in ALL_CHECKERS)
+
+
+def _lint_file(path, rules=None):
+    return lint_paths([path], ALL_CHECKERS, rules=rules).findings
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.bftlint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+# ---------------------------------------------------------------------
+# the tier-1 gate: the repo lints clean
+
+class TestRepoGate:
+    def test_all_eight_rules_registered(self):
+        assert RULES == sorted((
+            "supervised-spawn", "monotonic-clock",
+            "swallowed-exception", "yield-in-loop",
+            "await-atomicity", "blocking-in-async",
+            "unbounded-label", "cwd-write"))
+
+    def test_package_check_is_clean(self):
+        """`python -m tools.bftlint check` exits 0 on the repo with
+        all 8 rules active — THE gate that wires bftlint into
+        tier-1."""
+        proc = _cli("check", "--format", "json")
+        assert proc.returncode == 0, \
+            f"bftlint check failed:\n{proc.stdout}\n{proc.stderr}"
+        report = json.loads(proc.stdout)
+        assert report["rules"] == RULES
+        assert report["counts"]["new"] == 0
+        assert not report["parse_errors"]
+        assert report["files_scanned"] > 100
+
+    def test_no_stale_baseline_entries(self):
+        """A fixed site must shrink the baseline, not rot in it."""
+        result = lint_paths([PKG], ALL_CHECKERS)
+        diff = baseline_mod.diff(result.findings,
+                                 baseline_mod.load(BASELINE))
+        assert not diff.stale, \
+            (f"stale baseline entries (rerun `python -m tools.bftlint"
+             f" baseline`): {diff.stale}")
+
+    def test_baseline_entries_all_justified(self):
+        """Every grandfathered finding carries a real one-line
+        justification, not the placeholder."""
+        base = baseline_mod.load(BASELINE)
+        assert base, "baseline unexpectedly empty"
+        for fp, entry in base.items():
+            assert entry["justification"] != \
+                baseline_mod.DEFAULT_JUSTIFICATION, \
+                f"placeholder justification for {fp}"
+
+
+# ---------------------------------------------------------------------
+# per-rule fixtures: every rule trips on bad, passes good
+
+@pytest.mark.parametrize("rule", RULES)
+def test_bad_fixture_triggers(rule):
+    path = os.path.join(FIXTURES,
+                        f"bad_{rule.replace('-', '_')}.py")
+    assert os.path.exists(path), f"missing bad fixture for {rule}"
+    found = {f.rule for f in _lint_file(path)}
+    assert rule in found, \
+        f"{rule} did not fire on its bad fixture (found: {found})"
+
+
+@pytest.mark.parametrize("rule", RULES)
+def test_good_fixture_passes(rule):
+    path = os.path.join(FIXTURES,
+                        f"good_{rule.replace('-', '_')}.py")
+    assert os.path.exists(path), f"missing good fixture for {rule}"
+    findings = _lint_file(path)
+    assert not findings, \
+        f"good fixture for {rule} flagged: {findings}"
+
+
+def test_cli_exits_nonzero_on_each_bad_fixture():
+    for rule in RULES:
+        rel = os.path.join("tests", "bftlint_fixtures",
+                           f"bad_{rule.replace('-', '_')}.py")
+        proc = _cli("check", rel, "--no-baseline")
+        assert proc.returncode == 1, \
+            (f"check on {rel} exited {proc.returncode}; "
+             f"stdout:\n{proc.stdout}\nstderr:\n{proc.stderr}")
+
+
+# ---------------------------------------------------------------------
+# the retired AST test's invariant, carried over
+
+class TestSupervisedSpawnCarryover:
+    """tests/test_supervised_tasks_ast.py is deleted in favor of the
+    supervised-spawn checker; these lock the same semantics."""
+
+    def test_scope_is_nonempty(self):
+        # the glob must keep finding the reactors — a silent empty
+        # scope would make the rule vacuous
+        checker = next(c for c in ALL_CHECKERS
+                       if c.rule == "supervised-spawn")
+        in_scope = sorted(
+            os.path.relpath(p, REPO_ROOT).replace(os.sep, "/")
+            for p in glob.glob(os.path.join(PKG, "*", "reactor.py"))
+            + [os.path.join(PKG, "node", "node.py"),
+               os.path.join(PKG, "consensus", "state.py"),
+               os.path.join(PKG, "p2p", "switch.py")])
+        assert len(in_scope) >= 7, in_scope
+        for rel in in_scope:
+            # the literal paths must exist on disk — a renamed
+            # state.py/switch.py would otherwise silently leave the
+            # rule's scope (the retired AST test asserted this too)
+            assert os.path.exists(os.path.join(REPO_ROOT, rel)), \
+                f"{rel} is in supervised-spawn scope but missing"
+            assert checker.in_scope(rel), \
+                f"{rel} fell out of supervised-spawn scope"
+
+    def test_injected_bare_create_task_trips(self, tmp_path):
+        src = (
+            "# bftlint: path=cometbft_tpu/injected/reactor.py\n"
+            "import asyncio\n"
+            "class R:\n"
+            "    async def start(self):\n"
+            "        asyncio.create_task(self._loop())\n")
+        p = tmp_path / "injected_reactor.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "supervised-spawn"]
+        assert len(found) == 1
+        assert "supervisor.spawn" in found[0].message
+
+    def test_no_unsupervised_tasks_in_live_scope(self):
+        """Zero supervised-spawn findings over the real tree — not
+        even baselined ones (the old test's allowlist was empty)."""
+        result = lint_paths([PKG], ALL_CHECKERS,
+                            rules={"supervised-spawn"})
+        assert not result.findings, result.findings
+
+
+# ---------------------------------------------------------------------
+# framework semantics: suppressions and baseline accounting
+
+class TestFrameworkSemantics:
+    def test_inline_suppression_same_line_and_preceding(self, tmp_path):
+        src = (
+            "def f(x):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:  # bftlint: disable=swallowed-exception\n"
+            "        pass\n"
+            "    try:\n"
+            "        x()\n"
+            "    # bftlint: disable=swallowed-exception\n"
+            "    except Exception:\n"
+            "        pass\n")
+        p = tmp_path / "supp.py"
+        p.write_text(src)
+        assert _lint_file(str(p)) == []
+
+    def test_file_level_suppression(self, tmp_path):
+        src = (
+            "# bftlint: disable-file=swallowed-exception\n"
+            "def f(x):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        p = tmp_path / "suppfile.py"
+        p.write_text(src)
+        assert _lint_file(str(p)) == []
+
+    def test_suppression_is_rule_specific(self, tmp_path):
+        src = (
+            "def f(x):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:  # bftlint: disable=cwd-write\n"
+            "        pass\n")
+        p = tmp_path / "wrongrule.py"
+        p.write_text(src)
+        assert [f.rule for f in _lint_file(str(p))] == \
+            ["swallowed-exception"]
+
+    def test_baseline_count_semantics(self, tmp_path):
+        """N identical findings vs a count-1 entry: one baselined,
+        the rest are new; an unmatched entry reports stale."""
+        src = (
+            "def f(x):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        pass\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        p = tmp_path / "counts.py"
+        p.write_text(src)
+        findings = _lint_file(str(p))
+        assert len(findings) == 2
+        fp = findings[0].fingerprint
+        assert fp == findings[1].fingerprint
+        diff = baseline_mod.diff(
+            findings, {fp: {"count": 1, "justification": "j"},
+                       "ghost": {"count": 1, "justification": "j"}})
+        assert len(diff.baselined) == 1
+        assert len(diff.new) == 1
+        assert diff.stale == ["ghost"]
+
+    def test_fingerprint_is_line_number_free(self, tmp_path):
+        a = tmp_path / "a.py"
+        b = tmp_path / "b.py"
+        body = ("def f(x):\n"
+                "    try:\n"
+                "        x()\n"
+                "    except Exception:\n"
+                "        pass\n")
+        a.write_text("# bftlint: path=cometbft_tpu/same.py\n" + body)
+        b.write_text("# bftlint: path=cometbft_tpu/same.py\n"
+                     "\n\n\n" + body)
+        fa = _lint_file(str(a))
+        fb = _lint_file(str(b))
+        assert fa and fb
+        assert fa[0].fingerprint == fb[0].fingerprint
+        assert fa[0].line != fb[0].line
+
+    def test_unknown_rule_rejected(self):
+        proc = _cli("run", "--rules", "no-such-rule")
+        assert proc.returncode == 2
+        assert "unknown rule" in proc.stderr
+
+    def test_partial_count_use_reports_stale(self, tmp_path):
+        """An entry whose count exceeds its matches must surface as
+        stale — leftover slack would silently absorb a reintroduced
+        finding with the same fingerprint."""
+        src = ("def f(x):\n"
+               "    try:\n"
+               "        x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        p = tmp_path / "slack.py"
+        p.write_text(src)
+        findings = _lint_file(str(p))
+        assert len(findings) == 1
+        fp = findings[0].fingerprint
+        diff = baseline_mod.diff(
+            findings, {fp: {"count": 3, "justification": "j"}})
+        assert not diff.new and len(diff.baselined) == 1
+        assert diff.stale == [fp]
+
+    def test_filtered_baseline_preserves_other_rules(self, tmp_path):
+        """`baseline --rules x` / path-filtered runs must not wipe
+        entries they did not re-examine."""
+        prev = {
+            "cwd-write::cometbft_tpu/other.py::f::open('x', 'w')":
+                {"count": 1, "justification": "keep me"},
+            "swallowed-exception::cometbft_tpu/gone.py::g::except Exception:":
+                {"count": 1, "justification": "rule was rerun"},
+        }
+        out = tmp_path / "base.json"
+        # rerun covered only swallowed-exception and found nothing:
+        # its old entry goes; the cwd-write entry must survive
+        n = baseline_mod.write(str(out), [], previous=prev,
+                               active_rules={"swallowed-exception"})
+        assert n == 1
+        kept = baseline_mod.load(str(out))
+        assert list(kept.values())[0]["justification"] == "keep me"
+        # unfiltered rerun with no findings shrinks to empty
+        n = baseline_mod.write(str(out), [], previous=prev)
+        assert n == 0
+
+
+class TestReviewRegressions:
+    """Bug classes found in review: each was a false negative (or a
+    lost diagnostic) in the first cut of the linter."""
+
+    def test_yield_in_loop_sibling_handler_not_a_predecessor(
+            self, tmp_path):
+        """An await inside an *earlier* except handler cannot have
+        run on a later handler's path — the busy-spin continue there
+        must still be flagged."""
+        src = (
+            "import asyncio\n"
+            "async def routine(work):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            work()\n"
+            "        except TimeoutError:\n"
+            "            await asyncio.sleep(1)\n"
+            "            continue\n"
+            "        except Exception:\n"
+            "            continue\n")
+        p = tmp_path / "handlers.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "yield-in-loop"]
+        assert len(found) == 1
+        assert found[0].line == 10
+
+    def test_yield_in_loop_try_body_await_counts(self, tmp_path):
+        """The try body may have suspended before raising into the
+        handler — a continue there is not provably spin."""
+        src = (
+            "import asyncio\n"
+            "async def routine(work):\n"
+            "    while True:\n"
+            "        try:\n"
+            "            await work()\n"
+            "        except Exception:\n"
+            "            continue\n")
+        p = tmp_path / "trybody.py"
+        p.write_text(src)
+        assert not [f for f in _lint_file(str(p))
+                    if f.rule == "yield-in-loop"]
+
+    def test_swallowed_exception_word_boundary_match(self, tmp_path):
+        """`rebuild_catalog()` ends in 'log' but is not a logging
+        call; `log_error()` is."""
+        src = (
+            "def f(self, x):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        self.rebuild_catalog()\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        self.log_error()\n")
+        p = tmp_path / "words.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "swallowed-exception"]
+        assert len(found) == 1
+        assert found[0].line == 4
+
+    def test_baseline_refuses_rewrite_on_parse_errors(
+            self, tmp_path):
+        """An unparseable file yields no findings — an unfiltered
+        baseline rewrite would silently drop all its entries and
+        their justifications; refuse instead."""
+        good = tmp_path / "good.py"
+        good.write_text("def f():\n    return 1\n")
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        bl = tmp_path / "bl.json"
+        proc = _cli("baseline", str(tmp_path), "--baseline", str(bl))
+        assert proc.returncode == 2
+        assert "refusing to rewrite" in proc.stderr
+        assert not bl.exists()
+
+    def test_explicit_non_py_file_argument_is_an_error(
+            self, tmp_path):
+        """A named file that is not .py would be silently skipped by
+        the scan — mixed with other paths, the gate would pass
+        without ever examining it."""
+        txt = tmp_path / "notes.txt"
+        txt.write_text("not python\n")
+        py = tmp_path / "ok.py"
+        py.write_text("def f():\n    return 1\n")
+        proc = _cli("check", str(py), str(txt), "--no-baseline")
+        assert proc.returncode == 2
+        assert "not Python file" in proc.stderr
+
+    def test_mangled_fingerprint_surfaces_stale_not_crash(
+            self, tmp_path):
+        """A hand-edit/merge that mangles one fingerprint (valid
+        JSON, no '::') must not traceback a filtered run — the entry
+        surfaces stale, and a baseline rewrite drops it."""
+        src = ("def f(x):\n"
+               "    try:\n"
+               "        x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        p = tmp_path / "site.py"
+        p.write_text(src)
+        bl = tmp_path / "bl.json"
+        bl.write_text(json.dumps({
+            "schema": 1,
+            "entries": [{"fingerprint": "mangled by a bad merge",
+                         "rule": "swallowed-exception",
+                         "path": "x.py", "count": 1,
+                         "justification": "j"}]}))
+        proc = _cli("check", str(p), "--rules", "swallowed-exception",
+                    "--baseline", str(bl))
+        assert proc.returncode == 1
+        assert "Traceback" not in proc.stderr
+        assert "stale" in proc.stdout
+        proc = _cli("baseline", str(p), "--rules",
+                    "swallowed-exception", "--baseline", str(bl))
+        assert proc.returncode == 0, proc.stderr
+        assert "mangled by a bad merge" not in bl.read_text()
+
+    def test_examined_paths_repo_root_covers_everything(self):
+        """`check <repo-root>` relativizes to '.' — it re-examined
+        every logical path, so none may be masked from staleness."""
+        from tools.bftlint.cli import _ExaminedPaths
+        ex = _ExaminedPaths([REPO_ROOT], set())
+        assert "cometbft_tpu/consensus/state.py" in ex
+        sub = _ExaminedPaths(
+            [os.path.join(REPO_ROOT, "cometbft_tpu")], set())
+        assert "cometbft_tpu/consensus/state.py" in sub
+        assert "tests/other.py" not in sub
+
+    def test_deleted_file_goes_stale_under_dir_scoped_run(
+            self, tmp_path):
+        """A dir-scoped check/baseline re-examined everything under
+        the dir — a deleted file's entry must surface stale (and
+        leave the baseline on rewrite), not be masked by exact
+        scanned-file membership."""
+        d = tmp_path / "pkg"
+        d.mkdir()
+        site = d / "site.py"
+        site.write_text("def f(x):\n"
+                        "    try:\n"
+                        "        x()\n"
+                        "    except Exception:\n"
+                        "        pass\n")
+        # keep the dir non-empty after the delete, or the
+        # zero-files-scanned guard (exit 2) fires instead
+        (d / "other.py").write_text("def g():\n    return 1\n")
+        bl = tmp_path / "bl.json"
+        proc = _cli("baseline", str(d), "--baseline", str(bl))
+        assert proc.returncode == 0
+        site.unlink()
+        proc = _cli("check", str(d), "--baseline", str(bl))
+        assert proc.returncode == 1, proc.stdout
+        assert "stale" in proc.stdout
+        proc = _cli("baseline", str(d), "--baseline", str(bl))
+        assert proc.returncode == 0
+        assert baseline_mod.load(str(bl)) == {}
+
+    def test_blocking_in_async_chained_path_call(self, tmp_path):
+        """`Path("wal.json").read_text()` chains through a Call, so
+        call_name drops the receiver — it must still be flagged; a
+        bare local `read_text()` must not."""
+        src = ("# bftlint: path=cometbft_tpu/consensus/wal.py\n"
+               "from pathlib import Path\n"
+               "async def replay(read_text):\n"
+               "    data = Path('wal.json').read_text()\n"
+               "    local = read_text()\n"
+               "    return data, local\n")
+        p = tmp_path / "chained.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "blocking-in-async"]
+        assert [f.line for f in found] == [4]
+
+    def test_swallowed_exception_nested_def_log_not_handling(
+            self, tmp_path):
+        """A log/raise inside a nested def or lambda only runs if it
+        is later invoked — at the except site the failure is still
+        dropped.  A closure capturing the bound exception variable,
+        though, delegates it."""
+        src = (
+            "def f(x, log, defer):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        cb = lambda: log.error('boom')\n"
+            "        defer(cb)\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception as e:\n"
+            "        defer(lambda: log.handle(e))\n")
+        p = tmp_path / "closures.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "swallowed-exception"]
+        assert [f.line for f in found] == [4]
+
+    def test_overlapping_paths_lint_each_file_once(self):
+        """`check pkg pkg/file.py` must not double-count findings —
+        duplicates would overflow count-capped baseline entries and
+        surface as new on a clean tree."""
+        overlap = os.path.join("cometbft_tpu", "consensus",
+                               "state.py")
+        proc = _cli("check", "cometbft_tpu", overlap)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_missing_path_is_an_error_not_a_clean_pass(
+            self, tmp_path):
+        """`check <typo>` must exit 2, not print '0 files, 0 new
+        finding(s)' and exit 0 — a silent false green from the gate."""
+        proc = _cli("check", "cometbft_tpu_typo", "--no-baseline")
+        assert proc.returncode == 2
+        assert "no such path" in proc.stderr
+        # an existing dir with no Python files is just as silent
+        (tmp_path / "empty").mkdir()
+        proc = _cli("check", str(tmp_path / "empty"), "--no-baseline")
+        assert proc.returncode == 2
+        assert "no Python files" in proc.stderr
+
+    def test_comment_pragma_before_line_pragma_code_line(
+            self, tmp_path):
+        """A comment-only disable pragma applies to the next code
+        line even when that line carries its own trailing pragma —
+        and must not leak past it to a later line."""
+        src = (
+            "def f(x, seen):\n"
+            "    try:\n"
+            "        x()\n"
+            "    # bftlint: disable=swallowed-exception\n"
+            "    except Exception:  # bftlint: disable=cwd-write\n"
+            "        pass\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        pass\n")
+        p = tmp_path / "pragmas.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "swallowed-exception"]
+        # line 5 suppressed by the comment-only pragma; line 9 is not
+        # (the pending pragma must not leak onto it)
+        assert [f.line for f in found] == [9]
+
+    def test_yield_in_loop_nested_def_await_not_a_suspension(
+            self, tmp_path):
+        """An await inside a nested function *definition* preceding
+        the continue never ran on this path — the busy-spin must
+        still be flagged."""
+        src = (
+            "import asyncio\n"
+            "async def routine(q):\n"
+            "    while True:\n"
+            "        async def helper():\n"
+            "            await q.get()\n"
+            "        if q.empty():\n"
+            "            continue\n"
+            "        await helper()\n")
+        p = tmp_path / "nested.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "yield-in-loop"]
+        assert [f.line for f in found] == [7]
+
+    def test_await_atomicity_nested_def_await_not_a_straddle(
+            self, tmp_path):
+        """A nested def's await belongs to its own call's flow — the
+        outer function has no suspension point, so a load/store pair
+        around the def is not a straddle."""
+        src = (
+            "# bftlint: path=cometbft_tpu/consensus/fixture.py\n"
+            "class ConsensusState:\n"
+            "    async def outer(self):\n"
+            "        h = self.rs.height\n"
+            "        async def helper():\n"
+            "            await self.signer.sign(h)\n"
+            "        self._cb = helper\n"
+            "        self.rs.height = h + 1\n")
+        p = tmp_path / "nested_atom.py"
+        p.write_text(src)
+        assert not [f for f in _lint_file(str(p))
+                    if f.rule == "await-atomicity"]
+
+    def test_baseline_mode_refuses_corrupt_previous(self, tmp_path):
+        """`baseline` over a corrupt/mismatched file must refuse, not
+        silently rewrite it with placeholder justifications."""
+        src = ("def f(x):\n"
+               "    try:\n"
+               "        x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        p = tmp_path / "site.py"
+        p.write_text(src)
+        bl = tmp_path / "bl.json"
+        bl.write_text("{ truncated by a bad merge")
+        proc = _cli("baseline", str(p), "--baseline", str(bl))
+        assert proc.returncode == 2
+        assert "refusing to rewrite" in proc.stderr
+        assert bl.read_text() == "{ truncated by a bad merge"
+
+    def test_swallowed_exception_nonmetric_set_add(self, tmp_path):
+        """`event.set()` / `seen.add()` are not metric recordings —
+        only a receiver that names a metric (or with_labels) counts."""
+        src = (
+            "def f(self, x, seen):\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        self._stopped.set()\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        seen.add(x)\n"
+            "    try:\n"
+            "        x()\n"
+            "    except Exception:\n"
+            "        self.metrics.failures.add(1)\n")
+        p = tmp_path / "events.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "swallowed-exception"]
+        assert sorted(f.line for f in found) == [4, 8]
+
+    def test_cwd_write_update_mode(self, tmp_path):
+        """open(..., 'r+') writes without any of w/a/x — relative
+        update-mode paths land in the CWD too."""
+        src = ("# bftlint: path=cometbft_tpu/libs/upd.py\n"
+               "def f(rec):\n"
+               "    with open('state.json', 'r+') as fh:\n"
+               "        fh.write(rec)\n"
+               "    with open('state.json') as fh:\n"
+               "        return fh.read()\n")
+        p = tmp_path / "upd.py"
+        p.write_text(src)
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "cwd-write"]
+        assert [f.line for f in found] == [3]
+
+    def test_check_exits_nonzero_on_stale_baseline(self, tmp_path):
+        """`check` must fail on stale entries like the tier-1 pytest
+        gate does — a false local green hides a shrinkable baseline."""
+        src = ("def f(x):\n"
+               "    try:\n"
+               "        x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        p = tmp_path / "site.py"
+        p.write_text(src)
+        bl = tmp_path / "bl.json"
+        proc = _cli("baseline", str(p), "--baseline", str(bl))
+        assert proc.returncode == 0
+        proc = _cli("check", str(p), "--baseline", str(bl))
+        assert proc.returncode == 0
+        # fix the site: the entry goes stale and check must fail
+        p.write_text("def f(x):\n    return x()\n")
+        proc = _cli("check", str(p), "--baseline", str(bl))
+        assert proc.returncode == 1
+        assert "stale" in proc.stdout
+
+    def test_filtered_check_ignores_out_of_filter_entries(
+            self, tmp_path):
+        """A --rules/path-filtered check only re-examined a subset —
+        entries for other rules/paths must not read as stale."""
+        src = ("def f(x):\n"
+               "    try:\n"
+               "        x()\n"
+               "    except Exception:\n"
+               "        pass\n")
+        p = tmp_path / "site.py"
+        p.write_text(src)
+        bl = tmp_path / "bl.json"
+        proc = _cli("baseline", str(p), "--baseline", str(bl))
+        assert proc.returncode == 0
+        # the swallowed-exception entry is out of this rule filter:
+        # not re-examined, so not stale — check stays green
+        proc = _cli("check", str(p), "--rules", "yield-in-loop",
+                    "--baseline", str(bl))
+        assert proc.returncode == 0, proc.stdout
+        assert "1 stale" not in proc.stdout
+
+    def test_logger_debug_renders_traceback(self, capsys):
+        """exc_info=True on debug/info/warn must emit the traceback,
+        not a literal 'exc_info=True' k-v pair — the new preverify
+        debug logs depend on it."""
+        import logging
+
+        from cometbft_tpu.libs.log import Logger
+        base = logging.getLogger("bftlint-test-log")
+        base.setLevel(logging.DEBUG)
+        stream = __import__("io").StringIO()
+        h = logging.StreamHandler(stream)
+        base.addHandler(h)
+        try:
+            log = Logger(base)
+            try:
+                raise ValueError("boom")
+            except ValueError:
+                log.debug("skipping malformed vote", exc_info=True)
+            out = stream.getvalue()
+            assert "exc_info" not in out
+            assert "ValueError: boom" in out
+            assert "Traceback" in out
+        finally:
+            base.removeHandler(h)

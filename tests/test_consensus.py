@@ -244,8 +244,15 @@ class TestBurstPreverification:
                  extension=b"ext", extension_signature=b"\x02" * 64,
                  non_rp_extension=b"nrp",
                  non_rp_extension_signature=b"\x03" * 64)
+        # _append_vote_entries is an instance method (it logs
+        # skipped malformed votes); a stub self with a logger is
+        # enough for the entry-building contract under test
+        from types import SimpleNamespace
+        from cometbft_tpu.libs.log import new_logger
+        cs = SimpleNamespace(logger=new_logger("test"))
         entries = []
-        ConsensusState._append_vote_entries(entries, v, pk, "x-chain")
+        ConsensusState._append_vote_entries(cs, entries, v, pk,
+                                            "x-chain")
         assert len(entries) == 3
         assert entries[0][2] == b"\x01" * 64
         assert entries[1][2] == b"\x02" * 64
@@ -256,6 +263,6 @@ class TestBurstPreverification:
                        validator_address=pk.address(),
                        validator_index=0, signature=b"\x04" * 64)
         entries = []
-        ConsensusState._append_vote_entries(entries, prevote, pk,
+        ConsensusState._append_vote_entries(cs, entries, prevote, pk,
                                             "x-chain")
         assert len(entries) == 1

@@ -1,0 +1,18 @@
+"""bftlint: AST-based invariant linter for the cometbft_tpu node.
+
+PRs 1-4 each found a latent bug class the hard way — unsupervised
+background tasks dying silently, wall-clock arithmetic in consensus
+intervals, an event-loop livelock from a ``continue`` that never
+yielded, unbounded metric label cardinality — and each left at most a
+single ad-hoc guard.  bftlint codifies those invariants (plus the
+asyncio analogue of a data race: consensus state read-then-written
+across an ``await``) as mechanized checks that run in tier-1, so a new
+PR cannot silently reintroduce a bug class the nemesis runner already
+caught once.
+
+See docs/static_analysis.md for the rule catalog, suppression syntax
+and baseline workflow.  CLI: ``python -m tools.bftlint run|check|baseline``.
+"""
+from .core import Checker, FileContext, Finding, lint_paths  # noqa: F401
+
+__all__ = ["Checker", "FileContext", "Finding", "lint_paths"]

@@ -1,0 +1,136 @@
+"""bftlint baseline: grandfathered findings, with justifications.
+
+The committed ``bftlint_baseline.json`` lets ``check`` gate CI at
+*zero new findings* without first fixing every historical site: each
+entry names a finding fingerprint (rule + path + scope + source line,
+deliberately line-number-free), how many identical occurrences it
+covers, and a one-line justification a reviewer can audit.  The flow
+mirrors tools/perf_lab.py's committed perf_baseline.json:
+
+  * ``bftlint baseline``          write/refresh the file (keeps
+                                  existing justifications)
+  * fix a site                    the entry goes stale; ``check``
+                                  reports it so the baseline shrinks
+                                  monotonically instead of rotting
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+from dataclasses import dataclass, field
+from typing import Container, Iterable
+
+from .core import Finding
+
+SCHEMA = 1
+DEFAULT_JUSTIFICATION = "grandfathered; triage before copying this pattern"
+
+
+@dataclass
+class BaselineDiff:
+    new: list[Finding] = field(default_factory=list)
+    baselined: list[Finding] = field(default_factory=list)
+    stale: list[str] = field(default_factory=list)   # fingerprints
+
+
+def load(path: str) -> dict[str, dict]:
+    """fingerprint -> {count, justification}."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        raw = json.load(f)
+    if raw.get("schema") != SCHEMA:
+        raise ValueError(
+            f"baseline schema {raw.get('schema')} != {SCHEMA}; "
+            f"rerun `python -m tools.bftlint baseline`")
+    return {e["fingerprint"]: {"count": int(e.get("count", 1)),
+                               "justification": e.get(
+                                   "justification",
+                                   DEFAULT_JUSTIFICATION)}
+            for e in raw.get("entries", [])}
+
+
+def diff(findings: Iterable[Finding],
+         baseline: dict[str, dict]) -> BaselineDiff:
+    """Split findings into baselined (covered by an entry, up to its
+    count) and new; entries with unconsumed slack are stale — an
+    entry whose count exceeds its matches would otherwise silently
+    absorb a *reintroduced* finding with the same fingerprint, so
+    partial fixes must shrink the baseline too."""
+    out = BaselineDiff()
+    used: Counter[str] = Counter()
+    for f in findings:
+        fp = f.fingerprint
+        entry = baseline.get(fp)
+        if entry is not None and used[fp] < entry["count"]:
+            used[fp] += 1
+            out.baselined.append(f)
+        else:
+            out.new.append(f)
+    out.stale = sorted(fp for fp, e in baseline.items()
+                       if used[fp] < e["count"])
+    return out
+
+
+def write(path: str, findings: Iterable[Finding],
+          previous: dict[str, dict] | None = None,
+          active_rules: set[str] | None = None,
+          scanned_paths: Container[str] | None = None) -> int:
+    """Write a baseline covering ``findings``; justifications from
+    ``previous`` (usually the existing file) are preserved per
+    fingerprint.
+
+    A partial run must never wipe what it did not look at: when the
+    run was filtered to a rule subset (``active_rules``) or a path
+    subset (``scanned_paths``), previous entries outside that subset
+    are carried over untouched.  ``None`` means unfiltered — the
+    baseline then shrinks to exactly the current findings (that is
+    how fixed sites leave the file).  Returns the number of entries
+    written."""
+    previous = previous or {}
+    counts: Counter[str] = Counter()
+    meta: dict[str, Finding] = {}
+    for f in findings:
+        counts[f.fingerprint] += 1
+        meta.setdefault(f.fingerprint, f)
+    entries = []
+    for fp in sorted(counts):
+        f = meta[fp]
+        prev = previous.get(fp, {})
+        entries.append({
+            "fingerprint": fp,
+            "rule": f.rule,
+            "path": f.path,
+            "count": counts[fp],
+            "justification": prev.get("justification",
+                                      DEFAULT_JUSTIFICATION),
+        })
+    for fp in sorted(set(previous) - set(counts)):
+        parts = fp.split("::", 3)
+        if len(parts) < 2:
+            # a mangled fingerprint can never match a finding again —
+            # drop it from the rewrite rather than carry garbage
+            continue
+        rule, fpath = parts[:2]
+        outside = (active_rules is not None
+                   and rule not in active_rules) or \
+                  (scanned_paths is not None
+                   and fpath not in scanned_paths)
+        if outside:
+            prev = previous[fp]
+            entries.append({
+                "fingerprint": fp,
+                "rule": rule,
+                "path": fpath,
+                "count": prev["count"],
+                "justification": prev["justification"],
+            })
+    entries.sort(key=lambda e: e["fingerprint"])
+    with open(path, "w", encoding="utf-8") as f_out:
+        json.dump({"schema": SCHEMA,
+                   "generated_by": "python -m tools.bftlint baseline",
+                   "entries": entries},
+                  f_out, indent=2, sort_keys=True)
+        f_out.write("\n")
+    return len(entries)

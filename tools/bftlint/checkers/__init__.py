@@ -1,0 +1,24 @@
+"""The bftlint checker registry — one rule per module, each docstring
+naming the PR/bug class that motivated it (docs/static_analysis.md
+renders the catalog)."""
+from .await_atomicity import AwaitAtomicityChecker
+from .blocking_in_async import BlockingInAsyncChecker
+from .cwd_write import CwdWriteChecker
+from .monotonic_clock import MonotonicClockChecker
+from .supervised_spawn import SupervisedSpawnChecker
+from .swallowed_exception import SwallowedExceptionChecker
+from .unbounded_label import UnboundedLabelChecker
+from .yield_in_loop import YieldInLoopChecker
+
+ALL_CHECKERS = (
+    SupervisedSpawnChecker(),
+    MonotonicClockChecker(),
+    SwallowedExceptionChecker(),
+    YieldInLoopChecker(),
+    AwaitAtomicityChecker(),
+    BlockingInAsyncChecker(),
+    UnboundedLabelChecker(),
+    CwdWriteChecker(),
+)
+
+__all__ = ["ALL_CHECKERS"]

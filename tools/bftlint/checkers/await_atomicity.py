@@ -1,0 +1,157 @@
+"""await-atomicity: consensus state read-then-written across an
+``await`` needs re-validation — the asyncio analogue of a data race.
+
+asyncio removes preemption but not interleaving: every ``await`` is a
+point where another task (the timeout ticker, a supervisor restart, a
+stop-peer one-shot) can run and mutate shared state.  A method that
+reads ``self.rs.height`` before an ``await`` and writes round state
+after it, without re-checking, can apply a decision computed for a
+height/round the machine has already left — exactly the class of bug
+TLA+ audits of HotStuff/Tendermint keep finding in the
+"vote-after-timeout" corner (PAPERS.md).
+
+Heuristic: inside an ``async def`` of a consensus-critical class,
+flag a *store* to a tracked attribute (``self.rs.*``, ``self.rs``,
+``self.sm_state``, ``self.height``/``round``/``step`` mirrors) when
+
+  * the same attribute was *loaded* before an earlier ``await`` in
+    the same function, and
+  * no load of that attribute appears in an ``if``/``while``/
+    ``assert`` test between that ``await`` and the store
+    (re-validation).
+
+The dominant idiom in consensus/state.py is a local alias
+(``rs = self.rs``), so the checker tracks simple whole-object
+aliases: after ``rs = self.rs``, loads/stores of ``rs.height`` count
+as ``rs.height`` state accesses.  Deeper aliasing (``votes =
+self.rs.votes``) is not chased — it bounds false positives, not
+false negatives.  Findings are triaged like any other rule:
+restructure, re-validate, or baseline with a justification
+explaining why the interleaving is benign.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Checker, FileContext, Finding, walk_scope
+
+# attribute roots considered consensus-critical state
+_TRACKED_BASES = {"rs", "sm_state"}
+_TRACKED_DIRECT = {"rs", "sm_state", "height", "round", "step",
+                   "locked_round", "valid_round"}
+
+
+def _attr_key(node: ast.AST,
+              aliases: dict[str, str] | None = None) -> Optional[str]:
+    """``self.rs.height`` -> ``rs.height``; ``self.rs`` -> ``rs``;
+    with ``aliases={'rs': 'rs'}`` (from ``rs = self.rs``),
+    ``rs.height`` -> ``rs.height``; anything else -> None."""
+    if not isinstance(node, ast.Attribute):
+        return None
+    if isinstance(node.value, ast.Name) and node.value.id == "self":
+        return node.attr if node.attr in _TRACKED_DIRECT else None
+    if isinstance(node.value, ast.Name) and aliases and \
+            node.value.id in aliases:
+        return f"{aliases[node.value.id]}.{node.attr}"
+    if isinstance(node.value, ast.Attribute) and \
+            isinstance(node.value.value, ast.Name) and \
+            node.value.value.id == "self" and \
+            node.value.attr in _TRACKED_BASES:
+        return f"{node.value.attr}.{node.attr}"
+    return None
+
+
+def _collect_aliases(fn: ast.AsyncFunctionDef) -> dict[str, str]:
+    """``rs = self.rs`` / ``state = self.sm_state`` local aliases:
+    local name -> tracked base."""
+    aliases: dict[str, str] = {}
+    for node in walk_scope(fn):
+        if not isinstance(node, ast.Assign) or \
+                len(node.targets) != 1 or \
+                not isinstance(node.targets[0], ast.Name):
+            continue
+        v = node.value
+        if isinstance(v, ast.Attribute) and \
+                isinstance(v.value, ast.Name) and \
+                v.value.id == "self" and v.attr in _TRACKED_BASES:
+            aliases[node.targets[0].id] = v.attr
+    return aliases
+
+
+def _pos(node: ast.AST) -> tuple[int, int]:
+    return (node.lineno, node.col_offset)
+
+
+class AwaitAtomicityChecker(Checker):
+    rule = "await-atomicity"
+    description = ("consensus state read before an await and written "
+                   "after it without re-validation")
+    scope = ("cometbft_tpu/consensus/*",)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for fn in ctx.nodes(ast.AsyncFunctionDef):
+            yield from self._check_fn(ctx, fn)
+
+    def _check_fn(self, ctx: FileContext,
+                  fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
+        aliases = _collect_aliases(fn)
+        loads: list[tuple[tuple[int, int], str]] = []
+        stores: list[tuple[tuple[int, int], str, ast.AST]] = []
+        awaits: list[tuple[int, int]] = []
+        guards: list[tuple[tuple[int, int], str]] = []
+        # walk_scope: a nested def's awaits/loads/stores run on its
+        # own call's flow, not this function's — counting them here
+        # invents straddles that cannot happen (nested async defs are
+        # analyzed separately via ctx.nodes)
+        for node in walk_scope(fn):
+            if isinstance(node, ast.Await):
+                awaits.append(_pos(node))
+            elif isinstance(node, (ast.If, ast.While, ast.Assert)):
+                test = node.test
+                for sub in ast.walk(test):
+                    key = _attr_key(sub, aliases)
+                    if key and isinstance(
+                            getattr(sub, "ctx", None), ast.Load):
+                        guards.append((_pos(test), key))
+            elif isinstance(node, ast.Attribute):
+                key = _attr_key(node, aliases)
+                if key is None:
+                    continue
+                if isinstance(node.ctx, ast.Load):
+                    loads.append((_pos(node), key))
+                elif isinstance(node.ctx, ast.Store):
+                    stores.append((_pos(node), key, node))
+        if not awaits or not stores:
+            return
+        awaits.sort()
+        flagged: set[str] = set()
+        for spos, key, node in sorted(stores, key=lambda t: t[0]):
+            if key in flagged:
+                continue
+            # earliest await that both follows a load of `key` and
+            # precedes this store
+            straddle = None
+            for apos in awaits:
+                if apos < spos and any(
+                        lpos < apos for lpos, k in loads
+                        if k == key):
+                    straddle = apos
+                    break
+            if straddle is None:
+                continue
+            # a guard re-reading `key` between the await and the
+            # store counts as re-validation
+            if any(straddle <= gpos <= spos for gpos, k in guards
+                   if k == key):
+                continue
+            flagged.add(key)
+            yield ctx.finding(
+                self.rule, node,
+                f"self.{key} was read before an await (line "
+                f"{straddle[0]}) and is written here without "
+                f"re-validating — another task (timeout ticker, "
+                f"stop-peer one-shot) may have advanced the round "
+                f"state across that suspension; re-check "
+                f"height/round/step after the await or restructure "
+                f"to avoid the straddle")

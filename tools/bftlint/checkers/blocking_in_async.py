@@ -1,0 +1,73 @@
+"""blocking-in-async: no synchronous sleeps/sockets/file I/O inside
+``async def`` on hot paths.
+
+One blocking call inside a coroutine stalls the *entire* node — every
+reactor, every peer connection, the consensus state machine — because
+there is exactly one event loop.  ``time.sleep(0.1)`` in a receive
+handler is a 100ms global freeze; a synchronous ``open()`` on a slow
+disk is unbounded.
+
+Flags, inside any ``async def`` in consensus/p2p/mempool/abci/node
+code: ``time.sleep``, synchronous socket construction/connection,
+``subprocess`` calls, ``os.system``, ``urllib`` fetches, builtin
+``open`` and ``Path.read_*/write_*``.  Intentional synchronous
+durability points (the consensus WAL's write-through fsync is a
+correctness requirement, not an accident) get inline suppressions or
+baseline entries with the reason.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding, call_name
+
+_BLOCKING_CALLS = {
+    "time.sleep",
+    "socket.socket", "socket.create_connection",
+    "socket.getaddrinfo", "socket.gethostbyname",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen",
+    "urllib.request.urlopen", "requests.get", "requests.post",
+    "open",
+}
+_BLOCKING_TAILS = {"read_text", "read_bytes", "write_text",
+                   "write_bytes"}
+
+
+class BlockingInAsyncChecker(Checker):
+    rule = "blocking-in-async"
+    description = ("synchronous sleep/socket/file I/O inside an "
+                   "async def stalls the whole event loop")
+    scope = (
+        "cometbft_tpu/consensus/*",
+        "cometbft_tpu/p2p/*",
+        "cometbft_tpu/mempool/*",
+        "cometbft_tpu/abci/*",
+        "cometbft_tpu/node/*",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.Call):
+            if not ctx.in_async_def(node):
+                continue
+            name = call_name(node)
+            tail = name.rsplit(".", 1)[-1]
+            # attribute calls only: a bare local `read_text()` is not
+            # Path I/O, but any receiver counts — including a chained
+            # `Path("wal.json").read_text()`, where call_name
+            # truncates at the inner Call and drops the dot
+            if name in _BLOCKING_CALLS or \
+                    (tail in _BLOCKING_TAILS
+                     and isinstance(node.func, ast.Attribute)):
+                yield ctx.finding(
+                    self.rule, node,
+                    f"{name}() blocks the event loop inside an async "
+                    f"def — every reactor and peer stalls with it; "
+                    f"use the asyncio equivalent (asyncio.sleep, "
+                    f"loop.run_in_executor, to_thread) or justify "
+                    f"the synchronous durability point")
+
+
+__all__ = ["BlockingInAsyncChecker"]

@@ -1,0 +1,91 @@
+"""cwd-write: node code must not write relative paths.
+
+The PR 4 flight-dump bug class: the recorder dropped
+``flight-*.json`` into whatever directory the process happened to be
+started from, polluting the repo root in tests and silently
+scattering crash dumps in production.  The fix threaded an explicit
+dump dir (data dir / config / env / tempdir); this rule keeps every
+*other* write honest.
+
+Flags write-mode ``open()`` and ``Path("...").write_text/write_bytes``
+whose path is a *visibly relative* literal (a plain or formatted
+string not anchored at ``/``, ``~`` or a variable prefix).  Paths
+held in variables are not judged — the rule bounds false positives by
+only flagging what it can prove.  CLI tools under cometbft_tpu/tools/
+are exempt: writing reports into the invoker's CWD is their contract.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Checker, FileContext, Finding
+
+# "+" catches update modes ("r+", "rb+") that write without w/a/x
+_WRITE_MODES = ("w", "a", "x", "+")
+_PATH_WRITE_TAILS = {"write_text", "write_bytes"}
+
+
+def _relative_literal(arg: ast.expr) -> Optional[str]:
+    """Return a display string when ``arg`` is a provably-relative
+    path literal, else None."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        v = arg.value
+        if v and not v.startswith(("/", "~")):
+            return v
+    if isinstance(arg, ast.JoinedStr) and arg.values:
+        head = arg.values[0]
+        # f"{var}/..." anchors at a variable — not judged; a leading
+        # relative literal (f"flight-{h}.json") is provably relative
+        if isinstance(head, ast.Constant) and \
+                isinstance(head.value, str) and head.value and \
+                not head.value.startswith(("/", "~")):
+            return ast.unparse(arg) if hasattr(ast, "unparse") \
+                else head.value + "..."
+    return None
+
+
+def _open_write_mode(call: ast.Call) -> bool:
+    mode = None
+    if len(call.args) >= 2:
+        mode = call.args[1]
+    for kw in call.keywords:
+        if kw.arg == "mode":
+            mode = kw.value
+    return (isinstance(mode, ast.Constant)
+            and isinstance(mode.value, str)
+            and any(c in mode.value for c in _WRITE_MODES))
+
+
+class CwdWriteChecker(Checker):
+    rule = "cwd-write"
+    description = ("write to a relative path lands in the process "
+                   "CWD (the PR 4 flight-dump bug class)")
+    scope = ("cometbft_tpu/*",)
+
+    def in_scope(self, logical_path: str) -> bool:
+        if logical_path.startswith("cometbft_tpu/tools/"):
+            return False
+        return super().in_scope(logical_path)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.Call):
+            rel = None
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "open" and \
+                    node.args and _open_write_mode(node):
+                rel = _relative_literal(node.args[0])
+            elif isinstance(fn, ast.Attribute) and \
+                    fn.attr in _PATH_WRITE_TAILS and \
+                    isinstance(fn.value, ast.Call) and \
+                    isinstance(fn.value.func, ast.Name) and \
+                    fn.value.func.id == "Path" and fn.value.args:
+                rel = _relative_literal(fn.value.args[0])
+            if rel is None:
+                continue
+            yield ctx.finding(
+                self.rule, node,
+                f"write to relative path `{rel}` lands in whatever "
+                f"CWD the process started from — anchor it at the "
+                f"node data dir, an explicit config dir, or a "
+                f"tempdir (see Recorder.resolved_dump_dir)")

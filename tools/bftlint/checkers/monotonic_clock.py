@@ -1,0 +1,65 @@
+"""monotonic-clock: no wall-clock arithmetic in consensus/p2p/mempool.
+
+PR 2's clock-discipline satellite converted reactor
+``seconds_since_start_time`` and pex ``last_seen`` to
+``time.monotonic()`` after wall-clock steps (NTP slew, VM suspend)
+were shown to corrupt interval arithmetic — freshness ordering,
+timeout scheduling, rate windows.  Wall time is only meaningful at
+persistence boundaries (the pex addrbook save/load converts via the
+current offset) and in exposition metadata (exemplar timestamps).
+
+The checker flags ``time.time()`` / ``datetime.now()`` /
+``datetime.utcnow()`` in consensus, p2p, mempool and libs code.
+Known persistence boundaries are allowlisted below; anything else
+needs an inline ``# bftlint: disable=monotonic-clock`` with a reason,
+or a fix.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding, call_name
+
+_WALL_CALLS = {
+    "time.time",
+    "datetime.now", "datetime.utcnow", "datetime.today",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+}
+
+# (logical path, enclosing function) pairs where wall clock is the
+# point: serializing to/from disk, where monotonic stamps would be
+# meaningless across reboots.  PR 2 established the pex addrbook
+# save/load as the canonical wall<->monotonic conversion boundary.
+PERSISTENCE_ALLOWLIST: set[tuple[str, str]] = {
+    ("cometbft_tpu/p2p/pex.py", "AddrBook.save"),
+    ("cometbft_tpu/p2p/pex.py", "AddrBook._load"),
+}
+
+
+class MonotonicClockChecker(Checker):
+    rule = "monotonic-clock"
+    description = ("wall-clock call in interval-arithmetic scope; "
+                   "use time.monotonic() (wall time only at "
+                   "persistence boundaries)")
+    scope = (
+        "cometbft_tpu/consensus/*",
+        "cometbft_tpu/p2p/*",
+        "cometbft_tpu/mempool/*",
+        "cometbft_tpu/libs/*",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.Call):
+            name = call_name(node)
+            if name not in _WALL_CALLS:
+                continue
+            key = (ctx.logical_path, ctx.scope_of(node))
+            if key in PERSISTENCE_ALLOWLIST:
+                continue
+            yield ctx.finding(
+                self.rule, node,
+                f"{name}() is wall clock — steps under NTP slew/VM "
+                f"suspend corrupt interval arithmetic; use "
+                f"time.monotonic(), converting to wall time only at "
+                f"persistence boundaries (PR 2 clock discipline)")

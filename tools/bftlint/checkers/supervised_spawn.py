@@ -1,0 +1,61 @@
+"""supervised-spawn: reactor/node background loops are supervisor-owned.
+
+PR 1 (failure-domain supervision) moved every reactor/switch/consensus
+background loop under libs/supervisor.py so an uncaught exception
+restarts the loop (bounded, metered) instead of silently killing it.
+A bare ``asyncio.create_task`` / ``loop.create_task`` /
+``ensure_future`` in reactor or node code is a regression — spawn
+through ``self.supervisor.spawn(...)`` instead.
+
+This checker absorbs tests/test_supervised_tasks_ast.py, carrying its
+scope and (empty) allowlist over exactly.  Library plumbing that
+manages its own task lifecycle with in-loop error handling
+(p2p/conn.py MConnection, abci/client.py SocketClient, libs/service)
+is deliberately out of scope — those are transports, not reactor/node
+loops.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding
+
+_SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+# (logical path, line) pairs exempted from the invariant.  Keep this
+# EMPTY unless a spawn is provably supervisor-mediated and cannot be
+# expressed through Supervisor.spawn — and document why here.
+# (Carried over, still empty, from test_supervised_tasks_ast.py.)
+ALLOWLIST: set[tuple[str, int]] = set()
+
+
+class SupervisedSpawnChecker(Checker):
+    rule = "supervised-spawn"
+    description = ("bare create_task/ensure_future in reactor/node "
+                   "scope; use self.supervisor.spawn(...)")
+    scope = (
+        "cometbft_tpu/*/reactor.py",
+        "cometbft_tpu/node/node.py",
+        "cometbft_tpu/consensus/state.py",
+        "cometbft_tpu/p2p/switch.py",
+    )
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            name = ""
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _SPAWN_ATTRS:
+                name = fn.attr
+            elif isinstance(fn, ast.Name) and fn.id in _SPAWN_ATTRS:
+                name = fn.id
+            if not name:
+                continue
+            if (ctx.logical_path, node.lineno) in ALLOWLIST:
+                continue
+            yield ctx.finding(
+                self.rule, node,
+                f"unsupervised task spawn ({name}) in reactor/node "
+                f"code — use self.supervisor.spawn(...) so crashes "
+                f"restart (bounded) instead of dying silently")

@@ -1,0 +1,127 @@
+"""swallowed-exception: broad except handlers must do *something*.
+
+Every debugging session that ends in "the task died an hour ago and
+nothing was logged" starts with an ``except Exception: pass``.  The
+node's supervision story (PR 1) only works when failures surface — a
+handler that catches everything and drops it silently defeats both
+the supervisor's restart accounting and the flight recorder's crash
+timelines.
+
+A broad handler (``except:``, ``except Exception``,
+``except BaseException``, or a tuple containing one of those) passes
+when its body — in the handler's own control flow, not inside a
+nested def/lambda that may never run — does any of:
+
+  * re-raise (any ``raise``);
+  * log — a call whose target name contains the word debug/info/
+    warn/warning/error/exception/critical/log (word-boundary match
+    on the final attribute), or ``print`` (the CLI-tool idiom);
+  * record a metric — a call ending in inc/observe, or in set/add
+    when the receiver is recognizably a metric (the dotted chain
+    names a metric/counter/gauge/histogram, or hangs off
+    ``with_labels(...)``) — a bare ``event.set()`` / ``seen.add()``
+    is not handling;
+  * reference the bound exception variable (``except Exception as e:
+    self._fail(e)`` delegates the error instead of dropping it).
+
+Anything else is a swallow: fix it, or baseline it with a
+justification.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding, call_name, \
+    walk_scope
+
+_BROAD = {"Exception", "BaseException"}
+# matched against the call target's final attribute, split on "_":
+# `logger.error`, `log_error`, `print` count; `rebuild_catalog` or
+# `backlog_drain` must NOT (word-boundary match, not endswith)
+_LOG_WORDS = {"debug", "info", "warn", "warning", "error",
+              "exception", "critical", "log", "print"}
+_METRIC_TAILS = ("inc", "observe")
+# set/add only count when the receiver is recognizably a metric:
+# `asyncio.Event.set()` / builtin-`set.add()` handlers are swallows
+_AMBIGUOUS_METRIC_TAILS = ("set", "add")
+_METRIC_HINTS = ("metric", "counter", "gauge", "histogram")
+
+
+def _is_log_call(tail: str) -> bool:
+    return tail in _LOG_WORDS or \
+        any(part in _LOG_WORDS for part in tail.split("_"))
+
+
+def _is_metric_call(node: ast.Call, tail: str) -> bool:
+    if tail in _METRIC_TAILS:
+        return True
+    if tail not in _AMBIGUOUS_METRIC_TAILS:
+        return False
+    chain = call_name(node).lower().split(".")[:-1]
+    if any(h in part for part in chain for h in _METRIC_HINTS):
+        return True
+    # family.with_labels(...).add(1): call_name truncates the chain
+    # at the inner call, so look one hop through it
+    fn = node.func
+    return (isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Call)
+            and call_name(fn.value).rsplit(".", 1)[-1] == "with_labels")
+
+
+def _is_broad(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    if isinstance(t, ast.Name):
+        return t.id in _BROAD
+    if isinstance(t, ast.Attribute):
+        return t.attr in _BROAD
+    if isinstance(t, ast.Tuple):
+        return any(isinstance(e, ast.Name) and e.id in _BROAD
+                   or isinstance(e, ast.Attribute) and e.attr in _BROAD
+                   for e in t.elts)
+    return False
+
+
+def _handles(handler: ast.ExceptHandler) -> bool:
+    exc_name = handler.name
+    # walk_scope: a raise/log/metric inside a nested def or lambda
+    # only runs if that function is later invoked — at the except
+    # site the failure is still dropped silently
+    for node in walk_scope(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            tail = call_name(node).rsplit(".", 1)[-1]
+            if _is_log_call(tail) or _is_metric_call(node, tail):
+                return True
+        if exc_name and isinstance(node, ast.Name) and \
+                node.id == exc_name and \
+                isinstance(node.ctx, ast.Load):
+            return True
+    if exc_name:
+        # the bound exception escaping into a closure still delegates
+        # it (`except Exception as e: defer(lambda: handle(e))`)
+        for node in ast.walk(handler):
+            if isinstance(node, ast.Name) and node.id == exc_name \
+                    and isinstance(node.ctx, ast.Load):
+                return True
+    return False
+
+
+class SwallowedExceptionChecker(Checker):
+    rule = "swallowed-exception"
+    description = ("broad except whose body neither logs, re-raises, "
+                   "records a metric, nor uses the exception")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.ExceptHandler):
+            if not _is_broad(node) or _handles(node):
+                continue
+            yield ctx.finding(
+                self.rule, node,
+                "broad except swallows the failure — log it (with "
+                "context), record a metric, re-raise, or narrow the "
+                "exception type; silent drops defeat supervision "
+                "and the flight recorder")

@@ -1,0 +1,92 @@
+"""unbounded-label: metric label values must be bounded at the call
+site.
+
+PR 1 capped the ``with_labels`` memo and PR 4 added the runtime
+``overflow`` series (> _CHILDREN_MAX label sets collapse) after
+unbounded label cardinality was shown to grow scrape size and memory
+without limit.  The runtime guard is the backstop; this rule is the
+front door — every ``with_labels(...)`` argument must be visibly
+bounded at the call site:
+
+  * a literal (str/int/bool constant), or
+  * a name in the reviewed-bounded allowlist below (small closed
+    enumerations: config lanes, backend names, breaker states...), or
+  * ``str(x)``/f-string of such a name.
+
+Anything else — peer ids, channel ids formatted from the wire,
+heights, error strings — is potential cardinality and must be
+suppressed or baselined with a reason (usually "bounded by runtime
+overflow collapse" or "bounded by max peer count").
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from ..core import Checker, FileContext, Finding
+
+# Variable names reviewed as small closed enumerations.  Add a name
+# here only when every assignment to it in the repo is provably
+# bounded (config enum, hard-coded choice set) — when in doubt,
+# baseline the call site instead so the review trail stays visible.
+ALLOWED_NAMES = {
+    "lane",          # mempool lanes: closed set from genesis config
+    "backend",       # batch-verify backend: {tpu, cpu, native, pure}
+    "kind",          # supervisor task kind: hard-coded per spawn site
+    "state",         # breaker state name: {closed, open, half_open}
+    "conn_name",     # ABCI app connection: 4 named conns
+    "choice",        # kernel dispatch choice: closed set in ops
+    "vt_label",      # vote type: {prevote, precommit}
+    "timely",        # PBTS timeliness: {true, false}
+}
+
+
+def _bounded(arg: ast.expr) -> bool:
+    if isinstance(arg, ast.Constant):
+        return True
+    if isinstance(arg, ast.Name):
+        return arg.id in ALLOWED_NAMES
+    # "accepted" if ok else "rejected": both arms bounded -> bounded
+    if isinstance(arg, ast.IfExp):
+        return _bounded(arg.body) and _bounded(arg.orelse)
+    # str(name) / f"{name}" of an allowlisted name stays bounded
+    if isinstance(arg, ast.Call) and \
+            isinstance(arg.func, ast.Name) and arg.func.id == "str" \
+            and len(arg.args) == 1:
+        return _bounded(arg.args[0])
+    if isinstance(arg, ast.JoinedStr):
+        return all(_bounded(v.value) for v in arg.values
+                   if isinstance(v, ast.FormattedValue))
+    return False
+
+
+def _offender(call: ast.Call) -> Optional[ast.expr]:
+    for arg in list(call.args) + [kw.value for kw in call.keywords]:
+        if not _bounded(arg):
+            return arg
+    return None
+
+
+class UnboundedLabelChecker(Checker):
+    rule = "unbounded-label"
+    description = ("with_labels() argument is not a literal or "
+                   "reviewed-bounded name: metric cardinality risk")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for node in ctx.nodes(ast.Call):
+            fn = node.func
+            if not (isinstance(fn, ast.Attribute)
+                    and fn.attr == "with_labels"):
+                continue
+            bad = _offender(node)
+            if bad is None:
+                continue
+            desc = ast.unparse(bad) if hasattr(ast, "unparse") \
+                else type(bad).__name__
+            yield ctx.finding(
+                self.rule, node,
+                f"label value `{desc}` is not a literal or "
+                f"reviewed-bounded name — unbounded label values "
+                f"grow scrape size/memory until the runtime overflow "
+                f"collapse kicks in; bound it at the call site or "
+                f"baseline with the boundedness argument")

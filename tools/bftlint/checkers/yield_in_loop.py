@@ -1,0 +1,109 @@
+"""yield-in-loop: every ``continue`` path in an async hot loop must
+await.
+
+The PR 1 livelock: ``_gossip_data_routine``'s proposal branch
+``continue``d without yielding when the peer send-queue was full, so
+the event loop spun forever on one coroutine and the whole node wedged
+— no crash, no log, just a 100% CPU core and no progress.  The
+nemesis runner caught it once; this rule keeps it caught.
+
+For each ``while True:`` (or other constant-true) loop inside an
+``async def``, the checker takes every ``continue`` owned by that loop
+and asks: can anything on the way to this ``continue`` suspend?  It
+collects the subtrees of all statements that lexically precede the
+``continue`` at each nesting level inside the loop (plus enclosing
+``if``/``while`` tests, which may await) and looks for ``await`` /
+``async for`` / ``async with``.  If no suspension point can possibly
+execute before the ``continue``, one starved branch becomes a busy
+loop — flagged.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Iterator
+
+from ..core import Checker, FileContext, Finding, walk_scope
+
+
+def _is_const_true(test: ast.expr) -> bool:
+    return isinstance(test, ast.Constant) and bool(test.value) is True
+
+
+def _has_suspension(nodes) -> bool:
+    # an await inside a nested def/lambda defined before the continue
+    # never ran on this path — it is not a suspension
+    for root in nodes:
+        if isinstance(root, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        for node in walk_scope(root):
+            if isinstance(node, (ast.Await, ast.AsyncFor,
+                                 ast.AsyncWith)):
+                return True
+    return False
+
+
+def _owning_loop(ctx: FileContext, cont: ast.Continue):
+    for anc in ctx.ancestors(cont):
+        if isinstance(anc, (ast.While, ast.For, ast.AsyncFor)):
+            return anc
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return None
+    return None
+
+
+class YieldInLoopChecker(Checker):
+    rule = "yield-in-loop"
+    description = ("continue path in an async while-True loop with no "
+                   "possible await: event-loop livelock")
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        for loop in ctx.nodes(ast.While):
+            if not _is_const_true(loop.test):
+                continue
+            fn = ctx.enclosing_function(loop)
+            if not isinstance(fn, ast.AsyncFunctionDef):
+                continue
+            for cont in ast.walk(loop):
+                if not isinstance(cont, ast.Continue) or \
+                        _owning_loop(ctx, cont) is not loop:
+                    continue
+                # everything that could run before this continue:
+                # preceding siblings at each block level up to the
+                # loop, plus the tests of enclosing if/while nodes
+                before: list[ast.AST] = []
+                node: ast.AST = cont
+                while node is not loop:
+                    parent = ctx.parent(node)
+                    if parent is None:      # pragma: no cover
+                        break
+                    for fname in ("body", "orelse", "finalbody"):
+                        block = getattr(parent, fname, None)
+                        if isinstance(block, list) and node in block:
+                            before.extend(
+                                block[:block.index(node)])
+                    if isinstance(parent, ast.Try):
+                        # sibling except handlers are alternatives,
+                        # never predecessors — an await there cannot
+                        # have run on this path.  The try body *may*
+                        # have suspended before raising into a
+                        # handler (and fully ran before orelse /
+                        # partially before finalbody), so it counts.
+                        if node in parent.handlers or \
+                                (parent.orelse and
+                                 node in parent.orelse) or \
+                                (parent.finalbody and
+                                 node in parent.finalbody):
+                            before.extend(parent.body)
+                    if isinstance(parent, (ast.If, ast.While)) and \
+                            parent is not loop:
+                        before.append(parent.test)
+                    node = parent
+                if not _has_suspension(before):
+                    yield ctx.finding(
+                        self.rule, cont,
+                        "this continue can be reached without any "
+                        "await since the loop iteration began — a "
+                        "persistently-true branch busy-spins the "
+                        "event loop (the PR 1 gossip livelock); "
+                        "await before continuing, or asyncio.sleep(0)")

@@ -1,0 +1,190 @@
+"""bftlint CLI — ``run | check | baseline``, mirroring perf_lab's
+gate pattern (run for humans, check for CI, baseline to commit the
+current floor).
+
+  run        lint, print every finding (baselined ones marked), exit 0
+  check      lint, print NEW findings only; exit 1 on any new
+             finding or stale baseline entry (the tier-1 gate —
+             tests/test_bftlint.py runs this)
+  baseline   rewrite bftlint_baseline.json from the current findings,
+             preserving existing justifications
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from . import baseline as baseline_mod
+from .checkers import ALL_CHECKERS
+from .core import lint_paths
+from .reporters import json_report, text_report
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+DEFAULT_BASELINE = os.path.join(_REPO_ROOT, "bftlint_baseline.json")
+DEFAULT_PATHS = (os.path.join(_REPO_ROOT, "cometbft_tpu"),)
+
+
+def _logical(p: str) -> str:
+    return os.path.relpath(os.path.abspath(p),
+                           _REPO_ROOT).replace(os.sep, "/")
+
+
+class _ExaminedPaths:
+    """Logical paths a path-filtered run re-examined: every scanned
+    file, plus everything under a directory argument — a *deleted*
+    file's baseline entry under that directory was re-examined too,
+    so it must surface stale (and leave the baseline) instead of
+    being masked by exact scanned-file membership and carried
+    forever."""
+
+    def __init__(self, arg_paths, scanned: set[str]):
+        self._scanned = scanned
+        prefixes = []
+        for p in arg_paths:
+            if not os.path.isdir(p):
+                continue
+            lp = _logical(p)
+            # the repo root itself relativizes to "." — every
+            # logical path is under it, not under "./"
+            prefixes.append("" if lp == "." else lp + "/")
+        self._dir_prefixes = tuple(prefixes)
+
+    def __contains__(self, fpath: str) -> bool:
+        if fpath in self._scanned:
+            return True
+        return bool(self._dir_prefixes) and \
+            fpath.startswith(self._dir_prefixes)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.bftlint",
+        description=__doc__.splitlines()[0])
+    ap.add_argument("mode", choices=("run", "check", "baseline"),
+                    nargs="?", default="run")
+    ap.add_argument("paths", nargs="*",
+                    help="files/dirs to lint (default: cometbft_tpu/)")
+    ap.add_argument("--rules", default="",
+                    help="comma-separated rule subset")
+    ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline file (fixture tests)")
+    ap.add_argument("--format", choices=("text", "json"),
+                    default="text")
+    ap.add_argument("--verbose", action="store_true",
+                    help="also print baselined findings (text mode)")
+    # intermixed: `check path/to/file.py --no-baseline` must parse
+    args = ap.parse_intermixed_args(argv)
+
+    rules = {r.strip() for r in args.rules.split(",") if r.strip()} \
+        or None
+    if rules:
+        known = {c.rule for c in ALL_CHECKERS}
+        unknown = rules - known
+        if unknown:
+            print(f"unknown rule(s): {', '.join(sorted(unknown))}; "
+                  f"known: {', '.join(sorted(known))}",
+                  file=sys.stderr)
+            return 2
+
+    # a typo'd path must not read as a clean pass from the CI gate
+    missing = [p for p in args.paths if not os.path.exists(p)]
+    if missing:
+        print(f"no such path(s): {', '.join(missing)}",
+              file=sys.stderr)
+        return 2
+    non_py = [p for p in args.paths
+              if os.path.isfile(p) and not p.endswith(".py")]
+    if non_py:
+        # iter_python_files would silently skip it and the gate
+        # would pass without ever examining the named file
+        print(f"not Python file(s): {', '.join(non_py)}",
+              file=sys.stderr)
+        return 2
+
+    paths = args.paths or list(DEFAULT_PATHS)
+    result = lint_paths(paths, ALL_CHECKERS, rules=rules)
+    if args.paths and not result.files_scanned:
+        print(f"no Python files found under: {', '.join(args.paths)}",
+              file=sys.stderr)
+        return 2
+
+    if args.mode == "baseline":
+        if result.parse_errors:
+            # an unparseable file yields no findings, so an
+            # unfiltered rewrite would silently drop all of that
+            # file's entries and their audited justifications
+            for err in result.parse_errors:
+                print(f"parse error: {err}", file=sys.stderr)
+            print("refusing to rewrite the baseline with files "
+                  "unparsed — fix them and rerun", file=sys.stderr)
+            return 2
+        try:
+            prev = baseline_mod.load(args.baseline)
+        except ValueError as e:
+            # a corrupt/mismatched file must not be silently rewritten
+            # — that would replace every audited justification with
+            # the placeholder; make the operator fix or delete it
+            print(f"refusing to rewrite {args.baseline}: {e}\n"
+                  f"fix the file (or delete it to start fresh), then "
+                  f"rerun", file=sys.stderr)
+            return 2
+        # a rule- or path-filtered run must not wipe entries it
+        # didn't re-examine; an unfiltered run shrinks the file to
+        # exactly the current findings
+        n = baseline_mod.write(
+            args.baseline, result.findings, previous=prev,
+            active_rules=rules,
+            scanned_paths=(_ExaminedPaths(args.paths,
+                                          result.scanned_paths)
+                           if args.paths else None))
+        print(f"baseline written: {args.baseline} ({n} entries "
+              f"covering {len(result.findings)} findings)")
+        return 0
+
+    base = {} if args.no_baseline \
+        else baseline_mod.load(args.baseline)
+    if base and (rules is not None or args.paths):
+        # a rule-/path-filtered run only re-examined a subset of the
+        # baseline; diffing against the full file would falsely
+        # report every out-of-filter entry as stale
+        examined_paths = _ExaminedPaths(args.paths,
+                                        result.scanned_paths)
+
+        def _examined(fp: str) -> bool:
+            parts = fp.split("::", 3)
+            if len(parts) < 2:
+                # a mangled fingerprint matches no finding: keep the
+                # entry in the diff so it surfaces stale instead of
+                # crashing the filtered run
+                return True
+            rule, fpath = parts[:2]
+            if rules is not None and rule not in rules:
+                return False
+            if args.paths and fpath not in examined_paths:
+                return False
+            return True
+        base = {fp: e for fp, e in base.items() if _examined(fp)}
+    diff = baseline_mod.diff(result.findings, base)
+    active = sorted(c.rule for c in ALL_CHECKERS
+                    if rules is None or c.rule in rules)
+    if args.format == "json":
+        sys.stdout.write(json_report(result, diff, active))
+    else:
+        print(text_report(result, diff,
+                          verbose=args.verbose
+                          or args.mode == "run"))
+
+    if result.parse_errors:
+        return 2
+    # stale entries fail check too: tests/test_bftlint.py gates on
+    # them, so the local command must not give a false green
+    if args.mode == "check" and (diff.new or diff.stale):
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

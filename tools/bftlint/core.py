@@ -1,0 +1,287 @@
+"""bftlint core: file contexts, the checker protocol, and the driver.
+
+Design mirrors what the repo's ad-hoc AST guards already did well
+(tests/test_supervised_tasks_ast.py — parse once, walk, explain the
+invariant in the message) and adds what they lacked: one parse per
+file shared by every checker, parent/scope tracking, inline
+suppressions, and a committed baseline for grandfathered findings so
+``check`` can gate CI at zero new findings from day one.
+"""
+from __future__ import annotations
+
+import ast
+import fnmatch
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+# ``# bftlint: disable=rule-a,rule-b`` on a flagged line suppresses
+# those rules on that line; on a comment-only line it applies to the
+# next code line.  ``# bftlint: disable-file=rule`` anywhere in the
+# first _FILE_PRAGMA_LINES lines suppresses the rule file-wide.
+# ``# bftlint: path=<logical path>`` (fixture files) overrides the
+# path used for scope matching, so tests can exercise path-scoped
+# checkers from tests/bftlint_fixtures/.
+_SUPPRESS_RE = re.compile(r"#\s*bftlint:\s*disable=([\w,\-]+)")
+_SUPPRESS_FILE_RE = re.compile(r"#\s*bftlint:\s*disable-file=([\w,\-]+)")
+_PATH_RE = re.compile(r"#\s*bftlint:\s*path=(\S+)")
+_FILE_PRAGMA_LINES = 15
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+@dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str           # repo-relative posix path (logical, see above)
+    line: int
+    col: int
+    message: str
+    scope: str          # dotted class/def chain enclosing the node
+    snippet: str        # stripped source of the flagged line
+
+    @property
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the baseline: findings
+        keep matching their grandfather entry across unrelated edits
+        that only shift line numbers."""
+        return "::".join((self.rule, self.path, self.scope,
+                          self.snippet))
+
+    def location(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}"
+
+
+class FileContext:
+    """One parsed file, shared by every checker.
+
+    Provides the parent map, dotted scope lookup, async-enclosure
+    tests and the suppression index so checkers stay small.
+    """
+
+    def __init__(self, path: str, source: str,
+                 repo_root: str = _REPO_ROOT):
+        self.abs_path = os.path.abspath(path)
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        rel = os.path.relpath(self.abs_path, repo_root)
+        self.rel_path = rel.replace(os.sep, "/")
+        self.logical_path = self.rel_path
+        # one walk serves everyone: the parent map and a by-type node
+        # index (checkers iterate ctx.nodes(ast.Call) instead of
+        # re-walking the whole tree 8 times — see the
+        # bftlint_selfcheck benchmark in tools/perf_lab.py)
+        self._parents: dict[ast.AST, ast.AST] = {}
+        self._by_type: dict[type, list[ast.AST]] = {}
+        for node in ast.walk(self.tree):
+            self._by_type.setdefault(type(node), []).append(node)
+            for child in ast.iter_child_nodes(node):
+                self._parents[child] = node
+        self._line_suppress: dict[int, set[str]] = {}
+        self._file_suppress: set[str] = set()
+        self._index_pragmas()
+
+    # -- pragmas ------------------------------------------------------
+    def _index_pragmas(self) -> None:
+        pending: set[str] = set()
+        for i, raw in enumerate(self.lines, start=1):
+            if i <= _FILE_PRAGMA_LINES:
+                m = _PATH_RE.search(raw)
+                if m:
+                    self.logical_path = m.group(1)
+                m = _SUPPRESS_FILE_RE.search(raw)
+                if m:
+                    self._file_suppress.update(
+                        r.strip() for r in m.group(1).split(","))
+            m = _SUPPRESS_RE.search(raw)
+            code = raw.split("#", 1)[0].strip()
+            if m:
+                rules = {r.strip() for r in m.group(1).split(",")}
+                if code:        # trailing comment: this line only —
+                    # plus any pending comment-only pragma, which
+                    # targets this code line too (it must not leak
+                    # past it to a later line)
+                    self._line_suppress.setdefault(i, set()) \
+                        .update(rules | pending)
+                    pending = set()
+                else:           # comment-only line: the next code line
+                    pending |= rules
+            elif code and pending:
+                self._line_suppress.setdefault(i, set()) \
+                    .update(pending)
+                pending = set()
+
+    def suppressed(self, line: int, rule: str) -> bool:
+        if rule in self._file_suppress:
+            return True
+        return rule in self._line_suppress.get(line, set())
+
+    # -- tree helpers -------------------------------------------------
+    def nodes(self, *types: type) -> Iterator[ast.AST]:
+        """All nodes of the given AST types, in walk order — the
+        shared index that keeps every checker O(relevant nodes)
+        instead of O(whole tree)."""
+        for t in types:
+            yield from self._by_type.get(t, ())
+
+    def parent(self, node: ast.AST) -> Optional[ast.AST]:
+        return self._parents.get(node)
+
+    def ancestors(self, node: ast.AST) -> Iterator[ast.AST]:
+        cur = self._parents.get(node)
+        while cur is not None:
+            yield cur
+            cur = self._parents.get(cur)
+
+    def scope_of(self, node: ast.AST) -> str:
+        names = []
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.ClassDef)):
+                names.append(anc.name)
+        return ".".join(reversed(names)) or "<module>"
+
+    def enclosing_function(self, node: ast.AST):
+        for anc in self.ancestors(node):
+            if isinstance(anc, (ast.FunctionDef,
+                                ast.AsyncFunctionDef)):
+                return anc
+        return None
+
+    def in_async_def(self, node: ast.AST) -> bool:
+        return isinstance(self.enclosing_function(node),
+                          ast.AsyncFunctionDef)
+
+    def snippet(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def finding(self, rule: str, node: ast.AST,
+                message: str) -> Finding:
+        return Finding(rule=rule, path=self.logical_path,
+                       line=node.lineno, col=node.col_offset,
+                       message=message,
+                       scope=self.scope_of(node),
+                       snippet=self.snippet(node.lineno))
+
+
+def walk_scope(root: ast.AST) -> Iterator[ast.AST]:
+    """``ast.walk`` that does not descend into nested function
+    definitions or lambdas: lexically-nested code does not execute in
+    the enclosing function's control flow, so flow-sensitive checkers
+    (yield-in-loop, await-atomicity) must not credit or blame its
+    awaits/loads/stores to the outer function.  ``root`` itself is
+    yielded even when it is a function def."""
+    stack: list[ast.AST] = [root]
+    while stack:
+        node = stack.pop()
+        yield node
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef,
+                                  ast.AsyncFunctionDef, ast.Lambda)):
+                continue
+            stack.append(child)
+
+
+def call_name(node: ast.Call) -> str:
+    """Dotted best-effort name of a call target: ``time.time``,
+    ``asyncio.create_task``, ``self.metrics.x.with_labels`` ->
+    ``with_labels`` keeps only the tail attribute chain of Names and
+    Attributes (subscripts/calls in the chain truncate it)."""
+    parts: list[str] = []
+    cur = node.func
+    while True:
+        if isinstance(cur, ast.Attribute):
+            parts.append(cur.attr)
+            cur = cur.value
+        elif isinstance(cur, ast.Name):
+            parts.append(cur.id)
+            break
+        else:
+            break
+    return ".".join(reversed(parts))
+
+
+class Checker:
+    """A single rule.  Subclasses set ``rule``/``description``, may
+    narrow ``scope`` (fnmatch patterns over the logical repo-relative
+    path; empty = every file), and implement ``check``."""
+
+    rule: str = ""
+    description: str = ""
+    scope: tuple[str, ...] = ()
+
+    def in_scope(self, logical_path: str) -> bool:
+        if not self.scope:
+            return True
+        return any(fnmatch.fnmatch(logical_path, pat)
+                   for pat in self.scope)
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+
+@dataclass
+class LintResult:
+    findings: list[Finding] = field(default_factory=list)
+    files_scanned: int = 0
+    scanned_paths: set[str] = field(default_factory=set)  # logical
+    parse_errors: list[str] = field(default_factory=list)
+
+
+def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
+    # overlapping arguments (`check pkg pkg/file.py`) must not lint a
+    # file twice — duplicate findings would overflow count-capped
+    # baseline entries and read as new
+    seen: set[str] = set()
+    for p in paths:
+        if os.path.isfile(p):
+            files = [p] if p.endswith(".py") else []
+        else:
+            files = []
+            for root, dirs, names in os.walk(p):
+                dirs[:] = sorted(d for d in dirs
+                                 if d != "__pycache__"
+                                 and not d.startswith("."))
+                files.extend(os.path.join(root, f)
+                             for f in sorted(names)
+                             if f.endswith(".py"))
+        for f in files:
+            real = os.path.realpath(f)
+            if real not in seen:
+                seen.add(real)
+                yield f
+
+
+def lint_paths(paths: Iterable[str], checkers: Iterable[Checker],
+               rules: Optional[set[str]] = None,
+               repo_root: str = _REPO_ROOT) -> LintResult:
+    """Parse each file once, run every in-scope checker over it, and
+    drop inline-suppressed findings.  Baseline filtering is the
+    caller's concern (tools/bftlint/baseline.py)."""
+    checkers = list(checkers)
+    if rules:
+        checkers = [c for c in checkers if c.rule in rules]
+    result = LintResult()
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                source = f.read()
+            ctx = FileContext(path, source, repo_root=repo_root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            result.parse_errors.append(f"{path}: {e}")
+            continue
+        result.files_scanned += 1
+        result.scanned_paths.add(ctx.logical_path)
+        for checker in checkers:
+            if not checker.in_scope(ctx.logical_path):
+                continue
+            for finding in checker.check(ctx):
+                if not ctx.suppressed(finding.line, finding.rule):
+                    result.findings.append(finding)
+    result.findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return result

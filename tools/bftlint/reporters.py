@@ -1,0 +1,55 @@
+"""bftlint reporters: text for humans, JSON for tooling."""
+from __future__ import annotations
+
+import json
+from typing import Iterable
+
+from .baseline import BaselineDiff
+from .core import Finding, LintResult
+
+
+def text_report(result: LintResult, diff: BaselineDiff,
+                verbose: bool = False) -> str:
+    lines: list[str] = []
+    for f in diff.new:
+        lines.append(f"{f.location()}: [{f.rule}] {f.message}")
+        lines.append(f"    {f.snippet}")
+    if verbose:
+        for f in diff.baselined:
+            lines.append(f"{f.location()}: [{f.rule}] baselined: "
+                         f"{f.message}")
+    for fp in diff.stale:
+        lines.append(f"stale baseline entry (site fixed or moved — "
+                     f"rerun `baseline` to shrink the file): {fp}")
+    for err in result.parse_errors:
+        lines.append(f"parse error: {err}")
+    lines.append(
+        f"bftlint: {result.files_scanned} files, "
+        f"{len(diff.new)} new finding(s), "
+        f"{len(diff.baselined)} baselined, "
+        f"{len(diff.stale)} stale baseline entr(ies)")
+    return "\n".join(lines)
+
+
+def _finding_obj(f: Finding, baselined: bool) -> dict:
+    return {"rule": f.rule, "path": f.path, "line": f.line,
+            "col": f.col, "scope": f.scope, "message": f.message,
+            "snippet": f.snippet, "fingerprint": f.fingerprint,
+            "baselined": baselined}
+
+
+def json_report(result: LintResult, diff: BaselineDiff,
+                rules: Iterable[str]) -> str:
+    return json.dumps({
+        "schema": 1,
+        "files_scanned": result.files_scanned,
+        "rules": sorted(rules),
+        "findings": ([_finding_obj(f, False) for f in diff.new]
+                     + [_finding_obj(f, True)
+                        for f in diff.baselined]),
+        "stale_baseline": diff.stale,
+        "parse_errors": result.parse_errors,
+        "counts": {"new": len(diff.new),
+                   "baselined": len(diff.baselined),
+                   "stale": len(diff.stale)},
+    }, indent=2, sort_keys=True) + "\n"

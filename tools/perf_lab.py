@@ -17,7 +17,11 @@ decomposition the flight recorder attributes per height):
   * ``tracing_disabled_span``  — the flight-recorder disabled path
     (tier-1 separately guards < 1µs);
   * ``p2p_loopback_send``      — MConnection framing/scheduling cost
-    per message over an in-memory pipe (no sockets, no crypto).
+    per message over an in-memory pipe (no sockets, no crypto);
+  * ``bftlint_selfcheck``      — the full-package bftlint run that
+    gates tier-1 (tests/test_bftlint.py); a pathological checker
+    (an accidental O(n^2) walk) must not blow the tier-1 budget, so
+    this is pinned < ~5s via an explicit tolerance.
 
 Modes:
   run                 run the suite, print a JSON report
@@ -269,6 +273,20 @@ def bench_p2p_loopback_send(fast: bool):
     }
 
 
+def bench_bftlint_selfcheck(fast: bool):
+    from tools.bftlint import lint_paths
+    from tools.bftlint.checkers import ALL_CHECKERS
+    pkg = os.path.join(_REPO_ROOT, "cometbft_tpu")
+
+    def run():
+        result = lint_paths([pkg], ALL_CHECKERS)
+        if result.parse_errors:
+            raise RuntimeError(
+                f"bftlint parse errors: {result.parse_errors}")
+
+    return measure(run, reps=2 if fast else 4, warmup=1)
+
+
 # name -> (fn, in_fast_subset)
 BENCHMARKS = {
     "batch_verify_cpu_pad64": (bench_batch_verify_pad64, True),
@@ -279,6 +297,7 @@ BENCHMARKS = {
     "metrics_observe": (bench_metrics_observe, True),
     "tracing_disabled_span": (bench_tracing_disabled_span, True),
     "p2p_loopback_send": (bench_p2p_loopback_send, True),
+    "bftlint_selfcheck": (bench_bftlint_selfcheck, True),
 }
 
 
