@@ -142,6 +142,9 @@ class KVStoreApplication(abci.Application):
         self.abci_delays: dict[str, float] = {}
         self._height = 0
         self._size = 0
+        # (height, sorted kv pairs, key->index, hashed leaves) for
+        # /multistore; rebuilt lazily, dropped on commit/restore
+        self._multistore_memo = None
         self._load_state()
 
     # ------------------------------------------------------------------
@@ -325,6 +328,11 @@ class KVStoreApplication(abci.Application):
             if len(parts) != 2:
                 raise RuntimeError(f"unexpected tx format: {tx!r}")
             self.db.set(_KV_PREFIX + parts[0], parts[1])
+        # the kv writes land HERE, not in finalize_block (which
+        # already bumped _height) — drop the multistore memo so a
+        # prove=true batch never re-serves the pre-commit snapshot
+        # under the new height for the rest of the block
+        self._multistore_memo = None
         self._save_state()
         if self.snapshot_interval > 0 and self._height > 0 and \
                 self._height % self.snapshot_interval == 0:
@@ -352,6 +360,7 @@ class KVStoreApplication(abci.Application):
 
     def _restore_state(self, raw: bytes) -> None:
         import json as _json
+        self._multistore_memo = None
         d = _json.loads(raw)
         for k, _ in list(self.db.iterator()):
             self.db.delete(k)
@@ -409,6 +418,8 @@ class KVStoreApplication(abci.Application):
             result=abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT)
 
     async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
+        if req.path == "/multistore":
+            return self._multistore_query(req)
         if req.path == "/val":
             value = self.db.get(
                 (VALIDATOR_PREFIX + req.data.decode()).encode()) or b""
@@ -422,6 +433,62 @@ class KVStoreApplication(abci.Application):
             key=req.data,
             value=value or b"",
             log="exists" if value is not None else "does not exist",
+            height=self._height,
+        )
+
+    # ------------------------------------------------------------------
+    def _multistore_query(self, req: abci.QueryRequest
+                          ) -> abci.QueryResponse:
+        """Batched provable lookup (lightserve.core.MULTISTORE_PATH):
+        request data is JSON {"keys": [hex...]}; the response value is
+        JSON carrying every found (key, value) pair plus ONE compact
+        multiproof over the app's state tree — sorted kv pairs hashed
+        with the ValueOp leaf binding, so a client replays
+        merkle.value_op_leaf per pair and verifies the batch in one
+        Multiproof.verify.  The root is the state-tree commitment;
+        like the per-key kvstore query it is not bound into app_hash
+        (the reference app hashes only its size).
+
+        The sorted pair list + hashed leaves are memoized per
+        committed height — thousands of light clients batching
+        queries against one height must not each pay an O(n) store
+        scan and re-hash (only the requested-indices proof walk is
+        per-request)."""
+        from ..crypto import merkle
+        try:
+            keys = [bytes.fromhex(k)
+                    for k in json.loads(req.data)["keys"]]
+        except (ValueError, KeyError, TypeError) as e:
+            return abci.QueryResponse(
+                code=CODE_TYPE_ENCODING_ERROR,
+                log=f"bad multistore request: {e}")
+        memo = self._multistore_memo
+        if memo is None or memo[0] != self._height:
+            pairs = sorted(
+                (k[len(_KV_PREFIX):], v)
+                for k, v in self.db.iterator()
+                if k.startswith(_KV_PREFIX))
+            index_of = {k: i for i, (k, _) in enumerate(pairs)}
+            leaves = [merkle.value_op_leaf(k, v) for k, v in pairs]
+            memo = (self._height, pairs, index_of, leaves)
+            self._multistore_memo = memo
+        _, pairs, index_of, leaves = memo
+        indices = sorted(index_of[k] for k in set(keys)
+                         if k in index_of)
+        missing = sorted(k.hex() for k in set(keys)
+                         if k not in index_of)
+        root, mp = merkle.multiproof_from_byte_slices(leaves, indices)
+        return abci.QueryResponse(
+            key=req.data,
+            value=json.dumps({
+                "root": root.hex(),
+                "total": len(pairs),
+                "indices": indices,
+                "keys": [pairs[i][0].hex() for i in indices],
+                "values": [pairs[i][1].hex() for i in indices],
+                "missing": missing,
+                "multiproof": mp.to_dict(),
+            }).encode(),
             height=self._height,
         )
 

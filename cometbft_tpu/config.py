@@ -63,6 +63,10 @@ class RPCConfig:
     unsafe: bool = False      # enables dial_seeds/dial_peers/
                               # unsafe_flush_mempool (reference:
                               # config.go RPCConfig.Unsafe)
+    # lightserve response cache budget: immutable height-keyed RPC
+    # responses (blocks, commits, light blocks, multiproofs below the
+    # tip) held in RAM; 0 disables (docs/light_proofs.md)
+    cache_max_bytes: int = 32 * 1024 * 1024
 
 
 @dataclass

@@ -172,6 +172,172 @@ def _trails_from_leaf_hashes(hashes: Sequence[bytes]):
     return lefts + rights, root
 
 
+# -- compact multiproofs ----------------------------------------------------
+# One proof object covering many leaves of one tree, sharing the
+# interior hashes every per-leaf Proof would repeat ("Compact Merkle
+# Multiproofs", PAPERS.md).  Layout: the proven leaf positions
+# (`indices`, canonical sorted-unique) plus the roots of every maximal
+# subtree containing NO proven leaf (`aunts`), emitted in the
+# deterministic left-to-right order a pre-order walk of the RFC-6962
+# split-point tree visits them.  Verification replays the same walk,
+# consuming leaf hashes at proven positions and aunts everywhere else,
+# so builder and verifier agree on the order by construction and the
+# proof needs no per-aunt position tags.
+
+
+@dataclass
+class Multiproof:
+    """Compact inclusion proof for several leaves of one merkle tree.
+
+    Wire parity with Proof: ints for total/indices, hex hashes in
+    to_dict/from_dict.  ``verify`` takes the raw leaf values (what the
+    caller fetched and wants proven) in ``indices`` order and raises
+    ValueError on any mismatch, like Proof.verify."""
+    total: int
+    indices: list[int] = field(default_factory=list)
+    aunts: list[bytes] = field(default_factory=list)
+
+    def validate_basic(self) -> None:
+        if self.total < 0:
+            raise ValueError("multiproof total must be >= 0")
+        prev = -1
+        for i in self.indices:
+            if i <= prev:
+                raise ValueError(
+                    "multiproof indices must be sorted and unique")
+            prev = i
+        if self.indices and self.indices[-1] >= self.total:
+            raise ValueError("multiproof index out of range")
+
+    def verify(self, root: bytes, leaves: Sequence[bytes]) -> None:
+        """Verify ``leaves`` (raw tree ITEMS, aligned with
+        ``indices``) against ``root``; each gets the RFC-6962 leaf
+        prefix hash on the way in.  NOTE: for the tx tree the items
+        are the per-tx sha256 digests (types/tx.py txs_hash) — pass
+        the digests HERE, they are not yet leaf hashes.  Use
+        verify_hashes only with true leaf-prefix hashes
+        (``leaf_hash(item)``)."""
+        from ._native_loader import batched_hashes
+        hashes = batched_hashes("leaf_hashes", list(leaves))
+        if hashes is None:
+            hashes = [leaf_hash(leaf) for leaf in leaves]
+        self.verify_hashes(root, hashes)
+
+    def verify_hashes(self, root: bytes,
+                      leaf_hashes: Sequence[bytes]) -> None:
+        computed = self.compute_root_hash(leaf_hashes)
+        if computed != root:
+            raise ValueError("invalid multiproof: root mismatch")
+
+    def compute_root_hash(self, leaf_hashes: Sequence[bytes]) -> bytes:
+        self.validate_basic()
+        if len(leaf_hashes) != len(self.indices):
+            raise ValueError(
+                f"multiproof expects {len(self.indices)} leaves, "
+                f"got {len(leaf_hashes)}")
+        aunts = iter(self.aunts)
+        hashes = iter(leaf_hashes)
+        pos = 0                       # next unconsumed index pointer
+
+        def walk(lo: int, hi: int) -> bytes:
+            nonlocal pos
+            if pos >= len(self.indices) or self.indices[pos] >= hi:
+                # no proven leaf in [lo, hi): one pre-supplied subtree
+                # root covers the whole range
+                try:
+                    return next(aunts)
+                except StopIteration:
+                    raise ValueError(
+                        "invalid multiproof: missing aunts") from None
+            if hi - lo == 1:
+                pos += 1
+                return next(hashes)
+            k = lo + _split_point(hi - lo)
+            left = walk(lo, k)
+            right = walk(k, hi)
+            return inner_hash(left, right)
+
+        if self.total == 0:
+            if self.aunts or self.indices:
+                raise ValueError(
+                    "unexpected aunts/indices for empty tree")
+            return empty_hash()
+        out = walk(0, self.total)
+        if pos != len(self.indices):
+            raise ValueError("invalid multiproof: unconsumed indices")
+        try:
+            next(aunts)
+        except StopIteration:
+            return out
+        raise ValueError("invalid multiproof: unconsumed aunts")
+
+    def to_dict(self) -> dict:
+        return {"total": self.total, "indices": list(self.indices),
+                "aunts": [a.hex() for a in self.aunts]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "Multiproof":
+        return cls(total=d["total"], indices=list(d["indices"]),
+                   aunts=[bytes.fromhex(a) for a in d["aunts"]])
+
+
+def _root_from_leaf_hashes(hashes: Sequence[bytes]) -> bytes:
+    if len(hashes) == 1:
+        return hashes[0]
+    k = _split_point(len(hashes))
+    return inner_hash(_root_from_leaf_hashes(hashes[:k]),
+                      _root_from_leaf_hashes(hashes[k:]))
+
+
+def multiproof_from_byte_slices(
+        items: Sequence[bytes],
+        indices: Sequence[int]) -> tuple[bytes, Multiproof]:
+    """Root + one compact proof for the leaves at ``indices``.
+
+    Input indices may arrive unsorted/duplicated (a batch of client
+    keys); the proof carries the canonical sorted-unique form and
+    callers supply leaves in that order.  Every untargeted subtree is
+    hashed exactly once, so building is O(n) regardless of how many
+    leaves are proven."""
+    from ._native_loader import batched_hashes
+    hashes = batched_hashes("leaf_hashes", items)
+    if hashes is None:
+        hashes = [leaf_hash(it) for it in items]
+    return multiproof_from_leaf_hashes(hashes, indices)
+
+
+def multiproof_from_leaf_hashes(
+        hashes: Sequence[bytes],
+        indices: Sequence[int]) -> tuple[bytes, Multiproof]:
+    """Multiproof over pre-hashed leaves (tx digests, kv bindings)."""
+    total = len(hashes)
+    idx = sorted(set(indices))
+    if idx and (idx[0] < 0 or idx[-1] >= total):
+        raise ValueError(
+            f"multiproof index out of range [0, {total})")
+    if total == 0:
+        return empty_hash(), Multiproof(total=0)
+    aunts: list[bytes] = []
+    pos = 0
+
+    def build(lo: int, hi: int) -> bytes:
+        nonlocal pos
+        if pos >= len(idx) or idx[pos] >= hi:
+            h = _root_from_leaf_hashes(hashes[lo:hi])
+            aunts.append(h)
+            return h
+        if hi - lo == 1:
+            pos += 1
+            return hashes[lo]
+        k = lo + _split_point(hi - lo)
+        left = build(lo, k)
+        right = build(k, hi)
+        return inner_hash(left, right)
+
+    root = build(0, total)
+    return root, Multiproof(total=total, indices=idx, aunts=aunts)
+
+
 # -- chained proof operators (reference: crypto/merkle/proof_op.go) ---------
 
 def _uvarint(n: int) -> bytes:
@@ -179,6 +345,14 @@ def _uvarint(n: int) -> bytes:
     encodeByteSlice)."""
     from ..wire.proto import encode_uvarint
     return encode_uvarint(n)
+
+def value_op_leaf(key: bytes, value: bytes) -> bytes:
+    """The <key, value-hash> leaf binding shared by ValueOp proofs and
+    the kvstore state multiproof (reference: proof_value.go:89-102 —
+    encodeByteSlice(key) + encodeByteSlice(sha256(value)))."""
+    vhash = _sha256(value)
+    return _uvarint(len(key)) + key + _uvarint(len(vhash)) + vhash
+
 
 class ProofOperator:
     def run(self, values: list[bytes]) -> list[bytes]:
@@ -197,11 +371,7 @@ class ValueOp(ProofOperator):
     def run(self, values: list[bytes]) -> list[bytes]:
         if len(values) != 1:
             raise ValueError("ValueOp expects one value")
-        vhash = _sha256(values[0])
-        # leaf binds <key, value-hash> as length-prefixed pair
-        # (reference: proof_value.go:89-102 encodeByteSlice(key)+(vhash))
-        kv = _uvarint(len(self.key)) + self.key + _uvarint(len(vhash)) + vhash
-        lh = leaf_hash(kv)
+        lh = leaf_hash(value_op_leaf(self.key, values[0]))
         if lh != self.proof.leaf_hash:
             raise ValueError("leaf hash mismatch")
         return [self.proof.compute_root_hash()]

@@ -12,6 +12,7 @@ from typing import Optional
 from ..libs.log import Logger, new_logger
 from ..types.block import LightBlock
 from ..types.evidence import LightClientAttackEvidence
+from ..types.signature_cache import SignatureCache
 from ..types.timestamp import Timestamp
 from ..types.validation import Fraction
 from .provider import LightBlockNotFoundError, Provider, ProviderError
@@ -91,6 +92,11 @@ class Client:
             self, height: int,
             now: Optional[Timestamp] = None) -> LightBlock:
         """Reference: VerifyLightBlockAtHeight."""
+        return await self._verify_at(height, now, cache=None)
+
+    async def _verify_at(self, height: int, now: Optional[Timestamp],
+                         cache: Optional[SignatureCache]
+                         ) -> LightBlock:
         now = now or Timestamp.now()
         if height <= 0:
             raise LightClientError("height must be positive")
@@ -107,8 +113,10 @@ class Client:
             # between stored roots: verify forward from the closest
             # lower stored block
             base = self._closest_below(height)
-            return await self._verify_forward(base, height, now)
-        return await self._verify_forward(latest, height, now)
+            return await self._verify_forward(base, height, now,
+                                              cache=cache)
+        return await self._verify_forward(latest, height, now,
+                                          cache=cache)
 
     async def update(self, now: Optional[Timestamp] = None
                      ) -> Optional[LightBlock]:
@@ -122,6 +130,24 @@ class Client:
             return None
         return await self._verify_forward(latest, new.height, now,
                                           prefetched=new)
+
+    async def verify_to_height(self, height: int,
+                               now: Optional[Timestamp] = None
+                               ) -> LightBlock:
+        """Skipping (bisection) sync to ``height`` with ONE signature
+        cache spanning every hop — the scalable consumer loop of the
+        proof-serving layer (docs/light_proofs.md).
+
+        Every hop's commit check rides the crypto.batch seam
+        (Traced/Guarded verifiers: TPU kernel behind the breaker, CPU
+        RLC fallback).  The cache spans the whole sync, so each hop's
+        1/3-trust and 2/3 checks — which walk the same commit with
+        overlapping old/new validator sets — and any bisection
+        re-examination of an already-proved commit skip verified
+        signatures instead of re-batching them (adjacent fallback
+        hops previously ran uncached entirely)."""
+        return await self._verify_at(height, now,
+                                     cache=SignatureCache())
 
     def trusted_light_block(self, height: int) -> Optional[LightBlock]:
         return self.store.light_block(height)
@@ -138,21 +164,23 @@ class Client:
 
     async def _verify_forward(self, trusted: LightBlock, height: int,
                               now: Timestamp,
-                              prefetched: Optional[LightBlock] = None
+                              prefetched: Optional[LightBlock] = None,
+                              cache: Optional[SignatureCache] = None
                               ) -> LightBlock:
         trace: list[LightBlock] = [trusted]
         if self.mode == SEQUENTIAL:
             lb = await self._verify_sequential(trusted, height, now,
-                                               trace)
+                                               trace, cache)
         else:
             lb = await self._verify_skipping(trusted, height, now,
-                                             prefetched, trace)
+                                             prefetched, trace, cache)
         await self._detect_divergence(lb, now, trace)
         return lb
 
     async def _verify_sequential(self, trusted: LightBlock,
                                  height: int, now: Timestamp,
-                                 trace: Optional[list] = None
+                                 trace: Optional[list] = None,
+                                 cache: Optional[SignatureCache] = None
                                  ) -> LightBlock:
         """Verify every header between trusted and height (reference:
         verifySequential)."""
@@ -162,7 +190,8 @@ class Client:
             verify(current.signed_header, current.validator_set,
                    nxt.signed_header, nxt.validator_set,
                    self.trust_options.period_ns, now,
-                   self.max_clock_drift_ns, self.trust_level)
+                   self.max_clock_drift_ns, self.trust_level,
+                   cache=cache)
             self.store.save_light_block(nxt)
             if trace is not None:
                 trace.append(nxt)
@@ -172,7 +201,8 @@ class Client:
     async def _verify_skipping(self, trusted: LightBlock, height: int,
                                now: Timestamp,
                                prefetched: Optional[LightBlock] = None,
-                               trace: Optional[list] = None
+                               trace: Optional[list] = None,
+                               cache: Optional[SignatureCache] = None
                                ) -> LightBlock:
         """Bisection (reference: verifySkipping): try to jump straight
         to the target; on insufficient trust, bisect."""
@@ -187,7 +217,8 @@ class Client:
                 verify(verified.signed_header, verified.validator_set,
                        candidate.signed_header, candidate.validator_set,
                        self.trust_options.period_ns, now,
-                       self.max_clock_drift_ns, self.trust_level)
+                       self.max_clock_drift_ns, self.trust_level,
+                       cache=cache)
                 self.store.save_light_block(candidate)
                 if trace is not None:
                     trace.append(candidate)

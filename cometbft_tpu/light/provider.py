@@ -76,19 +76,35 @@ class HttpProvider(Provider):
         self.client = HTTPClient(address)
         self.chain_id = chain_id
         self.address = address
+        self._has_light_block = True   # downgraded on first -32601
 
     async def light_block(self, height: int) -> LightBlock:
         from ..rpc.client import RPCClientError
         try:
-            signed_header, _ = await self.client.commit(height)
-            h = signed_header.header.height
-            vals = await self.client.validators(h)
+            if self._has_light_block:
+                # one round trip via the lightserve route; servers
+                # predating it answer method-not-found and we fall
+                # back to /commit + paged /validators for good
+                try:
+                    lb = await self.client.light_block(height)
+                except RPCClientError as e:
+                    if "-32601" not in str(e):   # method not found
+                        raise
+                    self._has_light_block = False
+                    lb = None
+            else:
+                lb = None
+            if lb is None:
+                signed_header, _ = await self.client.commit(height)
+                h = signed_header.header.height
+                vals = await self.client.validators(h)
+                lb = LightBlock(signed_header=signed_header,
+                                validator_set=vals)
         except RPCClientError as e:
             raise LightBlockNotFoundError(str(e)) from None
         except (OSError, asyncio.TimeoutError) as e:
             raise ProviderError(
                 f"provider {self.address} unreachable: {e}") from None
-        lb = LightBlock(signed_header=signed_header, validator_set=vals)
         if self.chain_id:
             lb.validate_basic(self.chain_id)
         return lb

@@ -77,8 +77,17 @@ def verify_adjacent(trusted_header: SignedHeader,
                     untrusted_header: SignedHeader,
                     untrusted_vals: ValidatorSet,
                     trusting_period_ns: int, now: Timestamp,
-                    max_clock_drift_ns: int) -> None:
-    """Reference: VerifyAdjacent (:92)."""
+                    max_clock_drift_ns: int,
+                    cache: Optional[SignatureCache] = None) -> None:
+    """Reference: VerifyAdjacent (:92).
+
+    The commit check dispatches through types/validation.py, which
+    routes >= 2 same-type signatures into crypto.batch's
+    Traced/Guarded batch verifiers (TPU kernel behind the breaker,
+    CPU RLC otherwise) and falls back per-signature below the batch
+    threshold.  A caller-supplied SignatureCache (one per sync in
+    light/client.py verify_to_height) lets overlapping validator sets
+    across hops skip re-verification entirely."""
     if untrusted_header.height != trusted_header.height + 1:
         raise LightClientError("headers must be adjacent in height")
     if header_expired(trusted_header, trusting_period_ns, now):
@@ -96,7 +105,7 @@ def verify_adjacent(trusted_header: SignedHeader,
         verify_commit_light(
             trusted_header.header.chain_id, untrusted_vals,
             untrusted_header.commit.block_id, untrusted_header.height,
-            untrusted_header.commit)
+            untrusted_header.commit, cache=cache)
     except VerificationError as e:
         raise InvalidHeaderError(str(e)) from e
 
@@ -107,9 +116,13 @@ def verify_non_adjacent(trusted_header: SignedHeader,
                         untrusted_vals: ValidatorSet,
                         trusting_period_ns: int, now: Timestamp,
                         max_clock_drift_ns: int,
-                        trust_level: Fraction = DEFAULT_TRUST_LEVEL
+                        trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+                        cache: Optional[SignatureCache] = None
                         ) -> None:
-    """Reference: VerifyNonAdjacent (:30)."""
+    """Reference: VerifyNonAdjacent (:30).  Both commit checks ride
+    the batch seam (see verify_adjacent); with no caller cache a
+    fresh one still spans the two checks here, mirroring the
+    reference's shared SignatureCache (:55-57)."""
     if untrusted_header.height == trusted_header.height + 1:
         raise LightClientError("headers must be non-adjacent in height")
     if header_expired(trusted_header, trusting_period_ns, now):
@@ -117,7 +130,8 @@ def verify_non_adjacent(trusted_header: SignedHeader,
     _verify_new_header_and_vals(untrusted_header, untrusted_vals,
                                 trusted_header, now, max_clock_drift_ns)
 
-    cache = SignatureCache()
+    if cache is None:
+        cache = SignatureCache()
     # 1/3+ of the trusted valset must have signed the new commit
     try:
         verify_commit_light_trusting(
@@ -125,6 +139,11 @@ def verify_non_adjacent(trusted_header: SignedHeader,
             untrusted_header.commit, trust_level, cache=cache)
     except NotEnoughVotingPowerError as e:
         raise NewValSetCantBeTrustedError(str(e)) from e
+    except VerificationError as e:
+        # e.g. a wrong signature: invalid header, NOT a trust-range
+        # miss — bisecting on it would never converge (reference:
+        # VerifyNonAdjacent wraps both checks in ErrInvalidHeader)
+        raise InvalidHeaderError(str(e)) from e
     # 2/3+ of the new valset must have signed — LAST check: untrusted
     # valsets can be made large to DoS the light client
     try:
@@ -140,17 +159,19 @@ def verify(trusted_header: SignedHeader, trusted_vals: ValidatorSet,
            untrusted_header: SignedHeader,
            untrusted_vals: ValidatorSet, trusting_period_ns: int,
            now: Timestamp, max_clock_drift_ns: int,
-           trust_level: Fraction = DEFAULT_TRUST_LEVEL) -> None:
+           trust_level: Fraction = DEFAULT_TRUST_LEVEL,
+           cache: Optional[SignatureCache] = None) -> None:
     """Reference: Verify (:130)."""
     if untrusted_header.height != trusted_header.height + 1:
         verify_non_adjacent(trusted_header, trusted_vals,
                             untrusted_header, untrusted_vals,
                             trusting_period_ns, now,
-                            max_clock_drift_ns, trust_level)
+                            max_clock_drift_ns, trust_level,
+                            cache=cache)
     else:
         verify_adjacent(trusted_header, untrusted_header,
                         untrusted_vals, trusting_period_ns, now,
-                        max_clock_drift_ns)
+                        max_clock_drift_ns, cache=cache)
 
 
 def verify_backwards(untrusted_header, trusted_header) -> None:

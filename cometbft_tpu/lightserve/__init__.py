@@ -1,0 +1,21 @@
+"""lightserve: the proof-serving read path (ROADMAP item 3).
+
+The write path (consensus) commits blocks; this package turns those
+immutable artifacts into a product surface sized for millions of light
+clients:
+
+  * ``core.py``  — RPC method bodies for ``light_block`` (one response
+    with everything a skipping-sync hop needs), ``multiproof`` (one
+    compact proof covering many txs of a block, "Compact Merkle
+    Multiproofs" in PAPERS.md), and ``abci_query_batch`` (many app keys
+    per round trip, with a single state multiproof when the app can
+    serve one);
+  * ``cache.py`` — a height-keyed response cache: results at heights
+    strictly below the chain tip are immutable, so thousands of
+    concurrent light clients replaying the same sync path hit RAM, not
+    the stores.
+
+docs/light_proofs.md documents the proof formats, the skipping-sync
+trust model and the cache semantics.
+"""
+from .cache import Metrics, ResponseCache  # noqa: F401

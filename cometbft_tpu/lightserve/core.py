@@ -1,0 +1,208 @@
+"""Proof-serving RPC method bodies.
+
+These are the handlers behind the ``light_block`` / ``multiproof`` /
+``abci_query_batch`` routes in rpc/core.py — kept here so the proof
+machinery has one home and rpc/core.py stays a thin method table.
+
+JSON conventions follow the rest of the RPC surface: int64s as
+strings, hashes hex-upper, tx bytes base64.
+"""
+from __future__ import annotations
+
+import base64
+import json
+
+from ..crypto import merkle
+
+# ABCI query path the batched+proven key lookup rides on.  Served by
+# apps that maintain a provable state tree (the kvstore does); apps
+# that do not simply answer it with a non-OK code and the RPC layer
+# degrades to per-key queries without a proof.
+MULTISTORE_PATH = "/multistore"
+
+
+def _rpc_error(code: int, message: str):
+    from ..rpc.server import RPCError
+    return RPCError(code, message)
+
+
+def parse_indices(indices) -> list[int]:
+    """A comma-separated index list URI/JSON param ("0,5,17"); lists
+    of ints pass through.  Empty string = empty key set."""
+    if isinstance(indices, (list, tuple)):
+        return [int(i) for i in indices]
+    s = str(indices).strip()
+    if not s:
+        return []
+    try:
+        return [int(p) for p in s.split(",") if p.strip() != ""]
+    except ValueError:
+        raise _rpc_error(-32602, f"invalid indices {indices!r}")
+
+
+# ---------------------------------------------------------------------------
+# light_block: one response per skipping-sync hop
+
+
+async def light_block(env, height) -> dict:
+    """Signed header + validator set in one round trip — the unit of
+    skipping verification (reference: the statesync LightBlock proto;
+    the HTTP provider otherwise stitches /commit + /validators)."""
+    from ..rpc import core as rpc_core
+    from ..types import genesis as genesis_types
+    h = rpc_core._normalize_height(env, height)
+    meta = env.block_store.load_block_meta(h)
+    commit = env.block_store.load_block_commit(h)
+    if commit is None:
+        commit = env.block_store.load_seen_commit(h)
+    if meta is None or commit is None:
+        raise _rpc_error(-32603, f"no light block at height {h}")
+    vals = env.state_store.load_validators(h)
+    if vals is None:
+        raise _rpc_error(-32603, f"no validator set at height {h}")
+    return {
+        "height": str(h),
+        "light_block": {
+            "signed_header": {
+                "header": rpc_core._header_json(meta.header),
+                "commit": rpc_core._commit_json(commit),
+            },
+            "validator_set": {
+                "validators": [
+                    {"address": v.address.hex().upper(),
+                     "pub_key": genesis_types.pub_key_to_json(v.pub_key),
+                     "voting_power": str(v.voting_power),
+                     "proposer_priority": str(v.proposer_priority)}
+                    for v in vals.validators],
+                "total": str(vals.size()),
+            },
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# multiproof: many txs of one block under one compact proof
+
+
+async def tx_multiproof(env, height, indices) -> dict:
+    """Compact multiproof that the txs at ``indices`` are the block's
+    txs at those positions, against the header's data_hash.  A light
+    client that verified the header (light_block + verify_to_height)
+    checks the whole batch with Multiproof.verify over the tx digests
+    (the tree's items, per types/tx.py txs_hash) — one response where
+    per-tx /tx?prove=true would ship one Proof each."""
+    from ..rpc import core as rpc_core
+    from ..types.tx import hash_each
+    h = rpc_core._normalize_height(env, height)
+    block = env.block_store.load_block(h)
+    if block is None:
+        raise _rpc_error(-32603, f"block at height {h} not found")
+    txs = block.data.txs
+    idx = parse_indices(indices)
+    if idx and (min(idx) < 0 or max(idx) >= len(txs)):
+        raise _rpc_error(
+            -32602,
+            f"tx index out of range [0, {len(txs)}) at height {h}")
+    # data_hash is the merkle root over per-tx sha256 digests
+    # (types/tx.py txs_hash) — the digests are the tree's ITEMS, so
+    # they get the usual leaf-prefix hash on the way in
+    root, mp = merkle.multiproof_from_byte_slices(hash_each(txs), idx)
+    return {
+        "height": str(h),
+        "total": str(len(txs)),
+        "indices": mp.indices,
+        "data_hash": root.hex().upper(),
+        "txs": [base64.b64encode(txs[i]).decode() for i in mp.indices],
+        "multiproof": mp.to_dict(),
+    }
+
+
+# ---------------------------------------------------------------------------
+# abci_query_batch: many app keys per round trip
+
+
+def _query_json(res) -> dict:
+    return {
+        "code": res.code, "log": res.log, "info": res.info,
+        "index": str(res.index),
+        "key": base64.b64encode(res.key).decode(),
+        "value": base64.b64encode(res.value).decode(),
+        "height": str(res.height), "codespace": res.codespace,
+    }
+
+
+def _parse_keys(data) -> list[bytes]:
+    from ..rpc.core import _decode_hex_or_str
+    if isinstance(data, (list, tuple)):
+        return [_decode_hex_or_str(d) for d in data]
+    s = str(data)
+    return [_decode_hex_or_str(type(data)(p))
+            for p in s.split(",") if p != ""]
+
+
+async def abci_query_batch(env, path, data, height, prove) -> dict:
+    """N abci_query calls in one response.  With prove=true the app is
+    asked once, via MULTISTORE_PATH, for all keys plus a single
+    compact multiproof over its state tree; apps without a provable
+    store answer per key with proof=null."""
+    from ..abci import types as abci
+    from ..rpc.core import _parse_bool
+    keys = _parse_keys(data)
+    if not keys:
+        raise _rpc_error(-32602, "no keys provided")
+    try:
+        h = int(height)
+    except (TypeError, ValueError):
+        raise _rpc_error(-32602, f"invalid height {height!r}")
+    if _parse_bool(prove):
+        req = json.dumps(
+            {"keys": [k.hex() for k in keys]}).encode()
+        res = await env.node.app_conns.query.query(abci.QueryRequest(
+            data=req, path=MULTISTORE_PATH, height=h, prove=True))
+        if res.code == 0 and res.value:
+            return _batch_from_multistore(keys, res)
+    responses = []
+    for k in keys:
+        res = await env.node.app_conns.query.query(abci.QueryRequest(
+            data=k, path=str(path), height=h, prove=False))
+        responses.append(_query_json(res))
+    return {"responses": responses, "proof": None}
+
+
+def _batch_from_multistore(keys: list[bytes], res) -> dict:
+    """Shape the app's one-shot multistore answer: per-key responses
+    (preserving request order) + the shared proof envelope."""
+    st = json.loads(res.value)
+    found = {bytes.fromhex(k): bytes.fromhex(v)
+             for k, v in zip(st["keys"], st["values"])}
+    responses = []
+    for k in keys:
+        v = found.get(k)
+        responses.append({
+            "code": 0,
+            "log": "exists" if v is not None else "does not exist",
+            "info": "", "index": "-1",
+            "key": base64.b64encode(k).decode(),
+            "value": base64.b64encode(v or b"").decode(),
+            "height": str(res.height), "codespace": "",
+        })
+    return {
+        "responses": responses,
+        "proof": {
+            "root": st["root"].upper(),
+            "total": str(st["total"]),
+            "indices": list(st["indices"]),
+            "missing": list(st.get("missing", [])),
+            "multiproof": st["multiproof"],
+        },
+    }
+
+
+def verify_kv_multiproof(proof: dict, keys_values: list) -> None:
+    """Client-side check of an abci_query_batch proof envelope:
+    reconstructs the ValueOp-parity kv leaves for the (key, value)
+    pairs (in proof index order) and verifies the single multiproof
+    against the advertised root.  Raises ValueError on mismatch."""
+    mp = merkle.Multiproof.from_dict(proof["multiproof"])
+    leaves = [merkle.value_op_leaf(k, v) for k, v in keys_values]
+    mp.verify(bytes.fromhex(proof["root"]), leaves)

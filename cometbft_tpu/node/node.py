@@ -177,6 +177,18 @@ class Node:
         self.supervisor = Supervisor("node", logger=self.logger,
                                      metrics=self.supervisor_metrics)
 
+        # --- lightserve: height-keyed RPC response cache ----------------
+        # immutable responses (blocks/commits/light blocks/multiproofs
+        # below the tip) served from RAM so light-client read traffic
+        # never reaches the stores (docs/light_proofs.md)
+        from ..lightserve.cache import Metrics as LightserveMetrics
+        from ..lightserve.cache import ResponseCache
+        self.lightserve_cache = None
+        if config.rpc.cache_max_bytes > 0:
+            self.lightserve_cache = ResponseCache(
+                config.rpc.cache_max_bytes,
+                metrics=LightserveMetrics(self.metrics_registry))
+
         # --- mempool ----------------------------------------------------
         self.mempool: Optional[CListMempool] = None
         self.mempool_reactor: Optional[MempoolReactor] = None

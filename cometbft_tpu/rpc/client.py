@@ -186,6 +186,20 @@ class HTTPClient:
             page += 1
         return validator_set_from_json(vals)
 
+    async def light_block(self, height: int = 0):
+        """One-round-trip signed header + validator set from the
+        lightserve route (docs/light_proofs.md)."""
+        from ..types.block import LightBlock
+        res = await self.call("light_block", height=str(height))
+        lb = res["light_block"]
+        sh = lb["signed_header"]
+        return LightBlock(
+            signed_header=SignedHeader(
+                header=header_from_json(sh["header"]),
+                commit=commit_from_json(sh["commit"])),
+            validator_set=validator_set_from_json(
+                lb["validator_set"]["validators"]))
+
     async def genesis(self) -> dict:
         return await self.call("genesis")
 

@@ -62,6 +62,13 @@ def routes(env: Environment) -> dict:
         "num_unconfirmed_txs": lambda: _num_unconfirmed_txs(env),
         "block": lambda height="0": _block(env, height),
         "block_by_hash": lambda hash="": _block_by_hash(env, hash),
+        # lightserve: the proof-serving read surface (ROADMAP item 3;
+        # cometbft_tpu/lightserve/, docs/light_proofs.md)
+        "light_block": lambda height="0": _light_block(env, height),
+        "multiproof": lambda height="0", indices="":
+            _multiproof(env, height, indices),
+        "abci_query_batch": lambda path="", data="", height="0",
+        prove=False: _abci_query_batch(env, path, data, height, prove),
         "header": lambda height="0": _header(env, height),
         "header_by_hash": lambda hash="":
             _header_by_hash(env, hash),
@@ -343,8 +350,31 @@ def _normalize_height(env, height) -> int:
     return h
 
 
+async def _cached(env, method: str, height: int, extra, build):
+    """Serve ``method`` at ``height`` from the lightserve response
+    cache when possible; otherwise build and (when the height is
+    strictly below the tip, i.e. immutable) insert.  ``extra`` is the
+    hashable remainder of the request key."""
+    cache = getattr(env.node, "lightserve_cache", None) \
+        if env.node is not None else None
+    if cache is None:
+        return await build()
+    hit = cache.get(method, height, extra)
+    if hit is not None:
+        return hit
+    res = await build()
+    cache.put(method, height, extra, res,
+              latest_height=env.block_store.height)
+    return res
+
+
 async def _block(env, height):
     h = _normalize_height(env, height)
+    return await _cached(env, "block", h, (),
+                         lambda: _build_block(env, h))
+
+
+async def _build_block(env, h):
     block = env.block_store.load_block(h)
     meta = env.block_store.load_block_meta(h)
     if block is None or meta is None:
@@ -352,6 +382,29 @@ async def _block(env, height):
         raise RPCError(-32603, f"block at height {h} not found")
     return {"block_id": _block_id_json(meta.block_id),
             "block": _block_json(block)}
+
+
+async def _light_block(env, height):
+    from ..lightserve import core as lightserve
+    h = _normalize_height(env, height)
+    return await _cached(env, "light_block", h, (),
+                         lambda: lightserve.light_block(env, h))
+
+
+async def _multiproof(env, height, indices):
+    from ..lightserve import core as lightserve
+    h = _normalize_height(env, height)
+    idx = tuple(sorted(set(lightserve.parse_indices(indices))))
+    return await _cached(env, "multiproof", h, idx,
+                         lambda: lightserve.tx_multiproof(env, h, idx))
+
+
+async def _abci_query_batch(env, path, data, height, prove):
+    from ..lightserve import core as lightserve
+    # app state is not height-immutable from here (the app serves its
+    # latest state regardless of the height param) — never cached
+    return await lightserve.abci_query_batch(env, path, data, height,
+                                             prove)
 
 
 async def _block_by_hash(env, hash):
@@ -440,6 +493,13 @@ async def _block_results(env, height):
 
 async def _commit(env, height):
     h = _normalize_height(env, height)
+    # cache-safe: only heights below the tip are inserted (put
+    # refuses the rest), and below the tip the commit is canonical
+    return await _cached(env, "commit", h, (),
+                         lambda: _build_commit(env, h))
+
+
+async def _build_commit(env, h):
     meta = env.block_store.load_block_meta(h)
     commit = env.block_store.load_block_commit(h)
     canonical = True

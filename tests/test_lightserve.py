@@ -1,0 +1,341 @@
+"""lightserve: compact merkle multiproofs, the height-keyed RPC
+response cache, the proof-serving RPC routes, and the skipping-sync
+light client consuming them (docs/light_proofs.md; ROADMAP item 3).
+"""
+import asyncio
+import base64
+import hashlib
+import json
+import os
+import tempfile
+
+import pytest
+
+from cometbft_tpu.crypto import batch as crypto_batch
+from cometbft_tpu.crypto import merkle
+from cometbft_tpu.lightserve.cache import ResponseCache
+from cometbft_tpu.lightserve.cache import Metrics as LightserveMetrics
+
+
+@pytest.fixture(autouse=True)
+def _cpu_backend():
+    crypto_batch.set_backend("cpu")
+    yield
+    crypto_batch.set_backend("auto")
+
+
+# ---------------------------------------------------------------------------
+# Multiproof: edge cases + parity with per-key Proof semantics
+
+
+class TestMultiproof:
+    ITEMS = [b"item-%04d" % i for i in range(64)]
+
+    def test_verifies_and_matches_tree_root(self):
+        root_ref = merkle.hash_from_byte_slices(self.ITEMS)
+        root, mp = merkle.multiproof_from_byte_slices(
+            self.ITEMS, [0, 7, 33, 63])
+        assert root == root_ref
+        mp.verify(root, [self.ITEMS[i] for i in (0, 7, 33, 63)])
+
+    def test_empty_key_set(self):
+        """No proven leaves: the proof is just the tree root — it
+        still binds total/root and verifies with zero leaves."""
+        root, mp = merkle.multiproof_from_byte_slices(self.ITEMS, [])
+        assert mp.indices == [] and len(mp.aunts) == 1
+        mp.verify(root, [])
+        with pytest.raises(ValueError):
+            mp.verify(b"\x01" * 32, [])
+
+    def test_empty_tree(self):
+        root, mp = merkle.multiproof_from_byte_slices([], [])
+        assert root == merkle.empty_hash()
+        mp.verify(root, [])
+
+    def test_single_leaf_and_total_1(self):
+        root, mp = merkle.multiproof_from_byte_slices([b"only"], [0])
+        assert root == merkle.leaf_hash(b"only")
+        assert mp.aunts == [] and mp.total == 1
+        mp.verify(root, [b"only"])
+        # total=1 with an empty key set: the lone aunt IS the root
+        root2, mp2 = merkle.multiproof_from_byte_slices([b"only"], [])
+        assert mp2.aunts == [root2]
+        mp2.verify(root2, [])
+
+    def test_duplicate_unsorted_indices_canonicalized(self):
+        """Builder input may be duplicated/unsorted (a batch of client
+        keys); the proof carries the canonical sorted-unique form."""
+        root, mp = merkle.multiproof_from_byte_slices(
+            self.ITEMS, [33, 7, 33, 7, 0])
+        assert mp.indices == [0, 7, 33]
+        mp.verify(root, [self.ITEMS[i] for i in (0, 7, 33)])
+
+    def test_verifier_rejects_non_canonical_indices(self):
+        root, mp = merkle.multiproof_from_byte_slices(
+            self.ITEMS, [3, 9])
+        leaves = [self.ITEMS[3], self.ITEMS[9]]
+        for bad in ([9, 3], [3, 3], [3, 64], [-1, 3]):
+            tampered = merkle.Multiproof(
+                total=mp.total, indices=bad, aunts=list(mp.aunts))
+            with pytest.raises(ValueError):
+                tampered.verify(root, leaves)
+
+    def test_out_of_range_build_rejected(self):
+        with pytest.raises(ValueError):
+            merkle.multiproof_from_byte_slices(self.ITEMS, [64])
+        with pytest.raises(ValueError):
+            merkle.multiproof_from_byte_slices(self.ITEMS, [-1])
+
+    def test_tamper_detection(self):
+        sel = [2, 5, 40]
+        root, mp = merkle.multiproof_from_byte_slices(self.ITEMS, sel)
+        leaves = [self.ITEMS[i] for i in sel]
+        # flipped interior hash
+        bad = merkle.Multiproof.from_dict(mp.to_dict())
+        bad.aunts[0] = bytes(32)
+        with pytest.raises(ValueError):
+            bad.verify(root, leaves)
+        # wrong root
+        with pytest.raises(ValueError):
+            mp.verify(b"\xee" * 32, leaves)
+        # wrong leaf value
+        with pytest.raises(ValueError):
+            mp.verify(root, [b"forged"] + leaves[1:])
+        # truncated aunts
+        bad2 = merkle.Multiproof.from_dict(mp.to_dict())
+        bad2.aunts.pop()
+        with pytest.raises(ValueError):
+            bad2.verify(root, leaves)
+        # surplus aunts
+        bad3 = merkle.Multiproof.from_dict(mp.to_dict())
+        bad3.aunts.append(bytes(32))
+        with pytest.raises(ValueError):
+            bad3.verify(root, leaves)
+        # leaf count mismatch
+        with pytest.raises(ValueError):
+            mp.verify(root, leaves[:-1])
+
+    def test_round_trip_parity_with_proof(self):
+        """to_dict/from_dict is wire-stable and JSON-safe like
+        Proof's, and a 1-index multiproof proves exactly what the
+        per-key Proof proves."""
+        sel = [1, 8, 21]
+        root, mp = merkle.multiproof_from_byte_slices(self.ITEMS, sel)
+        rt = merkle.Multiproof.from_dict(
+            json.loads(json.dumps(mp.to_dict())))
+        assert rt.to_dict() == mp.to_dict()
+        rt.verify(root, [self.ITEMS[i] for i in sel])
+
+        root_p, proofs = merkle.proofs_from_byte_slices(self.ITEMS)
+        assert root_p == root
+        for i in sel:
+            proofs[i].verify(root, self.ITEMS[i])
+            r1, mp1 = merkle.multiproof_from_byte_slices(
+                self.ITEMS, [i])
+            assert r1 == root
+            mp1.verify(root, [self.ITEMS[i]])
+
+    def test_random_parity_fuzz(self):
+        import random
+        rng = random.Random(1234)
+        for _ in range(40):
+            n = rng.randrange(1, 70)
+            items = [bytes([rng.randrange(256)]) * 4
+                     for _ in range(n)]
+            sel = rng.sample(range(n), rng.randrange(0, n + 1))
+            root, mp = merkle.multiproof_from_byte_slices(items, sel)
+            assert root == merkle.hash_from_byte_slices(items)
+            mp.verify(root, [items[i] for i in sorted(set(sel))])
+
+    def test_256_keys_at_least_4x_smaller_than_per_key_proofs(self):
+        """The headline compactness claim, deterministically: 256 of
+        1024 leaves (fixed spread layout), serialized JSON bytes."""
+        items = [b"leaf-%05d" % i for i in range(1024)]
+        sel = list(range(0, 1024, 4))
+        root, mp = merkle.multiproof_from_byte_slices(items, sel)
+        _, proofs = merkle.proofs_from_byte_slices(items)
+        per_key = sum(len(json.dumps(proofs[i].to_dict()))
+                      for i in sel)
+        multi = len(json.dumps(mp.to_dict()))
+        assert per_key >= 4 * multi, (per_key, multi)
+        mp.verify(root, [items[i] for i in sel])
+
+    def test_baseline_records_3x_verify_speedup(self):
+        """The committed perf-lab baseline must show multiproof
+        verification >= 3x faster than 256 per-key proofs (the live
+        regression gate keeps both numbers honest; see
+        tools/perf_lab.py multiproof_verify)."""
+        path = os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "perf_baseline.json")
+        with open(path) as f:
+            benches = json.load(f)["benchmarks"]
+        multi = benches["multiproof_verify"]["min_ms"]
+        per_key = benches["proofs_verify_256"]["min_ms"]
+        assert per_key >= 3.0 * multi, (per_key, multi)
+        # the gate tolerance on the multiproof side must be tight
+        # enough that a regression voiding the 3x claim fails check
+        assert float(benches["multiproof_verify"].get(
+            "tolerance", 99)) <= 3.0
+
+
+class TestValueOpLeafParity:
+    def test_multistore_leaf_matches_value_op(self):
+        """One leaf binding shared by per-key ValueOp proofs and the
+        kv multiproof: a ValueOp built over the same tree verifies."""
+        pairs = sorted((b"k%d" % i, b"v%d" % i) for i in range(9))
+        leaves = [merkle.value_op_leaf(k, v) for k, v in pairs]
+        root, proofs = merkle.proofs_from_byte_slices(leaves)
+        for i, (k, v) in enumerate(pairs):
+            op = merkle.ValueOp(key=k, proof=proofs[i])
+            assert op.run([v]) == [root]
+
+
+# ---------------------------------------------------------------------------
+# ResponseCache
+
+
+class TestResponseCache:
+    def test_hit_miss_and_immutability_rule(self):
+        c = ResponseCache(max_bytes=1 << 20)
+        assert c.get("block", 5) is None
+        # tip (h == latest) is never cached
+        assert not c.put("block", 10, (), {"x": 1}, latest_height=10)
+        assert c.get("block", 10) is None
+        assert c.put("block", 5, (), {"x": 1}, latest_height=10)
+        assert c.get("block", 5) == {"x": 1}
+        assert c.stats()["hits"] == 1 and c.stats()["misses"] == 2
+        # params are part of the key
+        assert c.get("block", 5, ("a",)) is None
+
+    def test_byte_bound_evicts_lru(self):
+        c = ResponseCache(max_bytes=4096)
+        big = "y" * 300
+        for h in range(1, 20):
+            c.put("block", h, (), {"v": big}, latest_height=100)
+        assert c.size_bytes <= 4096
+        assert c.evictions > 0
+        # newest entries survive, oldest were evicted
+        assert c.get("block", 19) is not None
+        assert c.get("block", 1) is None
+
+    def test_single_giant_entry_refused(self):
+        c = ResponseCache(max_bytes=4096)
+        assert not c.put("block", 1, (), {"v": "z" * 1000},
+                         latest_height=10)
+        assert len(c) == 0
+
+    def test_metrics_counters(self):
+        from cometbft_tpu.libs.metrics import Registry
+        reg = Registry()
+        c = ResponseCache(max_bytes=1 << 20,
+                          metrics=LightserveMetrics(reg))
+        c.get("block", 1)
+        c.put("block", 1, (), {"v": 1}, latest_height=5)
+        c.get("block", 1)
+        page = reg.render()
+        assert "cometbft_lightserve_cache_hits_total 1" in page
+        assert "cometbft_lightserve_cache_misses_total 1" in page
+        assert "cometbft_lightserve_cache_entries 1" in page
+
+    def test_disabled_budget_caches_nothing(self):
+        c = ResponseCache(max_bytes=0)
+        assert not c.put("block", 1, (), {"v": 1}, latest_height=5)
+        assert len(c) == 0
+
+
+# ---------------------------------------------------------------------------
+# Live RPC routes + cache wiring
+
+
+class TestLightserveRPC:
+    def test_routes_end_to_end(self):
+        from tests.test_rpc_contract import _make_node_cfg
+
+        from cometbft_tpu.lightserve.core import verify_kv_multiproof
+        from cometbft_tpu.node.node import Node
+        from cometbft_tpu.rpc.client import HTTPClient
+
+        async def run():
+            with tempfile.TemporaryDirectory() as d:
+                node = Node(_make_node_cfg(d))
+                await node.start()
+                try:
+                    cli = HTTPClient(
+                        f"http://{node._rpc_server.listen_addr}",
+                        timeout=30.0)
+                    for i in range(2):
+                        await cli.broadcast_tx_commit(
+                            b"lk%d=lv%d" % (i, i))
+                    while node.height < 5:
+                        await asyncio.sleep(0.02)
+
+                    target = next(
+                        h for h in range(2, node.height)
+                        if node.block_store.load_block(h) is not None
+                        and node.block_store.load_block(h).data.txs)
+
+                    # --- multiproof verifies against the header's
+                    # data_hash (what a verified light client holds)
+                    res = await cli.call("multiproof",
+                                         height=str(target),
+                                         indices="0")
+                    mp = merkle.Multiproof.from_dict(res["multiproof"])
+                    txs = [base64.b64decode(t) for t in res["txs"]]
+                    hdr = node.block_store.load_block_meta(
+                        target).header
+                    mp.verify(hdr.data_hash,
+                              [hashlib.sha256(t).digest()
+                               for t in txs])
+
+                    # --- light_block round-trips through the typed
+                    # client and validates
+                    lb = await cli.light_block(target)
+                    lb.validate_basic(node.genesis_doc.chain_id)
+
+                    # --- repeat requests hit the cache
+                    before = node.lightserve_cache.stats()
+                    await cli.call("multiproof", height=str(target),
+                                   indices="0")
+                    await cli.call("light_block",
+                                   height=str(target))
+                    after = node.lightserve_cache.stats()
+                    assert after["hits"] >= before["hits"] + 2
+
+                    # --- the tip is never cached
+                    tip = node.height
+                    await cli.call("block", height=str(tip))
+                    assert all(k[1] != tip for k in
+                               node.lightserve_cache._entries)
+
+                    # --- batched provable query: one multiproof
+                    # covers every found key; missing keys are named
+                    res = await cli.call(
+                        "abci_query_batch",
+                        data="0x" + b"lk0".hex() + ",0x" +
+                             b"lk1".hex() + ",0x" + b"absent".hex(),
+                        prove=True)
+                    assert res["proof"] is not None
+                    kv = sorted(
+                        (base64.b64decode(r["key"]),
+                         base64.b64decode(r["value"]))
+                        for r in res["responses"]
+                        if r["log"] == "exists")
+                    assert len(kv) == 2
+                    verify_kv_multiproof(res["proof"], kv)
+                    assert res["proof"]["missing"] == \
+                        [b"absent".hex()]
+                    bad = dict(res["proof"])
+                    bad["root"] = "00" * 32
+                    with pytest.raises(ValueError):
+                        verify_kv_multiproof(bad, kv)
+
+                    # --- prove=false degrades to per-key fanout
+                    res2 = await cli.call(
+                        "abci_query_batch",
+                        data="0x" + b"lk0".hex(), prove=False)
+                    assert res2["proof"] is None
+                    assert len(res2["responses"]) == 1
+                finally:
+                    await node.stop()
+        asyncio.run(run())

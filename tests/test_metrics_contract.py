@@ -262,6 +262,9 @@ def _assemble_full_registry() -> Registry:
     from cometbft_tpu.libs.supervisor import (
         Metrics as SupervisorMetrics,
     )
+    from cometbft_tpu.lightserve.cache import (
+        Metrics as LightserveMetrics,
+    )
     from cometbft_tpu.mempool.metrics import Metrics as MempoolMetrics
     from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
     from cometbft_tpu.state.metrics import Metrics as StateMetrics
@@ -271,7 +274,7 @@ def _assemble_full_registry() -> Registry:
     reg = Registry()
     for cls in (ConsensusMetrics, MempoolMetrics, P2PMetrics,
                 BlocksyncMetrics, StatesyncMetrics, StateMetrics,
-                ProxyMetrics, SupervisorMetrics):
+                ProxyMetrics, SupervisorMetrics, LightserveMetrics):
         cls(reg)
     return reg
 

@@ -133,6 +133,11 @@ class TestRPCContract:
                         "broadcast_tx_commit": {"tx": base64.b64encode(
                             b"probe=3").decode()},
                         "block": {"height": "2"},
+                        "light_block": {"height": "2"},
+                        "multiproof": {"height": "2", "indices": ""},
+                        "abci_query_batch": {
+                            "data": "0x" + b"spec".hex(),
+                            "prove": True},
                         "block_results": {"height": "2"},
                         "commit": {"height": "2"},
                         "blockchain": {"minHeight": "1",

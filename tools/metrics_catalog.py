@@ -36,6 +36,9 @@ def collect_catalog() -> list[dict]:
     from cometbft_tpu.libs.supervisor import (
         Metrics as SupervisorMetrics,
     )
+    from cometbft_tpu.lightserve.cache import (
+        Metrics as LightserveMetrics,
+    )
     from cometbft_tpu.mempool.metrics import Metrics as MempoolMetrics
     from cometbft_tpu.ops import ed25519_jax
     from cometbft_tpu.p2p.metrics import Metrics as P2PMetrics
@@ -48,7 +51,7 @@ def collect_catalog() -> list[dict]:
     reg = libmetrics.Registry()
     for cls in (ConsensusMetrics, MempoolMetrics, P2PMetrics,
                 BlocksyncMetrics, StatesyncMetrics, StateMetrics,
-                ProxyMetrics, SupervisorMetrics):
+                ProxyMetrics, SupervisorMetrics, LightserveMetrics):
         cls(reg)
     # force the lazy process-global families into existence
     crypto_batch.verify_seconds_histogram()

@@ -18,6 +18,14 @@ decomposition the flight recorder attributes per height):
     (tier-1 separately guards < 1µs);
   * ``p2p_loopback_send``      — MConnection framing/scheduling cost
     per message over an in-memory pipe (no sockets, no crypto);
+  * ``multiproof_build`` / ``multiproof_verify`` /
+    ``proofs_verify_256`` — lightserve compact multiproofs: build and
+    verify 256 of 1024 leaves vs the same leaves as 256 individual
+    Proofs (the committed numbers demonstrate the >= 4x size / >= 3x
+    verify win; tests/test_lightserve.py pins the claim against this
+    baseline);
+  * ``rpc_cache_hit``          — lightserve response-cache lookup
+    (the path thousands of light clients ride per request);
   * ``bftlint_selfcheck``      — the full-package bftlint run that
     gates tier-1 (tests/test_bftlint.py); a pathological checker
     (an accidental O(n^2) walk) must not blow the tier-1 budget, so
@@ -273,6 +281,97 @@ def bench_p2p_loopback_send(fast: bool):
     }
 
 
+# lightserve: multiproof build/verify and the RPC response-cache hit
+# path (docs/light_proofs.md).  Fixed geometry — 1024-leaf tree, 256
+# seeded-random keys — so the committed numbers demonstrate the
+# compactness claims: tests/test_lightserve.py statically checks the
+# baseline shows multiproof_verify >= 3x faster than
+# proofs_verify_256, and the tight tolerance on multiproof_verify
+# makes a regression that would void the claim fail `check`.
+
+_MULTIPROOF_LEAVES = 1024
+_MULTIPROOF_KEYS = 256
+
+
+def _multiproof_fixture():
+    import random
+    items = [b"perf-leaf-%05d" % i for i in range(_MULTIPROOF_LEAVES)]
+    sel = sorted(random.Random(7).sample(
+        range(_MULTIPROOF_LEAVES), _MULTIPROOF_KEYS))
+    return items, sel
+
+
+def bench_multiproof_build(fast: bool):
+    from cometbft_tpu.crypto import merkle
+    items, sel = _multiproof_fixture()
+    stats = measure(lambda: merkle.multiproof_from_byte_slices(
+        items, sel), reps=5 if fast else 15, inner=3)
+    # 1/16-key builds ride along for the scaling picture (ungated)
+    for k in (1, 16):
+        sub = measure(lambda: merkle.multiproof_from_byte_slices(
+            items, sel[:k]), reps=3, inner=3)
+        stats[f"keys{k}_min_ms"] = sub["min_ms"]
+    stats["keys"] = _MULTIPROOF_KEYS
+    return stats
+
+
+def bench_multiproof_verify(fast: bool):
+    import json as _json
+
+    from cometbft_tpu.crypto import merkle
+    items, sel = _multiproof_fixture()
+    root, mp = merkle.multiproof_from_byte_slices(items, sel)
+    leaves = [items[i] for i in sel]
+    stats = measure(lambda: mp.verify(root, leaves),
+                    reps=5 if fast else 15, inner=3, warmup=2)
+    # serialized-size comparison vs 256 individual Proofs (the
+    # deterministic half of the compactness claim; also asserted in
+    # tests/test_lightserve.py)
+    _, proofs = merkle.proofs_from_byte_slices(items)
+    stats["bytes"] = len(_json.dumps(mp.to_dict()))
+    stats["per_key_bytes"] = sum(
+        len(_json.dumps(proofs[i].to_dict())) for i in sel)
+    stats["size_ratio"] = round(
+        stats["per_key_bytes"] / stats["bytes"], 2)
+    stats["keys"] = _MULTIPROOF_KEYS
+    return stats
+
+
+def bench_proofs_verify_256(fast: bool):
+    """The per-key comparison: verifying the same 256 leaves with 256
+    individual Proof objects."""
+    from cometbft_tpu.crypto import merkle
+    items, sel = _multiproof_fixture()
+    root, proofs = merkle.proofs_from_byte_slices(items)
+
+    def run():
+        for i in sel:
+            proofs[i].verify(root, items[i])
+
+    stats = measure(run, reps=5 if fast else 15, inner=3, warmup=2)
+    stats["keys"] = _MULTIPROOF_KEYS
+    return stats
+
+
+def bench_rpc_cache_hit(fast: bool):
+    from cometbft_tpu.lightserve.cache import ResponseCache
+    cache = ResponseCache(max_bytes=1 << 24)
+    payload = {"block": {"data": "x" * 512}}
+    for h in range(1, 513):
+        cache.put("block", h, (), payload, latest_height=1024)
+
+    def run():
+        for h in range(1, 513):
+            if cache.get("block", h) is None:
+                raise RuntimeError("expected a cache hit")
+
+    stats = measure(run, reps=5 if fast else 15, inner=4)
+    # per-op: each run() does 512 lookups
+    for k in ("p50_ms", "min_ms", "mean_ms"):
+        stats[k] = round(stats[k] / 512, 6)
+    return stats
+
+
 def bench_bftlint_selfcheck(fast: bool):
     from tools.bftlint import lint_paths
     from tools.bftlint.checkers import ALL_CHECKERS
@@ -297,6 +396,10 @@ BENCHMARKS = {
     "metrics_observe": (bench_metrics_observe, True),
     "tracing_disabled_span": (bench_tracing_disabled_span, True),
     "p2p_loopback_send": (bench_p2p_loopback_send, True),
+    "multiproof_build": (bench_multiproof_build, True),
+    "multiproof_verify": (bench_multiproof_verify, True),
+    "proofs_verify_256": (bench_proofs_verify_256, True),
+    "rpc_cache_hit": (bench_rpc_cache_hit, True),
     "bftlint_selfcheck": (bench_bftlint_selfcheck, True),
 }
 
