@@ -104,6 +104,22 @@ def is_valid_tx(tx: bytes) -> bool:
     return False
 
 
+def tx_recheck_keys(tx: bytes) -> list:
+    """The state keys a tx's validity depends on, for the mempool's
+    incremental recheck.  kvstore txs write exactly one kv key (or one
+    validator record); kvstore CheckTx is stateless, so this is a
+    conservative over-report — which is the safe direction."""
+    try:
+        if is_validator_tx(tx):
+            _, pub, _ = parse_validator_tx(tx)
+            return [VALIDATOR_PREFIX.encode() +
+                    base64.b64encode(pub)]
+        key, _ = parse_tx(tx.replace(b":", b"="))
+        return [_KV_PREFIX + key.encode()]
+    except ValueError:
+        return []
+
+
 def assign_lane(tx: bytes) -> str:
     """Deterministic lane assignment (reference: kvstore.go assignLane)."""
     if is_validator_tx(tx):
@@ -203,10 +219,13 @@ class KVStoreApplication(abci.Application):
                     code=CODE_TYPE_INVALID_TX_FORMAT)
         elif not is_valid_tx(req.tx):
             return abci.CheckTxResponse(code=CODE_TYPE_INVALID_TX_FORMAT)
+        keys = tx_recheck_keys(req.tx)
         if not self.lane_priorities:
-            return abci.CheckTxResponse(code=CODE_TYPE_OK, gas_wanted=1)
+            return abci.CheckTxResponse(code=CODE_TYPE_OK, gas_wanted=1,
+                                        recheck_keys=keys)
         return abci.CheckTxResponse(code=CODE_TYPE_OK, gas_wanted=1,
-                                    lane_id=assign_lane(req.tx))
+                                    lane_id=assign_lane(req.tx),
+                                    recheck_keys=keys)
 
     async def prepare_proposal(self, req: abci.PrepareProposalRequest
                                ) -> abci.PrepareProposalResponse:
@@ -287,6 +306,7 @@ class KVStoreApplication(abci.Application):
                 key = value = tx.decode(errors="replace")
             tx_results.append(abci.ExecTxResult(
                 code=CODE_TYPE_OK,
+                recheck_keys=tx_recheck_keys(tx),
                 events=[abci.Event(type="app", attributes=[
                     abci.EventAttribute("creator", "Cosmoshi Netowoko",
                                         True),

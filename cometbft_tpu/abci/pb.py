@@ -395,7 +395,8 @@ def response_to_proto(resp: Any) -> dict:
             "info": resp.info, "gas_wanted": resp.gas_wanted,
             "gas_used": resp.gas_used,
             "events": [_event_to(e) for e in resp.events],
-            "codespace": resp.codespace, "lane_id": resp.lane_id}}
+            "codespace": resp.codespace, "lane_id": resp.lane_id,
+            "recheck_keys": list(resp.recheck_keys)}}
     if t == "CommitResponse":
         return {"commit": {"retain_height": resp.retain_height}}
     if t == "ListSnapshotsResponse":
@@ -467,7 +468,8 @@ def response_from_proto(d: dict) -> Any:
             gas_used=b.get("gas_used", 0),
             events=[_event_from(e) for e in b.get("events", [])],
             codespace=b.get("codespace", ""),
-            lane_id=b.get("lane_id", ""))
+            lane_id=b.get("lane_id", ""),
+            recheck_keys=list(b.get("recheck_keys", [])))
     if "commit" in d:
         return abci.CommitResponse(
             retain_height=d["commit"].get("retain_height", 0))

@@ -134,6 +134,13 @@ class ExecTxResult:
     gas_used: int = 0
     events: list[Event] = field(default_factory=list)
     codespace: str = ""
+    # state keys this tx read/wrote, reported by the app for the
+    # mempool's incremental recheck (docs/pipeline.md).  NOT part of
+    # the results hash (like log/info/events, it is local metadata).
+    # Empty = the app doesn't attribute keys; the mempool then treats
+    # the commit as touching unknown state and falls back to its
+    # bounded-age watermark.
+    recheck_keys: list[bytes] = field(default_factory=list)
 
     def is_ok(self) -> bool:
         return self.code == CODE_TYPE_OK
@@ -346,6 +353,13 @@ class CheckTxResponse:
     events: list[Event] = field(default_factory=list)
     codespace: str = ""
     lane_id: str = ""
+    # state keys the tx's validity depends on, for incremental
+    # recheck: after a commit the mempool re-runs CheckTx only for
+    # pooled txs whose keys overlap the committed block's
+    # ExecTxResult.recheck_keys (plus the bounded-age watermark).
+    # Empty = unattributed; such a tx is revalidated on the watermark
+    # schedule only.
+    recheck_keys: list[bytes] = field(default_factory=list)
 
     def is_ok(self) -> bool:
         return self.code == CODE_TYPE_OK

@@ -114,6 +114,19 @@ class MempoolConfig:
     cache_size: int = 10000
     keep_invalid_txs_in_cache: bool = False
     max_tx_bytes: int = 1024 * 1024
+    # incremental recheck: after a commit, re-run CheckTx only for
+    # pooled txs whose app-reported state keys overlap the committed
+    # block's keys (CheckTxResponse/ExecTxResult.recheck_keys), plus
+    # any tx not revalidated within recheck_max_age_blocks heights
+    # (the bounded-age watermark — the backstop when the app reports
+    # no keys, and the cap on how stale any entry's validation may
+    # get).  False restores the full-pool recheck.
+    recheck_incremental: bool = True
+    recheck_max_age_blocks: int = 12
+    # CheckTx calls issued concurrently during a recheck pass (the
+    # async socket client pipelines them; the local client serializes
+    # on its own lock, so this only bounds gather fan-out)
+    recheck_batch_size: int = 64
 
 
 @dataclass
@@ -149,6 +162,21 @@ class ConsensusConfig:
     create_empty_blocks_interval_ns: int = 0
     peer_gossip_sleep_duration_ns: int = 100 * _MS
     peer_query_maj23_sleep_duration_ns: int = 2 * _S
+    # pipelined commit (docs/pipeline.md): run FinalizeBlock/apply/
+    # app-Commit/mempool-update of height H in a supervised background
+    # task while the round state advances to H+1 and keeps processing
+    # proposal/vote gossip; steps that need H's applied state (our own
+    # proposal, prevote validation, H+1's finalize) wait on an
+    # explicit pipeline barrier.  Replay always runs serial.
+    pipeline_commit: bool = True
+    # adaptive timeouts (docs/pipeline.md): derive propose/vote
+    # timeouts and the commit padding from an EWMA of the measured
+    # p95 quorum-prevote delay instead of the static values above,
+    # clamped to [floor, ceiling]; static config is the fallback
+    # while no delays have been measured (fresh node, replay).
+    adaptive_timeouts: bool = False
+    adaptive_timeout_floor_ns: int = 200 * _MS
+    adaptive_timeout_ceiling_ns: int = 10 * _S
 
     def propose_timeout_ns(self, round_: int) -> int:
         return self.timeout_propose_ns + \
@@ -273,6 +301,18 @@ def validate_basic(cfg: Config) -> None:
     if cfg.consensus.create_empty_blocks_interval_ns < 0:
         raise ConfigError(
             "consensus.create_empty_blocks_interval cannot be negative")
+    if cfg.consensus.adaptive_timeout_floor_ns < 0 or \
+            cfg.consensus.adaptive_timeout_ceiling_ns < \
+            cfg.consensus.adaptive_timeout_floor_ns:
+        raise ConfigError(
+            "consensus.adaptive_timeout_floor/ceiling must satisfy "
+            "0 <= floor <= ceiling")
+    if cfg.mempool.recheck_max_age_blocks <= 0:
+        raise ConfigError(
+            "mempool.recheck_max_age_blocks must be positive")
+    if cfg.mempool.recheck_batch_size <= 0:
+        raise ConfigError(
+            "mempool.recheck_batch_size must be positive")
     if cfg.tx_index.indexer not in ("kv", "psql", "null"):
         raise ConfigError(
             f"tx_index.indexer must be kv|psql|null, "

@@ -133,6 +133,22 @@ class Metrics:
             "Number of votes received corresponding to earlier "
             "heights/rounds than the node is in.",
             labels=("vote_type",))
+        # commit pipeline (docs/pipeline.md): how long the background
+        # execute/commit of height H ran, and how long the receive
+        # routine actually stalled on the barrier when it needed the
+        # applied state — overlap won = apply minus barrier wait
+        _pipe_buckets = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                         0.5, 1.0, 2.5, 5.0)
+        self.pipeline_apply_seconds = m.histogram(
+            "consensus", "pipeline_apply_seconds",
+            "Duration of the pipelined background execute/commit "
+            "(FinalizeBlock through mempool update) per height.",
+            buckets=_pipe_buckets)
+        self.pipeline_barrier_wait_seconds = m.histogram(
+            "consensus", "pipeline_barrier_wait_seconds",
+            "Time the consensus routine waited on the pipeline "
+            "barrier before a step that needs the applied state.",
+            buckets=_pipe_buckets)
         self.proposal_timestamp_difference = m.histogram(
             "consensus", "proposal_timestamp_difference",
             "Difference in seconds between local receive time and "

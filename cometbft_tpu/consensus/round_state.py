@@ -67,6 +67,133 @@ class RoundState:
     last_validators: Optional[ValidatorSet] = None
     triggered_timeout_precommit: bool = False
 
+    # ------------------------------------------------------------------
+    # state-transition seam (single-writer discipline, ROADMAP item 4)
+    #
+    # Every RoundState mutation the consensus machine performs after an
+    # await point goes through one of these methods instead of ad-hoc
+    # attribute stores.  Each transition re-validates its own
+    # preconditions at the moment of the write — the re-check the
+    # bftlint await-atomicity rule demands at a cross-await store —
+    # so a decision computed before a suspension can never be applied
+    # to a round the machine has already left.  With the commit
+    # pipeline two heights can be in flight; the receive routine stays
+    # the only caller, and these methods make that ownership (and its
+    # monotonicity) structural rather than an informal argument.
+
+    class TransitionError(Exception):
+        """A transition that would move the round state backwards."""
+
+    def advance(self, round_: int, step: int) -> None:
+        """Advance (round, step) within the current height.
+
+        Monotonic: refuses to move backwards — the re-validation at
+        the store site that the informal single-writer argument used
+        to stand in for."""
+        if (round_, step) < (self.round, self.step):
+            raise RoundState.TransitionError(
+                f"advance({round_}/{STEP_NAMES.get(step)}) would move "
+                f"{self} backwards")
+        self.round = round_
+        self.step = step
+
+    def begin_round(self, round_: int, validators) -> None:
+        """enterNewRound mutations: bump the round, install the
+        round's proposer-rotated validator set, clear the previous
+        round's proposal (rounds > 0), and track the next round's
+        votes."""
+        if round_ < self.round:
+            raise RoundState.TransitionError(
+                f"begin_round({round_}) would move {self} backwards")
+        self.round = round_
+        self.step = STEP_NEW_ROUND
+        self.validators = validators
+        if round_ != 0:
+            self.proposal = None
+            self.proposal_receive_time = Timestamp.zero()
+            self.proposal_block = None
+            self.proposal_block_parts = None
+        self.votes.set_round(round_ + 1)   # track next round too
+        self.triggered_timeout_precommit = False
+
+    def lock(self, round_: int, block, parts) -> None:
+        """Lock on a block (enterPrecommit +2/3-prevotes branch)."""
+        if round_ < self.locked_round:
+            raise RoundState.TransitionError(
+                f"lock({round_}) below locked_round "
+                f"{self.locked_round}")
+        self.locked_round = round_
+        self.locked_block = block
+        self.locked_block_parts = parts
+
+    def relock(self, round_: int) -> None:
+        """Re-lock the already-locked block at a later round."""
+        if self.locked_block is None or round_ < self.locked_round:
+            raise RoundState.TransitionError(
+                f"relock({round_}) without a valid earlier lock")
+        self.locked_round = round_
+
+    def set_valid(self, round_: int, block, parts) -> None:
+        """Record the POL (valid) block for round_."""
+        if round_ < self.valid_round:
+            raise RoundState.TransitionError(
+                f"set_valid({round_}) below valid_round "
+                f"{self.valid_round}")
+        self.valid_round = round_
+        self.valid_block = block
+        self.valid_block_parts = parts
+
+    def reset_proposal_parts(self, psh) -> None:
+        """Forget the (wrong or missing) proposal block and start
+        collecting parts for the part-set header peers committed
+        to."""
+        self.proposal_block = None
+        self.proposal_block_parts = PartSet(psh)
+
+    def drop_proposal_block(self) -> None:
+        """Forget an assembled proposal block (a quorum formed on a
+        different one) while keeping the part collection state."""
+        self.proposal_block = None
+
+    def begin_height(self, height: int, start_time, validators,
+                     votes, last_validators) -> None:
+        """updateToState's reset: a fresh height at round 0 with every
+        per-height field cleared."""
+        self.height = height
+        self.round = 0
+        self.step = STEP_NEW_HEIGHT
+        self.start_time = start_time
+        self.validators = validators
+        self.proposal = None
+        self.proposal_receive_time = Timestamp.zero()
+        self.proposal_block = None
+        self.proposal_block_parts = None
+        self.locked_round = -1
+        self.locked_block = None
+        self.locked_block_parts = None
+        self.valid_round = -1
+        self.valid_block = None
+        self.valid_block_parts = None
+        self.votes = votes
+        self.commit_round = -1
+        self.last_validators = last_validators
+        self.triggered_timeout_precommit = False
+
+    def adopt_block(self, block, parts) -> None:
+        """Adopt a fully-known block (e.g. the locked block on commit
+        entry) as the proposal block."""
+        self.proposal_block = block
+        self.proposal_block_parts = parts
+
+    def enter_commit(self, commit_round: int, commit_time) -> None:
+        """Enter the commit step for commit_round."""
+        if self.step >= STEP_COMMIT:
+            raise RoundState.TransitionError(
+                f"enter_commit: {self} already committing")
+        self.step = STEP_COMMIT
+        self.commit_round = commit_round
+        self.commit_time = commit_time
+
     def step_name(self) -> str:
         return STEP_NAMES.get(self.step, "Unknown")
 

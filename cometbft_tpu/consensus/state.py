@@ -19,7 +19,7 @@ from ..config import ConsensusConfig
 from ..libs import fail
 from ..libs import tracing
 from ..libs.log import Logger, new_logger
-from ..state.execution import BlockExecutor
+from ..state.execution import BlockExecutor, provisional_next_state
 from ..state.state import State as SMState
 from ..state.validation import BlockValidationError
 from ..types import canonical
@@ -40,6 +40,7 @@ from .height_vote_set import HeightVoteSet, HeightVoteSetError
 from .messages import (
     BlockPartMessage, ProposalMessage, VoteMessage,
 )
+from .adaptive import AdaptiveTimeouts
 from .round_state import (
     STEP_COMMIT, STEP_NAMES, STEP_NEW_HEIGHT, STEP_NEW_ROUND,
     STEP_PRECOMMIT, STEP_PRECOMMIT_WAIT, STEP_PREVOTE,
@@ -53,6 +54,24 @@ _TIME_IOTA_NS = 1_000_000  # minimum time increment between blocks (1ms)
 
 class ConsensusError(Exception):
     pass
+
+
+class _PipelinedCommit:
+    """One in-flight background execute/commit (docs/pipeline.md).
+
+    ``future`` resolves to the post-apply SMState (or the apply
+    failure).  Only the receive routine awaits it — the completion
+    hand-off back into consensus state happens on the single-writer
+    task, never from the background task itself."""
+
+    __slots__ = ("height", "future", "task", "t0")
+
+    def __init__(self, height: int, future: "asyncio.Future",
+                 t0: float):
+        self.height = height
+        self.future = future
+        self.task = None
+        self.t0 = t0
 
 
 class ConsensusState:
@@ -91,6 +110,19 @@ class ConsensusState:
 
         self.rs = RoundState()
         self.sm_state: Optional[SMState] = None
+        # pipelined commit: the one background execute/commit allowed
+        # in flight (pipeline depth 1); None when the machine is fully
+        # applied.  Steps that need the applied state call
+        # _sync_pipeline() — the explicit barrier.
+        self._pipeline: Optional[_PipelinedCommit] = None
+        # measured adaptive timeouts (consensus.adaptive_timeouts):
+        # fed from the same quorum-prevote-delay latch the histogram
+        # records; None = static config only
+        self._adaptive: Optional[AdaptiveTimeouts] = None
+        if getattr(config, "adaptive_timeouts", False):
+            self._adaptive = AdaptiveTimeouts(
+                config.adaptive_timeout_floor_ns,
+                config.adaptive_timeout_ceiling_ns)
         # highest (height, round) whose quorum-prevote delay was
         # observed: two_thirds_majority() stays true for every prevote
         # trailing the quorum — including stragglers from EARLIER
@@ -151,13 +183,52 @@ class ConsensusState:
                                  backoff_max_s=1.0))
         self._schedule_round0()
 
-    async def stop(self) -> None:
+    async def stop(self, drain_pipeline: bool = True) -> None:
+        """``drain_pipeline=False`` models a hard crash: an in-flight
+        pipelined apply is aborted instead of awaited, leaving the
+        stores wherever the crash-consistency barriers put them — the
+        WAL end-height record is already fsync'd, so restart recovery
+        (handshake + catchup replay) re-applies the block."""
         if self._task is not None:
             self._task.cancel()
             try:
                 await self._task
             except asyncio.CancelledError:
                 pass
+        # drain any in-flight pipelined apply: the block is decided
+        # and WAL-barriered, so letting the execute/commit finish
+        # keeps the stores one-height-consistent when it can complete;
+        # a failure here is already logged by the task itself
+        p, self._pipeline = self._pipeline, None
+        if p is not None and drain_pipeline:
+            # join the TASK, not the barrier future: cancelling the
+            # receive routine mid-barrier also cancelled the future
+            # it was awaiting, but the background apply keeps running
+            # and must be waited out (or aborted) before the stores
+            # are handed to a restart
+            try:
+                if p.task is not None:
+                    await asyncio.wait_for(p.task.wait(), 10.0)
+                else:
+                    await asyncio.wait_for(asyncio.shield(p.future),
+                                           10.0)
+            except Exception:
+                self.logger.info(
+                    "in-flight pipelined apply did not complete on "
+                    "stop; replay/handshake re-applies the block",
+                    height=p.height, exc_info=True)
+                if p.task is not None:
+                    p.task.cancel()
+        elif p is not None:
+            if p.task is not None:
+                p.task.cancel()
+            if not p.future.done():
+                p.future.cancel()
+            else:
+                try:
+                    p.future.exception()   # consume, never re-raised
+                except asyncio.CancelledError:
+                    pass
         self.ticker.stop()
         self.wal.close()
         self._stopped.set()
@@ -309,6 +380,19 @@ class ConsensusState:
         if ti.height != rs.height or ti.round < rs.round or \
                 (ti.round == rs.round and ti.step < rs.step):
             return
+        # create_empty_blocks gating (reference: state.go
+        # waiting-for-txs in enterPropose): with
+        # create_empty_blocks=false, or an interval that has not yet
+        # elapsed, an empty mempool re-arms a short poll instead of
+        # burning a full propose/prevote/precommit round on an empty
+        # block — at pipelined sub-second intervals the empty-block
+        # churn otherwise starves real work.  Checked BEFORE the WAL
+        # write so idle polls never bloat the WAL (they carry no
+        # state change to replay).
+        if ti.step == STEP_NEW_HEIGHT and self._should_wait_for_txs():
+            self._schedule_timeout(50 * 1_000_000, ti.height, 0,
+                                   STEP_NEW_HEIGHT)
+            return
         if not self.replay_mode:
             self.wal.write({"type": "timeout", "height": ti.height,
                             "round": ti.round, "step": ti.step})
@@ -363,42 +447,29 @@ class ConsensusState:
         if height == 1:
             height = state.initial_height
 
-        rs.height = height
-        rs.round = 0
-        rs.step = STEP_NEW_HEIGHT
-
         next_block_delay = state.next_block_delay_ns
         if next_block_delay == 0:
-            next_block_delay = self.config.timeout_commit_ns
+            # the padding came from static config, not from the app's
+            # next_block_delay decision — adaptivity may shrink it
+            next_block_delay = self._commit_padding_ns()
         if rs.commit_time.is_zero():
-            rs.start_time = Timestamp.now().add_ns(next_block_delay)
+            start_time = Timestamp.now().add_ns(next_block_delay)
         else:
-            rs.start_time = rs.commit_time.add_ns(next_block_delay)
+            start_time = rs.commit_time.add_ns(next_block_delay)
+
+        ext_enabled = state.consensus_params.feature \
+            .vote_extensions_enabled(height)
+        rs.begin_height(
+            height, start_time, validators,
+            HeightVoteSet(state.chain_id, height, validators,
+                          extensions_enabled=ext_enabled),
+            state.last_validators)
         # re-anchor: start_time is wall (a protocol-adjacent value);
         # elapsed-time consumers use the monotonic twin.  The offset
         # is SIGNED — a start_time already in the past (WAL replay,
         # slow commit) must keep reporting real elapsed time
         self._start_time_mono = time.monotonic() + \
             rs.start_time.sub(Timestamp.now()) / 1e9
-
-        rs.validators = validators
-        rs.proposal = None
-        rs.proposal_receive_time = Timestamp.zero()
-        rs.proposal_block = None
-        rs.proposal_block_parts = None
-        rs.locked_round = -1
-        rs.locked_block = None
-        rs.locked_block_parts = None
-        rs.valid_round = -1
-        rs.valid_block = None
-        rs.valid_block_parts = None
-        ext_enabled = state.consensus_params.feature \
-            .vote_extensions_enabled(height)
-        rs.votes = HeightVoteSet(state.chain_id, height, validators,
-                                 extensions_enabled=ext_enabled)
-        rs.commit_round = -1
-        rs.last_validators = state.last_validators
-        rs.triggered_timeout_precommit = False
         self.sm_state = state
         self._new_step()
 
@@ -538,6 +609,55 @@ class ConsensusState:
         self.ticker.schedule_timeout(
             TimeoutInfo(duration_ns, height, round_, step))
 
+    # ------------------------------------------------------------------
+    # timeout derivation: measured-adaptive when enabled AND the
+    # quorum-delay EWMA has data; the static config otherwise.  The
+    # per-round escalation deltas always come from the static config
+    # so liveness under asynchrony is unchanged (docs/pipeline.md).
+
+    def _propose_timeout_ns(self, round_: int) -> int:
+        if self._adaptive is not None:
+            base = self._adaptive.propose_timeout_ns()
+            if base is not None:
+                return base + \
+                    self.config.timeout_propose_delta_ns * round_
+        return self.config.propose_timeout_ns(round_)
+
+    def _vote_wait_timeout_ns(self, round_: int) -> int:
+        if self._adaptive is not None:
+            base = self._adaptive.vote_timeout_ns()
+            if base is not None:
+                return base + self.config.timeout_vote_delta_ns * round_
+        return self.config.prevote_timeout_ns(round_)
+
+    def _commit_padding_ns(self) -> int:
+        """Static commit padding, adaptively shrunk when measured
+        quorum delays say the net is faster than the config."""
+        padding = self.config.timeout_commit_ns
+        if self._adaptive is not None:
+            padding = self._adaptive.commit_padding_ns(padding)
+        return padding
+
+    def _should_wait_for_txs(self) -> bool:
+        """True while round 0 of a fresh height should hold off
+        proposing because the pool is empty (config.wait_for_txs):
+        create_empty_blocks=false waits indefinitely; a nonzero
+        create_empty_blocks_interval waits until the interval since
+        the height's start_time has elapsed.  Replay never waits (the
+        WAL drives it), and only round 0 is gated — once any round
+        ran, liveness wins."""
+        if self.replay_mode or self.rs.round != 0:
+            return False
+        if not self.config.wait_for_txs():
+            return False
+        mp = getattr(self.block_exec, "mempool", None)
+        if mp is None or mp.size() > 0:
+            return False
+        if not self.config.create_empty_blocks:
+            return True
+        interval_s = self.config.create_empty_blocks_interval_ns / 1e9
+        return (time.monotonic() - self._start_time_mono) < interval_s
+
     # ==================================================================
     # step: NewRound
 
@@ -550,16 +670,7 @@ class ConsensusState:
         if rs.round < round_:
             validators = validators.copy()
             validators.increment_proposer_priority(round_ - rs.round)
-        rs.round = round_
-        rs.step = STEP_NEW_ROUND
-        rs.validators = validators
-        if round_ != 0:
-            rs.proposal = None
-            rs.proposal_receive_time = Timestamp.zero()
-            rs.proposal_block = None
-            rs.proposal_block_parts = None
-        rs.votes.set_round(round_ + 1)  # track next round too
-        rs.triggered_timeout_precommit = False
+        rs.begin_round(round_, validators)
         self.metrics.mark_round(round_)
         self.event_bus.publish_new_round(rs.event_summary())
         await self._enter_propose(height, round_)
@@ -577,14 +688,13 @@ class ConsensusState:
             return
 
         async def done() -> None:
-            rs.round = round_
-            rs.step = STEP_PROPOSE
+            rs.advance(round_, STEP_PROPOSE)
             self._new_step()
             if self._is_proposal_complete():
                 await self._enter_prevote(height, rs.round)
 
         self._schedule_timeout(
-            self.config.propose_timeout_ns(round_), height, round_,
+            self._propose_timeout_ns(round_), height, round_,
             STEP_PROPOSE)
 
         if self.priv_validator is None or \
@@ -604,7 +714,13 @@ class ConsensusState:
 
     async def _decide_proposal(self, height: int, round_: int) -> None:
         """Reference: defaultDecideProposal."""
+        # pipeline barrier: the proposer needs the previous height's
+        # app hash / results hash in the new block's header — wait out
+        # any in-flight execute/commit before reaping and building
+        await self._sync_pipeline()
         rs = self.rs
+        if rs.height != height or round_ < rs.round:
+            return   # the machine moved on while we waited
         if rs.valid_block is not None:
             block, block_parts = rs.valid_block, rs.valid_block_parts
         else:
@@ -775,9 +891,8 @@ class ConsensusState:
         if has_two_thirds and not block_id.is_nil() and \
                 rs.valid_round < rs.round:
             if rs.proposal_block.hash() == block_id.hash:
-                rs.valid_round = rs.round
-                rs.valid_block = rs.proposal_block
-                rs.valid_block_parts = rs.proposal_block_parts
+                rs.set_valid(rs.round, rs.proposal_block,
+                             rs.proposal_block_parts)
         if rs.step <= STEP_PROPOSE and self._is_proposal_complete():
             await self._enter_prevote(height, rs.round)
             if has_two_thirds:
@@ -794,8 +909,10 @@ class ConsensusState:
                 (rs.round == round_ and rs.step >= STEP_PREVOTE):
             return
         await self._do_prevote(height, round_)
-        rs.round = round_
-        rs.step = STEP_PREVOTE
+        # the transition seam re-validates monotonicity at the store —
+        # the cross-await discipline bftlint's await-atomicity rule
+        # checks (the sign/validate awaits above suspend this routine)
+        rs.advance(round_, STEP_PREVOTE)
         self._new_step()
 
     async def _do_prevote(self, height: int, round_: int) -> None:
@@ -833,6 +950,11 @@ class ConsensusState:
                         await self._sign_add_vote(canonical.PREVOTE_TYPE, b"",
                                             PartSetHeader())
                         return
+                # pipeline barrier: full validation needs the applied
+                # previous height (app hash, results hash) and the app
+                # itself must be past H-1's Commit before it sees
+                # ProcessProposal(H)
+                await self._sync_pipeline()
                 try:
                     self.block_exec.validate_block(self.sm_state,
                                                    rs.proposal_block)
@@ -897,10 +1019,9 @@ class ConsensusState:
         if not rs.votes.prevotes(round_).has_two_thirds_any():
             raise ConsensusError(
                 "entering prevote wait without any +2/3 prevotes")
-        rs.round = round_
-        rs.step = STEP_PREVOTE_WAIT
+        rs.advance(round_, STEP_PREVOTE_WAIT)
         self._new_step()
-        self._schedule_timeout(self.config.prevote_timeout_ns(round_),
+        self._schedule_timeout(self._vote_wait_timeout_ns(round_),
                                height, round_, STEP_PREVOTE_WAIT)
 
     # ==================================================================
@@ -914,8 +1035,7 @@ class ConsensusState:
             return
 
         def done() -> None:
-            rs.round = round_
-            rs.step = STEP_PRECOMMIT
+            rs.advance(round_, STEP_PRECOMMIT)
             self._new_step()
 
         block_id, ok = rs.votes.prevotes(round_).two_thirds_majority()
@@ -936,7 +1056,7 @@ class ConsensusState:
         # +2/3 prevoted a block
         if rs.locked_block is not None and \
                 rs.locked_block.hash() == block_id.hash:
-            rs.locked_round = round_
+            rs.relock(round_)
             self.event_bus.publish_relock(rs.event_summary())
             await self._sign_add_vote(canonical.PRECOMMIT_TYPE, block_id.hash,
                                 block_id.part_set_header,
@@ -946,15 +1066,17 @@ class ConsensusState:
 
         if rs.proposal_block is not None and \
                 rs.proposal_block.hash() == block_id.hash:
+            # pipeline barrier: validating a block we never prevoted
+            # (we may be locking straight off a polka) needs the
+            # applied previous height
+            await self._sync_pipeline()
             try:
                 self.block_exec.validate_block(self.sm_state,
                                                rs.proposal_block)
             except BlockValidationError as e:
                 raise ConsensusError(
                     f"+2/3 prevoted for an invalid block: {e}") from e
-            rs.locked_round = round_
-            rs.locked_block = rs.proposal_block
-            rs.locked_block_parts = rs.proposal_block_parts
+            rs.lock(round_, rs.proposal_block, rs.proposal_block_parts)
             self.event_bus.publish_lock(rs.event_summary())
             await self._sign_add_vote(canonical.PRECOMMIT_TYPE, block_id.hash,
                                 block_id.part_set_header,
@@ -966,8 +1088,7 @@ class ConsensusState:
         if rs.proposal_block_parts is None or \
                 not rs.proposal_block_parts.has_header(
                     block_id.part_set_header):
-            rs.proposal_block = None
-            rs.proposal_block_parts = PartSet(block_id.part_set_header)
+            rs.reset_proposal_parts(block_id.part_set_header)
         await self._sign_add_vote(canonical.PRECOMMIT_TYPE, b"",
                             PartSetHeader())
         done()
@@ -982,7 +1103,7 @@ class ConsensusState:
                 "entering precommit wait without any +2/3 precommits")
         rs.triggered_timeout_precommit = True
         self._new_step()
-        self._schedule_timeout(self.config.precommit_timeout_ns(round_),
+        self._schedule_timeout(self._vote_wait_timeout_ns(round_),
                                height, round_, STEP_PRECOMMIT_WAIT)
 
     # ==================================================================
@@ -999,24 +1120,19 @@ class ConsensusState:
         if not ok or block_id.is_nil():
             raise ConsensusError("enterCommit expects +2/3 precommits")
 
-        rs.step = STEP_COMMIT
-        rs.commit_round = commit_round
-        rs.commit_time = Timestamp.now()
+        rs.enter_commit(commit_round, Timestamp.now())
         self._new_step()
 
         if rs.locked_block is not None and \
                 rs.locked_block.hash() == block_id.hash:
-            rs.proposal_block = rs.locked_block
-            rs.proposal_block_parts = rs.locked_block_parts
+            rs.adopt_block(rs.locked_block, rs.locked_block_parts)
 
         if rs.proposal_block is None or \
                 rs.proposal_block.hash() != block_id.hash:
             if rs.proposal_block_parts is None or \
                     not rs.proposal_block_parts.has_header(
                         block_id.part_set_header):
-                rs.proposal_block = None
-                rs.proposal_block_parts = PartSet(
-                    block_id.part_set_header)
+                rs.reset_proposal_parts(block_id.part_set_header)
                 self.event_bus.publish_valid_block(rs.event_summary())
                 # tell peers which parts we ACTUALLY hold (reference:
                 # the reactor broadcasts NewValidBlockMessage on
@@ -1042,9 +1158,24 @@ class ConsensusState:
         await self._finalize_commit(height)
 
     async def _finalize_commit(self, height: int) -> None:
-        """Reference: finalizeCommit (:1834) — validate, save with seen
-        commit, WAL EndHeight fsync barrier, ApplyBlock, updateToState,
-        schedule round 0."""
+        """Reference: finalizeCommit (:1834), split for the commit
+        pipeline (docs/pipeline.md) into
+
+          decide  — validate, save block + seen commit, fsync the WAL
+                    EndHeight barrier (synchronous, this method);
+          execute — FinalizeBlock/save-responses/app-Commit/mempool
+                    update (supervised background task when
+                    ``consensus.pipeline_commit``; inline otherwise);
+          advance — updateToState + schedule round 0.  Pipelined mode
+                    advances on a *provisional* next state so H+1's
+                    propose/gossip/vote tally overlap H's execution;
+                    the barrier (``_sync_pipeline``) installs the real
+                    post-apply state before anything reads it.
+        """
+        # pipeline depth is 1: H-1's execute/commit must have fully
+        # landed before H's begins (also orders the mempool update
+        # hand-offs)
+        await self._sync_pipeline()
         rs = self.rs
         if rs.height != height or rs.step != STEP_COMMIT:
             return
@@ -1087,7 +1218,10 @@ class ConsensusState:
                        # written (state.go:1889)
 
         # fsync'd end-of-height barrier BEFORE ApplyBlock: on crash,
-        # replay/handshake re-applies the block
+        # replay/handshake re-applies the block.  In pipelined mode
+        # every H+1 message the receive routine processes from here on
+        # lands in the WAL after this record, so catchup replay sees
+        # the same prefix the serial path would have written.
         self.wal.write_end_height(height)
 
         fail.fail()    # crash point: barrier written, block not applied
@@ -1098,26 +1232,161 @@ class ConsensusState:
                                    block_size=block_parts.byte_size,
                                    commit_round=rs.commit_round)
         state_copy = self.sm_state.copy()
-        with tracing.span(tracing.CONSENSUS, "apply_block",
-                          height=height, num_txs=len(block.data.txs)):
-            state_copy = await self.block_exec.apply_verified_block(
-                state_copy,
-                BlockID(hash=block.hash(),
-                        part_set_header=block_parts.header()),
-                block, block.header.height)
+        bid = BlockID(hash=block.hash(),
+                      part_set_header=block_parts.header())
+        if getattr(self.config, "pipeline_commit", False) and \
+                not self.replay_mode:
+            self._begin_pipelined_apply(height, bid, block,
+                                        block_parts, state_copy,
+                                        rs.commit_round)
+            next_state = provisional_next_state(self.sm_state, bid,
+                                                block)
+        else:
+            with tracing.span(tracing.CONSENSUS, "apply_block",
+                              height=height,
+                              num_txs=len(block.data.txs)):
+                state_copy = await self.block_exec \
+                    .apply_verified_block(state_copy, bid, block,
+                                          block.header.height)
 
-        fail.fail()    # crash point: applied, consensus state not yet
-                       # advanced (state.go:1933)
+            fail.fail()    # crash point: applied, consensus state not
+                           # yet advanced (state.go:1933)
 
-        tracing.instant(tracing.CONSENSUS, "commit", height=height,
-                        num_txs=len(block.data.txs),
-                        round=rs.commit_round,
-                        block_bytes=block_parts.byte_size)
-        self.update_to_state(state_copy)
+            tracing.instant(tracing.CONSENSUS, "commit", height=height,
+                            num_txs=len(block.data.txs),
+                            round=rs.commit_round,
+                            block_bytes=block_parts.byte_size)
+            next_state = state_copy
+        self.update_to_state(next_state)
         if self.priv_validator is not None:
             self.priv_validator_pub_key = \
                 self.priv_validator.get_pub_key()
         self._schedule_round0()
+
+    # ------------------------------------------------------------------
+    # commit pipeline (docs/pipeline.md)
+
+    def _begin_pipelined_apply(self, height: int, bid: BlockID, block,
+                               block_parts, state_copy,
+                               commit_round: int) -> None:
+        """Launch the supervised background execute/commit for the
+        decided block.  The task never touches RoundState or
+        ``sm_state`` — it resolves the barrier future and the receive
+        routine (the single writer) installs the result."""
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        p = _PipelinedCommit(height, fut, time.monotonic())
+
+        async def _apply_task() -> None:
+            try:
+                with tracing.span(tracing.CONSENSUS, "apply_block",
+                                  height=height,
+                                  num_txs=len(block.data.txs)):
+                    new_state = await self.block_exec \
+                        .apply_verified_block(state_copy, bid, block,
+                                              block.header.height)
+                fail.fail()    # crash point: applied, consensus state
+                               # not yet advanced (state.go:1933)
+                tracing.instant(tracing.CONSENSUS, "commit",
+                                height=height,
+                                num_txs=len(block.data.txs),
+                                round=commit_round,
+                                block_bytes=block_parts.byte_size)
+                self.metrics.pipeline_apply_seconds.observe(
+                    time.monotonic() - p.t0)
+            except asyncio.CancelledError:
+                if not fut.done():
+                    fut.cancel()
+                raise
+            except Exception as e:
+                # surfaced to every barrier waiter; the receive
+                # routine crashes loudly on its next sync, exactly
+                # like a serial apply failure
+                if not fut.done():
+                    fut.set_exception(e)
+                raise
+            if not fut.done():
+                fut.set_result(new_state)
+
+        from ..libs.supervisor import RestartPolicy
+        # no restarts: re-running FinalizeBlock after a partial apply
+        # would double-execute the block — crash recovery is the WAL
+        # barrier + handshake's job, not the supervisor's
+        p.task = self.supervisor.spawn(
+            _apply_task, name=f"pipeline_apply:{height}",
+            kind="consensus_pipeline_apply",
+            policy=RestartPolicy(max_restarts=0, window_s=1.0,
+                                 backoff_base_s=0.01,
+                                 backoff_max_s=0.01))
+        self._pipeline = p
+        tracing.instant(tracing.CONSENSUS, "pipeline_advance",
+                        height=height)
+
+    async def _sync_pipeline(self) -> None:
+        """The pipeline barrier: wait for the in-flight execute/commit
+        and install the real post-apply state over the provisional
+        one.  Called from the receive routine only (the single
+        writer), at every step that reads the applied state: our own
+        proposal construction, prevote validation / ProcessProposal,
+        vote-extension verification, and the next height's finalize."""
+        p = self._pipeline
+        if p is None:
+            return
+        t0 = time.monotonic()
+        # on failure (or cancellation of this waiter) the pipeline
+        # handle stays latched: an apply failure must poison every
+        # later barrier too — clearing it here would let a
+        # supervisor-restarted receive routine carry on at H+1 with
+        # the provisional (pre-apply) state, which is unsound — and a
+        # cancelled stop() still needs the handle to drain/abort the
+        # background task
+        new_state = await p.future
+        self._pipeline = None
+        self.metrics.pipeline_barrier_wait_seconds.observe(
+            time.monotonic() - t0)
+        self._reconcile_applied_state(p.height, new_state)
+
+    def _reconcile_applied_state(self, applied_height: int,
+                                 new_state: SMState) -> None:
+        """Swap the provisional H+1 state for the real post-apply one.
+
+        The provisional state already fixed the H+1 validator set and
+        vote-extension schedule (validator updates from H land at
+        H+2), so normally this is a plain assignment.  The one thing a
+        committed block CAN change out from under the provisional
+        snapshot is a consensus-param update taking effect at H+1 —
+        then the height vote set was built under the wrong rules and
+        is rebuilt; peers re-gossip any votes already tallied."""
+        rs = self.rs
+        if rs.height != applied_height + 1:
+            raise ConsensusError(
+                f"pipeline reconcile: round state at {rs.height}, "
+                f"applied height {applied_height}")
+        prov = self.sm_state
+        prov_ext = prov.consensus_params.feature \
+            .vote_extensions_enabled(rs.height)
+        real_ext = new_state.consensus_params.feature \
+            .vote_extensions_enabled(rs.height)
+        prov_vals = prov.validators.hash()
+        real_vals = new_state.validators.hash()
+        self.sm_state = new_state
+        if prov_ext != real_ext or prov_vals != real_vals:
+            self.logger.info(
+                "pipeline reconcile: consensus params changed at the "
+                "pipelined height; rebuilding height vote set",
+                height=rs.height, ext_changed=prov_ext != real_ext)
+            vals = new_state.validators
+            if rs.round > 0:
+                # preserve the proposer rotation _enter_new_round
+                # applied for the current round — installing round-0
+                # priorities here would make this node disagree with
+                # its peers about the round's proposer
+                vals = vals.copy()
+                vals.increment_proposer_priority(rs.round)
+            rs.validators = vals
+            rs.votes = HeightVoteSet(new_state.chain_id, rs.height,
+                                     vals,
+                                     extensions_enabled=real_ext)
+            rs.votes.set_round(rs.round + 1)
 
     # ==================================================================
     # votes
@@ -1185,6 +1454,9 @@ class ConsensusState:
                         f"bounds")
                 vote.verify_extension(self.sm_state.chain_id,
                                       val.pub_key)
+                # pipeline barrier: the app must be past the previous
+                # height's Commit before VerifyVoteExtension(H)
+                await self._sync_pipeline()
                 ok = await self.block_exec.verify_vote_extension(vote)
                 self.metrics.vote_extension_receive_count.with_labels(
                     "accepted" if ok else "rejected").add()
@@ -1229,6 +1501,9 @@ class ConsensusState:
                     self._quorum_delay_observed = (height, vote.round)
                     self.metrics.quorum_prevote_delay_seconds.observe(
                         max(0.0, delay_s))
+                    if self._adaptive is not None and \
+                            not self.replay_mode:
+                        self._adaptive.observe(delay_s)
                 if prevotes.has_all():
                     self.metrics.full_prevote_delay.with_labels(
                         proposer).set(delay_s)
@@ -1240,15 +1515,14 @@ class ConsensusState:
                         vote.round == rs.round:
                     if rs.proposal_block is not None and \
                             rs.proposal_block.hash() == block_id.hash:
-                        rs.valid_round = vote.round
-                        rs.valid_block = rs.proposal_block
-                        rs.valid_block_parts = rs.proposal_block_parts
+                        rs.set_valid(vote.round, rs.proposal_block,
+                                     rs.proposal_block_parts)
                     else:
-                        rs.proposal_block = None
+                        rs.drop_proposal_block()
                     if rs.proposal_block_parts is None or \
                             not rs.proposal_block_parts.has_header(
                                 block_id.part_set_header):
-                        rs.proposal_block_parts = PartSet(
+                        rs.reset_proposal_parts(
                             block_id.part_set_header)
                     self.event_bus.publish_valid_block(
                         rs.event_summary())

@@ -73,11 +73,15 @@ class TxCache:
 class MempoolTx:
     tx: bytes
     key: bytes
-    height: int          # height at which the tx was validated
+    height: int          # height at which the tx was last validated
     gas_wanted: int
     lane: str
     senders: set = field(default_factory=set)
     seq: int = 0         # global FIFO sequence for cross-lane ordering
+    # app-reported state keys the tx's validity depends on
+    # (CheckTxResponse.recheck_keys); empty = unattributed, so the
+    # bounded-age watermark alone schedules its rechecks
+    recheck_keys: frozenset = frozenset()
 
 
 class Mempool(abc.ABC):
@@ -146,6 +150,7 @@ class CListMempool(Mempool):
         self.height = height
         self._seq = 0
         self._size_bytes = 0
+        self._size_count = 0
         # commit-time exclusion: while locked, check_tx waits so no tx
         # can slip in unvalidated between FinalizeBlock and recheck
         self._unlocked = asyncio.Event()
@@ -153,6 +158,10 @@ class CListMempool(Mempool):
         self._txs_available: Optional[asyncio.Event] = None
         self._notified_txs_available = False
         self._recheck_cursor: Optional[int] = None
+        # tx keys admitted while a commit cycle raced their in-flight
+        # CheckTx: revalidated unconditionally by the next update()
+        # (the FinalizeBlock↔recheck admission-gap fix)
+        self._pending_recheck: set[bytes] = set()
         # broadcast wakeup for per-peer gossip routines: replaced on
         # every append so any number of waiters can block on it (the
         # clist-wait analog, reference internal/clist/clist.go:95-104)
@@ -210,7 +219,10 @@ class CListMempool(Mempool):
         await self.proxy_app.flush()
 
     def size(self) -> int:
-        return sum(len(d) for d in self._lane_txs.values())
+        # O(1): called on every CheckTx (_check_full), every metrics
+        # update, and every gossip bound — a lane scan here was
+        # measurable in the QA_r07 profile
+        return self._size_count
 
     def size_bytes(self) -> int:
         return self._size_bytes
@@ -236,6 +248,8 @@ class CListMempool(Mempool):
         for lane in self._lane_bytes:
             self._lane_bytes[lane] = 0
         self._size_bytes = 0
+        self._size_count = 0
+        self._pending_recheck.clear()
         self.cache.reset()
 
     # ------------------------------------------------------------------
@@ -250,7 +264,10 @@ class CListMempool(Mempool):
         # wait out any in-progress commit/update cycle
         while not self._unlocked.is_set():
             await self._unlocked.wait()
-        self._check_full(len(tx))
+        # dedup BEFORE the capacity math: under gossip most
+        # deliveries are duplicates (every peer forwards the same
+        # txs), and the QA_r07 profile showed the dup path paying
+        # the full admission bookkeeping per call
         key = tx_key(tx)
         if not self.cache.push(key):
             # record the extra sender for dedup/gossip routing
@@ -260,6 +277,12 @@ class CListMempool(Mempool):
                     e.senders.add(sender)
             self.metrics.already_received_txs.add()
             raise TxInCacheError("tx already exists in cache")
+        try:
+            self._check_full(len(tx))
+        except MempoolError:
+            self.cache.remove(key)
+            raise
+        checked_at = self.height
         try:
             import time as _time
             _t0 = _time.perf_counter()
@@ -280,12 +303,27 @@ class CListMempool(Mempool):
             raise InvalidTxError(res.code, res.log)
         try:
             lane = self._resolve_lane(res.lane_id)
-            self._add_tx(tx, key, res.gas_wanted, lane, sender)
+            self._add_tx(tx, key, res.gas_wanted, lane, sender,
+                         getattr(res, "recheck_keys", None))
         except MempoolError:
             # a tx never admitted to the pool must not stay cached, or
             # it becomes unsubmittable until LRU eviction
             self.cache.remove(key)
             raise
+        # the FinalizeBlock↔recheck gap (the old :150 note): the gate
+        # above ran BEFORE the CheckTx await, so a commit cycle that
+        # started during the call validated this tx against pre-block
+        # state AND already ran its recheck pass without us.  Mark the
+        # entry so the NEXT update()'s recheck slice revalidates it
+        # unconditionally — key overlap and the watermark may both
+        # miss it.  No retry loop here: under sub-second block
+        # intervals a validate-retry could chase the tip forever.
+        if not self._unlocked.is_set() or self.height != checked_at:
+            # pointless (and unbounded) when recheck is disabled —
+            # nothing would ever drain the set
+            if self.config.recheck and self.contains(key):
+                self._pending_recheck.add(key)
+                self.metrics.checktx_revalidations.add()
         return res
 
     def _resolve_lane(self, lane_id: str) -> str:
@@ -304,7 +342,8 @@ class CListMempool(Mempool):
                 f"{self._size_bytes} bytes")
 
     def _add_tx(self, tx: bytes, key: bytes, gas_wanted: int,
-                lane: str, sender: str) -> None:
+                lane: str, sender: str,
+                recheck_keys=None) -> None:
         if self.contains(key):
             return
         # capacity may have changed across the CheckTx await
@@ -314,8 +353,10 @@ class CListMempool(Mempool):
         entry = MempoolTx(tx=tx, key=key, height=self.height,
                           gas_wanted=gas_wanted, lane=lane,
                           senders={sender} if sender else set(),
-                          seq=self._seq)
+                          seq=self._seq,
+                          recheck_keys=frozenset(recheck_keys or ()))
         self._lane_txs[lane][key] = entry
+        self._size_count += 1
         self._size_bytes += len(tx)
         self._lane_bytes[lane] = \
             self._lane_bytes.get(lane, 0) + len(tx)
@@ -330,6 +371,7 @@ class CListMempool(Mempool):
         for d in self._lane_txs.values():
             e = d.pop(key, None)
             if e is not None:
+                self._size_count -= 1
                 self._size_bytes -= len(e.tx)
                 self._lane_bytes[e.lane] = \
                     self._lane_bytes.get(e.lane, 0) - len(e.tx)
@@ -397,18 +439,32 @@ class CListMempool(Mempool):
                      tx_results: Sequence[abci.ExecTxResult],
                      pre_check: Optional[Callable] = None,
                      post_check: Optional[Callable] = None) -> None:
-        """Remove committed txs, then recheck the remainder.
+        """Remove committed txs, then recheck the invalidated slice.
 
         Reference: Update (:767) — caller must hold the mempool lock
-        (BlockExecutor.commit does)."""
+        (BlockExecutor.commit does).  Incremental recheck
+        (docs/pipeline.md): the committed block's app-reported
+        ``recheck_keys`` select which pooled txs could have been
+        invalidated; everything else is revalidated on the bounded-age
+        watermark instead of after every block."""
         self.height = height
         self._notified_txs_available = False
         if self._txs_available is not None:
             self._txs_available.clear()
+        touched: set[bytes] = set()
+        unattributed_commit = False
         for tx, res in zip(txs, tx_results):
             key = tx_key(tx)
             if res.code == abci.CODE_TYPE_OK:
                 self.cache.push(key)   # committed: keep in cache forever
+                rk = getattr(res, "recheck_keys", None)
+                if rk:
+                    touched.update(rk)
+                else:
+                    # a state-changing tx the app didn't attribute:
+                    # key targeting is unsound for this block, fall
+                    # back to rechecking every attributed entry too
+                    unattributed_commit = True
             elif not self.config.keep_invalid_txs_in_cache:
                 self.cache.remove(key)
             try:
@@ -418,34 +474,87 @@ class CListMempool(Mempool):
         if self.config.recheck and self.size() > 0:
             import time as _time
             t0 = _time.perf_counter()
-            with tracing.span(tracing.MEMPOOL, "recheck",
-                              height=height, txs=self.size()):
-                await self._recheck_txs()
+            if self.config.recheck_incremental:
+                due = self._recheck_slice(height, touched,
+                                          unattributed_commit)
+                if self._pending_recheck:
+                    seen = {e.key for e in due}
+                    for d in self._lane_txs.values():
+                        for e in d.values():
+                            if e.key in self._pending_recheck and \
+                                    e.key not in seen:
+                                due.append(e)
+            else:
+                due = [e for d in self._lane_txs.values()
+                       for e in d.values()]
+            skipped = self.size() - len(due)
+            if skipped:
+                self.metrics.recheck_skipped_txs.add(skipped)
+            if due:
+                with tracing.span(tracing.MEMPOOL, "recheck",
+                                  height=height, txs=len(due),
+                                  skipped=skipped):
+                    await self._recheck_entries(due)
             dt = _time.perf_counter() - t0
             self.metrics.recheck_duration_seconds.set(dt)
             self.metrics.recheck_pass_duration_seconds.observe(dt)
+        # every commit settles the raced-admission flags — the due
+        # slice above consumed them; with recheck disabled or an
+        # empty pool there is nothing left they could select
+        self._pending_recheck.clear()
         self.metrics.update_sizes(self)
         self._notify_txs_available()
 
-    async def _recheck_txs(self) -> None:
-        """Re-validate every pooled tx at the new height (reference:
-        recheckTxs + handleRecheckTxResponse :618)."""
-        for lane, d in self._lane_txs.items():
-            for key in list(d.keys()):
-                e = d.get(key)
-                if e is None:
-                    continue
-                res = await self.proxy_app.check_tx(abci.CheckTxRequest(
+    def _recheck_slice(self, height: int, touched: set,
+                       unattributed_commit: bool) -> list[MempoolTx]:
+        """The pooled txs the committed block could have invalidated:
+        key overlap where the app attributes state keys, plus every
+        entry whose last validation is recheck_max_age_blocks old (the
+        watermark bounds staleness for unattributed txs and apps, and
+        for validity that depends on non-key state like height)."""
+        max_age = self.config.recheck_max_age_blocks
+        due: list[MempoolTx] = []
+        for d in self._lane_txs.values():
+            for e in d.values():
+                if height - e.height >= max_age:
+                    due.append(e)
+                elif e.recheck_keys and (
+                        unattributed_commit or
+                        not touched.isdisjoint(e.recheck_keys)):
+                    due.append(e)
+        return due
+
+    async def _recheck_entries(self, entries: list[MempoolTx]) -> None:
+        """Re-validate the given entries at the new height (reference:
+        recheckTxs + handleRecheckTxResponse :618), batching CheckTx
+        through the async client — the socket transport pipelines the
+        whole chunk in flight instead of paying a round trip per tx."""
+        batch = max(1, self.config.recheck_batch_size)
+        for i in range(0, len(entries), batch):
+            chunk = entries[i:i + batch]
+            results = await asyncio.gather(
+                *(self.proxy_app.check_tx(abci.CheckTxRequest(
                     tx=e.tx, type=abci.CHECK_TX_TYPE_RECHECK))
+                  for e in chunk),
+                return_exceptions=True)
+            for e, res in zip(chunk, results):
+                if isinstance(res, BaseException):
+                    raise res
                 self.metrics.recheck_times.add()
                 if res.code != abci.CODE_TYPE_OK:
-                    d.pop(key, None)
-                    self._size_bytes -= len(e.tx)
-                    self._lane_bytes[e.lane] = \
-                        self._lane_bytes.get(e.lane, 0) - len(e.tx)
-                    self.metrics.evicted_txs.add()
+                    removed = self._lane_txs.get(e.lane, {}) \
+                        .pop(e.key, None)
+                    if removed is not None:
+                        self._size_count -= 1
+                        self._size_bytes -= len(e.tx)
+                        self._lane_bytes[e.lane] = \
+                            self._lane_bytes.get(e.lane, 0) - len(e.tx)
+                        self.metrics.evicted_txs.add()
                     if not self.config.keep_invalid_txs_in_cache:
-                        self.cache.remove(key)
+                        self.cache.remove(e.key)
+                else:
+                    # revalidated: reset the watermark clock
+                    e.height = self.height
 
 
 class NopMempool(Mempool):

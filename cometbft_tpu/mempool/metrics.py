@@ -62,6 +62,16 @@ class Metrics:
             "mempool", "already_received_txs",
             "Number of duplicate transaction receptions (cache "
             "hits).")
+        # incremental recheck (docs/pipeline.md)
+        self.recheck_skipped_txs = m.counter(
+            "mempool", "recheck_skipped_txs",
+            "Pooled transactions the incremental recheck proved "
+            "untouched by the committed block and skipped.")
+        self.checktx_revalidations = m.counter(
+            "mempool", "checktx_revalidations",
+            "CheckTx calls re-issued because a commit cycle raced "
+            "the in-flight validation (the FinalizeBlock-to-recheck "
+            "admission gap).")
 
     def update_sizes(self, mempool) -> None:
         self.size.set(mempool.size())

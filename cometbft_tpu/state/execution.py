@@ -464,6 +464,31 @@ class BlockExecutor:
             bus.publish_validator_set_updates(validator_updates)
 
 
+def provisional_next_state(state: State, block_id: BlockID,
+                           block: Block) -> State:
+    """The H+1 state the consensus machine can know BEFORE height H's
+    FinalizeBlock/Commit have run — the pipelined-commit seam
+    (docs/pipeline.md).
+
+    Everything H+1 needs up to (but not including) block validation
+    and proposal construction is already determined when H is decided:
+    the H+1 validator set is ``state.next_validators`` (validator
+    updates from H only land at H+2), the chain id and vote-extension
+    schedule come from the pre-H consensus params, and the last
+    validators are H's signers.  The fields only execution can produce
+    — ``app_hash``, ``last_results_hash``, validator/param updates,
+    ``next_block_delay`` — are left at their pre-H values; the
+    pipeline barrier replaces this provisional state with the real
+    post-apply state before anything reads them (ConsensusState
+    reconciles on the apply-done handoff and rebuilds the height vote
+    set in the rare case a param update changed what the provisional
+    state baked in)."""
+    return update_state(state, block_id, block,
+                        abci.FinalizeBlockResponse(
+                            next_block_delay_ns=state.next_block_delay_ns),
+                        [])
+
+
 def update_state(state: State, block_id: BlockID, block: Block,
                  abci_response: abci.FinalizeBlockResponse,
                  validator_updates: list[Validator]) -> State:

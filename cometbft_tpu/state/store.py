@@ -341,6 +341,8 @@ def _fbr_to_proto(resp) -> dict:
             d["events"] = [event(e) for e in r.events]
         if r.codespace:
             d["codespace"] = r.codespace
+        if r.recheck_keys:
+            d["recheck_keys"] = list(r.recheck_keys)
         return d
 
     d: dict = {"next_block_delay": {}}
@@ -393,7 +395,8 @@ def _fbr_from_proto(d: dict):
             gas_wanted=r.get("gas_wanted", 0),
             gas_used=r.get("gas_used", 0),
             events=[event(e) for e in r.get("events", [])],
-            codespace=r.get("codespace", ""))
+            codespace=r.get("codespace", ""),
+            recheck_keys=list(r.get("recheck_keys", [])))
             for r in d.get("tx_results", [])],
         validator_updates=[abci_types.ValidatorUpdate(
             power=v.get("power", 0),

@@ -29,6 +29,10 @@ EXEC_TX_RESULT = Msg(
     F(6, "gas_used", "int64"),
     F(7, "events", "msg", msg=EVENT, repeated=True),
     F(8, "codespace", "string"),
+    # local extension (high tag, clear of upstream fields): app-
+    # reported state keys for incremental mempool recheck; excluded
+    # from the results hash like log/info/events
+    F(100, "recheck_keys", "bytes", repeated=True),
 )
 
 TX_RESULT = Msg(
@@ -287,6 +291,9 @@ CHECK_TX_RESPONSE = Msg(
     F(7, "events", "msg", msg=EVENT, repeated=True),
     F(8, "codespace", "string"),
     F(12, "lane_id", "string"),
+    # local extension: state keys the tx's validity depends on
+    # (incremental mempool recheck)
+    F(100, "recheck_keys", "bytes", repeated=True),
 )
 COMMIT_RESPONSE = Msg(
     "cometbft.abci.v2.CommitResponse",

@@ -12,3 +12,21 @@ class ConsensusState:
         proposal = rs.step
         await self.signer.sign(proposal)
         rs.step = proposal + 1
+
+    async def enter_step_blind_store(self, round_):
+        # strengthened rule: the store after the await is flagged even
+        # WITHOUT a load of the same attribute before it — with the
+        # commit pipeline two heights are in flight, so any
+        # post-suspension write needs re-validation (or the seam)
+        rs = self.rs
+        await self.signer.sign(round_)
+        rs.round = round_
+
+    async def stale_guard_before_await(self, height, round_):
+        # a guard BEFORE the suspension is stale by the time the store
+        # runs — re-validation must happen after the last await
+        rs = self.rs
+        if rs.round != round_:
+            return
+        await self.signer.sign(round_)
+        rs.round = round_ + 1

@@ -15,3 +15,22 @@ class ConsensusState:
         # single-writer architecture; see the baseline rationale
         # bftlint: disable=await-atomicity
         self.rs.step = step + 1
+
+    async def enter_prevote_via_seam(self, height, round_):
+        # the sanctioned mutation path: the RoundState transition seam
+        # re-validates monotonicity at the store, so a seam call after
+        # an await is not a straddle
+        rs = self.rs
+        await self.signer.sign(round_)
+        rs.advance(round_, 4)
+
+    async def lock_via_seam(self, round_):
+        rs = self.rs
+        await self.signer.sign(round_)
+        rs.lock(round_, self.block, self.parts)
+
+    async def store_before_await(self, round_):
+        # writes that precede every suspension point need no guard
+        rs = self.rs
+        rs.round = round_
+        await self.signer.sign(round_)

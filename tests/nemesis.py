@@ -99,15 +99,23 @@ class _NemesisConn:
 class NemesisNode:
     """A validator whose consensus+p2p can be hard-killed and
     restarted on its durable stores, with every link wrapped in the
-    net's fault injectors."""
+    net's fault injectors.
+
+    With ``wal_path`` set the node boots like the real node: ABCI
+    handshake reconciling app height vs store height (re-applying a
+    block a crash left between the crash-consistency barriers — the
+    window the pipelined commit widens on purpose), then WAL catchup
+    replay of the in-flight height."""
 
     def __init__(self, net: "NemesisNet", idx: int, doc: GenesisDoc,
-                 pv: MockPV, node_key: NodeKey):
+                 pv: MockPV, node_key: NodeKey,
+                 wal_path: Optional[str] = None):
         self.net = net
         self.idx = idx
         self.doc = doc
         self.pv = pv
         self.node_key = node_key
+        self.wal_path = wal_path
         self.app = KVStoreApplication()
         self.conns = AppConns(self.app)
         self.state_store = Store(MemDB())
@@ -119,6 +127,23 @@ class NemesisNode:
         self.running = False
 
     async def start(self) -> None:
+        from cometbft_tpu.consensus.replay import (
+            Handshaker, catchup_replay,
+        )
+        from cometbft_tpu.consensus.wal import WAL
+        if self.cs is not None:
+            # restart after a crash: a real process death loses the
+            # app's in-memory staging — rebuild the app from its
+            # durable db (committed state only), then let the
+            # handshake below re-apply whatever the crash left
+            # between the commit barriers (block saved / responses
+            # saved / app committed / state saved)
+            self.app = KVStoreApplication(db=self.app.db)
+            self.conns = AppConns(self.app)
+        state = self.state_store.load()
+        hs = Handshaker(self.state_store, state, self.block_store,
+                        self.doc)
+        await hs.handshake(self.conns)
         state = self.state_store.load()
         self.mempool = CListMempool(
             MempoolConfig(), self.conns.mempool, lanes=DEFAULT_LANES,
@@ -126,9 +151,12 @@ class NemesisNode:
         ex = BlockExecutor(self.state_store, self.conns.consensus,
                            mempool=self.mempool,
                            block_store=self.block_store)
+        wal = WAL(self.wal_path) if self.wal_path is not None else None
         self.cs = ConsensusState(
             _test_config().consensus, state, ex, self.block_store,
-            priv_validator=self.pv)
+            priv_validator=self.pv, wal=wal)
+        if self.wal_path is not None:
+            await catchup_replay(self.cs, self.wal_path)
         self.switch = Switch(self.node_key, self.doc.chain_id,
                              listen_addr="127.0.0.1:0")
         self.switch.conn_wrapper = self._wrap_conn
@@ -147,9 +175,11 @@ class NemesisNode:
         return _NemesisConn(conn, self.net.links, self.idx, dst)
 
     async def crash(self) -> None:
-        """Hard stop: no flush, no goodbye (in-proc analog of docker
-        kill; the stores survive)."""
-        await self.cs.stop()
+        """Hard stop: no flush, no goodbye, and an in-flight
+        pipelined apply is ABORTED, not drained (in-proc analog of
+        docker kill; the stores survive at whatever crash-consistency
+        barrier the commit reached)."""
+        await self.cs.stop(drain_pipeline=False)
         await self.switch.stop()
         self.running = False
 
@@ -160,7 +190,8 @@ class NemesisNode:
 
 class NemesisNet:
     def __init__(self, n: int = 4, seed: int = 0,
-                 fuzz_profile: Optional[dict] = None):
+                 fuzz_profile: Optional[dict] = None,
+                 wal_dir: Optional[str] = None):
         self.seed = seed
         self.rng = random.Random(seed)
         self.links = LinkTable()
@@ -178,8 +209,12 @@ class NemesisNet:
                 for pv in pvs])
         keys = [NodeKey.generate() for _ in range(n)]
         self._id_to_idx = {k.id: i for i, k in enumerate(keys)}
-        self.nodes = [NemesisNode(self, i, doc, pvs[i], keys[i])
-                      for i in range(n)]
+        import os as _os
+        self.nodes = [NemesisNode(
+            self, i, doc, pvs[i], keys[i],
+            wal_path=(_os.path.join(wal_dir, f"wal{i}")
+                      if wal_dir else None))
+            for i in range(n)]
         self._load_task: Optional[asyncio.Task] = None
         self._load_stop = asyncio.Event()
         self._tx_seq = 0
@@ -382,6 +417,10 @@ class Scenario:
     steps: tuple = ()
     recovery_blocks: int = 3
     recovery_timeout_s: float = 90.0
+    # file-backed consensus WALs + full crash recovery (handshake +
+    # catchup replay) on every restart — the pipelined-commit crash
+    # window needs the real recovery path, not just durable stores
+    use_wal: bool = False
 
 
 def archive_dir() -> str:
@@ -415,7 +454,18 @@ def _archive_flight_record(s: Scenario, exc: BaseException) -> str:
 
 
 async def run_scenario(s: Scenario) -> NemesisNet:
-    net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz)
+    import contextlib
+    import tempfile
+    wal_ctx = tempfile.TemporaryDirectory() if s.use_wal \
+        else contextlib.nullcontext(None)
+    with wal_ctx as wal_dir:
+        return await _run_scenario_inner(s, wal_dir)
+
+
+async def _run_scenario_inner(s: Scenario,
+                              wal_dir: Optional[str]) -> NemesisNet:
+    net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz,
+                     wal_dir=wal_dir)
     await net.start()
     try:
         try:

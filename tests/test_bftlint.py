@@ -125,6 +125,125 @@ def test_cli_exits_nonzero_on_each_bad_fixture():
 
 
 # ---------------------------------------------------------------------
+# await-atomicity strengthened semantics: the transition seam
+
+class TestAwaitAtomicitySeam:
+    """The pipelined-commit refactor (docs/pipeline.md) routes every
+    post-await RoundState mutation through the transition seam
+    (round_state.py) and strengthened the rule: a store after an
+    await is a finding even without a prior load of the same
+    attribute; the seam (which re-validates at the store) and
+    post-await guards are the sanctioned alternatives."""
+
+    def _lint_src(self, tmp_path, src):
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "# bftlint: path=cometbft_tpu/consensus/fx_state.py\n"
+            + src)
+        return [f for f in _lint_file(str(p))
+                if f.rule == "await-atomicity"]
+
+    def test_blind_store_after_await_fires(self, tmp_path):
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        await self.sign(r)\n"
+            "        rs.round = r\n"))
+        assert found, "store-after-await without a load must fire"
+
+    def test_seam_call_after_await_clean(self, tmp_path):
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        await self.sign(r)\n"
+            "        rs.advance(r, 4)\n"
+            "        rs.begin_round(r, self.vals)\n"
+            "        rs.reset_proposal_parts(self.psh)\n"))
+        assert not found, f"seam calls flagged: {found}"
+
+    def test_guard_must_follow_last_await(self, tmp_path):
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        if rs.round != r:\n"
+            "            return\n"
+            "        await self.sign(r)\n"
+            "        await self.sign(r)\n"
+            "        rs.round = r\n"))
+        assert found, "pre-await guard must not sanction the store"
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        await self.sign(r)\n"
+            "        if rs.round != r:\n"
+            "            return\n"
+            "        rs.round = r\n"))
+        assert not found, "post-await guard is re-validation"
+
+    def test_transition_table_matches_roundstate_api(self):
+        """The checker's seam table must name real RoundState
+        methods, and every guarded attribute must be a real
+        RoundState field — the allowlist cannot silently drift from
+        the live API."""
+        from cometbft_tpu.consensus.round_state import RoundState
+        from tools.bftlint.checkers.await_atomicity import (
+            _TRANSITION_GUARDS,
+        )
+        rs_fields = set(RoundState.__dataclass_fields__)
+        for meth, attrs in _TRANSITION_GUARDS.items():
+            assert callable(getattr(RoundState, meth, None)), \
+                f"seam method {meth!r} missing from RoundState"
+            for a in attrs:
+                assert a in rs_fields, \
+                    f"{meth} guards unknown field {a!r}"
+
+    def test_seam_call_guards_its_validated_keys(self, tmp_path):
+        """A seam call counts as re-validation for exactly the keys
+        the transition checks — a same-region direct store to one of
+        them passes, an unrelated key still fires."""
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        await self.sign(r)\n"
+            "        rs.advance(r, 4)\n"
+            "        rs.round = r\n"))     # advance re-validated round
+        assert not found, f"guarded key flagged: {found}"
+        found = self._lint_src(tmp_path, (
+            "class C:\n"
+            "    async def go(self, r):\n"
+            "        rs = self.rs\n"
+            "        await self.sign(r)\n"
+            "        rs.advance(r, 4)\n"
+            "        rs.locked_round = r\n"))
+        assert found, "advance() must not sanction locked_round"
+
+    def test_await_atomicity_baseline_ratcheted_out(self):
+        """The 4 grandfathered consensus/state.py straddles are gone
+        for good: the seam replaced them, and no await-atomicity
+        entry may ever come back (ratchet-down-only)."""
+        base = baseline_mod.load(BASELINE)
+        left = [fp for fp in base
+                if fp.startswith("await-atomicity::")]
+        assert not left, f"await-atomicity re-baselined: {left}"
+
+    def test_state_py_round_mutations_use_seam(self):
+        """consensus/state.py itself lints clean under the
+        strengthened rule with no suppressions — the tentpole's
+        single-writer claim, checked structurally."""
+        path = os.path.join(PKG, "consensus", "state.py")
+        found = [f for f in _lint_file(path,
+                                       rules={"await-atomicity"})]
+        assert not found, f"state.py straddles: {found}"
+        src = open(path).read()
+        assert "disable=await-atomicity" not in src
+
+
+# ---------------------------------------------------------------------
 # the retired AST test's invariant, carried over
 
 class TestSupervisedSpawnCarryover:

@@ -160,6 +160,193 @@ class TestMempool:
         run(go())
 
 
+class _CountingKV(KVStoreApplication):
+    """KVStore that counts CheckTx calls by type and can reject
+    rechecks of chosen txs."""
+
+    def __init__(self):
+        super().__init__()
+        self.checks = 0
+        self.rechecks = 0
+        self.reject_on_recheck: set = set()
+
+    async def check_tx(self, req):
+        if req.type == abci.CHECK_TX_TYPE_RECHECK:
+            self.rechecks += 1
+            if bytes(req.tx) in self.reject_on_recheck:
+                return abci.CheckTxResponse(code=9)
+        else:
+            self.checks += 1
+        return await super().check_tx(req)
+
+
+def _mk_incremental(app=None, **cfg_kw):
+    app = app if app is not None else _CountingKV()
+    conns = AppConns(app)
+    cfg = MempoolConfig(**cfg_kw)
+    mp = CListMempool(cfg, conns.mempool, lanes=DEFAULT_LANES,
+                      default_lane="default")
+    return mp, app
+
+
+def _committed(tx: bytes) -> abci.ExecTxResult:
+    from cometbft_tpu.abci.kvstore import tx_recheck_keys
+    return abci.ExecTxResult(code=abci.CODE_TYPE_OK,
+                             recheck_keys=tx_recheck_keys(tx))
+
+
+class TestIncrementalRecheck:
+    """Incremental recheck (docs/pipeline.md): a commit re-runs
+    CheckTx only for pooled txs whose app-reported keys overlap the
+    committed block's, plus the bounded-age watermark."""
+
+    def test_targets_only_touched_keys(self):
+        async def go():
+            mp, app = _mk_incremental()
+            for tx in (b"aa=1", b"bb=2", b"cc=3"):
+                await mp.check_tx(tx)
+            assert mp.size() == 3
+            await mp.update(1, [b"aa=9"], [_committed(b"aa=9")])
+            # only the pooled tx sharing key "aa" was rechecked
+            assert app.rechecks == 1
+            assert mp.size() == 3
+            # the rechecked entry's watermark clock was reset
+            from cometbft_tpu.types.tx import tx_key
+            for d in mp._lane_txs.values():
+                e = d.get(tx_key(b"aa=1"))
+                if e is not None:
+                    assert e.height == 1
+        run(go())
+
+    def test_watermark_bounds_staleness(self):
+        async def go():
+            mp, app = _mk_incremental(recheck_max_age_blocks=2)
+            await mp.check_tx(b"bb=2")          # validated at h 0
+            await mp.update(1, [b"zz=1"], [_committed(b"zz=1")])
+            assert app.rechecks == 0            # age 1 < 2, no overlap
+            await mp.update(2, [b"zz=2"], [_committed(b"zz=2")])
+            assert app.rechecks == 1            # age 2 hit the watermark
+            await mp.update(3, [b"zz=3"], [_committed(b"zz=3")])
+            assert app.rechecks == 1            # clock was reset to 2
+        run(go())
+
+    def test_unattributed_commit_rechecks_keyed_entries(self):
+        async def go():
+            mp, app = _mk_incremental()
+            for tx in (b"aa=1", b"bb=2"):
+                await mp.check_tx(tx)
+            # a state-changing result the app did not attribute: key
+            # targeting is unsound, every keyed entry gets rechecked
+            await mp.update(1, [b"zz=9"],
+                            [abci.ExecTxResult(code=abci.CODE_TYPE_OK)])
+            assert app.rechecks == 2
+        run(go())
+
+    def test_incremental_off_restores_full_recheck(self):
+        async def go():
+            mp, app = _mk_incremental(recheck_incremental=False)
+            for tx in (b"aa=1", b"bb=2", b"cc=3"):
+                await mp.check_tx(tx)
+            await mp.update(1, [b"zz=9"], [_committed(b"zz=9")])
+            assert app.rechecks == 3
+        run(go())
+
+    def test_recheck_evicts_invalidated_tx(self):
+        async def go():
+            mp, app = _mk_incremental()
+            await mp.check_tx(b"aa=1")
+            await mp.check_tx(b"bb=2")
+            app.reject_on_recheck.add(b"aa=1")
+            await mp.update(1, [b"aa=9"], [_committed(b"aa=9")])
+            assert mp.size() == 1
+            from cometbft_tpu.types.tx import tx_key
+            assert not mp.contains(tx_key(b"aa=1"))
+            # byte accounting stayed consistent
+            assert mp.size_bytes() == len(b"bb=2")
+            # evicted = resubmittable (not kept in cache)
+            await mp.check_tx(b"aa=1")
+            assert mp.size() == 2
+        run(go())
+
+    def test_batched_recheck_full_pass_parity(self):
+        """A large pool rechecked in gather-batches evicts exactly
+        what per-tx serial recheck would."""
+        async def go():
+            mp, app = _mk_incremental(recheck_incremental=False,
+                                      recheck_batch_size=8)
+            txs = [b"k%02dx=v" % i for i in range(30)]
+            for tx in txs:
+                await mp.check_tx(tx)
+            app.reject_on_recheck = {txs[3], txs[17], txs[29]}
+            await mp.update(1, [b"zz=9"], [_committed(b"zz=9")])
+            assert app.rechecks == 30
+            assert mp.size() == 27
+            assert mp.size_bytes() == sum(
+                len(t) for t in txs
+                if t not in app.reject_on_recheck)
+        run(go())
+
+
+class TestCheckTxCommitRace:
+    """Regression for the FinalizeBlock→recheck admission gap (the
+    mempool.py:150 note): a tx whose CheckTx was in flight when a
+    commit cycle started must be revalidated at the post-commit
+    height, never admitted on pre-block validation."""
+
+    class _GatedKV(_CountingKV):
+        def __init__(self):
+            super().__init__()
+            self.gate = asyncio.Event()
+            self.entered = asyncio.Event()
+
+        async def check_tx(self, req):
+            first = not self.gate.is_set()
+            if req.type == abci.CHECK_TX_TYPE_CHECK and first:
+                self.entered.set()
+                await self.gate.wait()
+            return await super().check_tx(req)
+
+    def test_in_flight_checktx_revalidated_by_next_update(self):
+        async def go():
+            app = self._GatedKV()
+            mp, _ = _mk_incremental(app=app)
+            task = asyncio.get_running_loop().create_task(
+                mp.check_tx(b"aa=1"))
+            await app.entered.wait()
+            # a commit cycle runs while the CheckTx is in flight
+            # (BlockExecutor.commit: lock → app commit → update) —
+            # its recheck pass cannot see the not-yet-admitted tx
+            mp.lock()
+            await mp.update(5, [b"zz=9"], [_committed(b"zz=9")])
+            mp.unlock()
+            app.gate.set()
+            await task
+            assert mp.size() == 1
+            from cometbft_tpu.types.tx import tx_key
+            # the raced admission is flagged for unconditional
+            # revalidation (no validate-retry loop: under sub-second
+            # block intervals that could chase the tip forever)
+            assert tx_key(b"aa=1") in mp._pending_recheck
+            assert mp.metrics.checktx_revalidations.value >= 1
+            # the NEXT update rechecks it even though neither key
+            # overlap nor the age watermark selects it
+            assert app.rechecks == 0
+            await mp.update(6, [b"zz=8"], [_committed(b"zz=8")])
+            assert app.rechecks == 1
+            assert not mp._pending_recheck
+            # and only once — the entry rejoins the normal schedule
+            await mp.update(7, [b"zz=7"], [_committed(b"zz=7")])
+            assert app.rechecks == 1
+        run(go())
+
+    def test_checktx_after_unlock_no_extra_roundtrip(self):
+        async def go():
+            mp, app = _mk_incremental()
+            await mp.check_tx(b"aa=1")
+            assert app.checks == 1
+        run(go())
+
+
 class TestTxCache:
     def test_lru_eviction(self):
         c = TxCache(2)

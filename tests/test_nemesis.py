@@ -85,6 +85,31 @@ class TestNemesisScenarios:
             ),
             recovery_blocks=2)))
 
+    def test_pipelined_commit_crash_reorder(self):
+        """Two heights in flight (pipelined commit, the default) under
+        reorder/duplicate link fuzz, plus a hard crash that ABORTS an
+        in-flight background apply and restarts through the real
+        recovery path (file WAL + ABCI handshake + catchup replay).
+        Safety (identical chains) and bounded recovery must hold; the
+        restarted node's replayed app hashes converge with the nodes
+        that executed serially-in-order — the WAL-replay-equals-
+        pipelined-execution half of the claim is pinned byte-exactly
+        in test_pipeline.py."""
+        run(run_scenario(Scenario(
+            name="pipelined-commit",
+            seed=17,
+            use_wal=True,
+            fuzz=dict(prob_reorder=0.06, prob_duplicate=0.06,
+                      prob_delay=0.04, max_delay_s=0.01),
+            steps=(
+                ("wait_blocks", 3),
+                ("crash", 2),
+                ("expect_progress", (0, 1, 3), 2, 60.0),
+                ("restart", 2),
+                ("wait_blocks", 2),
+            ),
+            recovery_blocks=3)))
+
     def test_mute_validator_routes_around(self):
         """Asymmetric single-node mute: node 3's frames reach nobody,
         but it still hears the net.  The other three form a quorum and

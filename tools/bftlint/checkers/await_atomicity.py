@@ -1,24 +1,38 @@
-"""await-atomicity: consensus state read-then-written across an
-``await`` needs re-validation — the asyncio analogue of a data race.
+"""await-atomicity: consensus state written after an ``await`` needs
+re-validation at the store — the asyncio analogue of a data race.
 
 asyncio removes preemption but not interleaving: every ``await`` is a
 point where another task (the timeout ticker, a supervisor restart, a
-stop-peer one-shot) can run and mutate shared state.  A method that
-reads ``self.rs.height`` before an ``await`` and writes round state
-after it, without re-checking, can apply a decision computed for a
+stop-peer one-shot) can run — and since the commit pipeline
+(docs/pipeline.md) put two heights in flight, a point where a
+background execute/commit is running concurrently with the receive
+routine.  A method that computes a decision, suspends, and then writes
+round state without re-checking can apply that decision to a
 height/round the machine has already left — exactly the class of bug
 TLA+ audits of HotStuff/Tendermint keep finding in the
 "vote-after-timeout" corner (PAPERS.md).
 
-Heuristic: inside an ``async def`` of a consensus-critical class,
-flag a *store* to a tracked attribute (``self.rs.*``, ``self.rs``,
-``self.sm_state``, ``self.height``/``round``/``step`` mirrors) when
+Heuristic (strengthened with the pipelined-commit refactor; the
+original rule only fired when the same attribute was also *loaded*
+before the await): inside an ``async def`` of a consensus-critical
+class, flag a *store* to a tracked attribute (``self.rs.*``,
+``self.rs``, ``self.sm_state``, ``height``/``round``/``step``
+mirrors) when
 
-  * the same attribute was *loaded* before an earlier ``await`` in
-    the same function, and
+  * any ``await`` precedes the store in the function, and
   * no load of that attribute appears in an ``if``/``while``/
-    ``assert`` test between that ``await`` and the store
+    ``assert`` test between the last such ``await`` and the store
     (re-validation).
+
+The sanctioned mutation path is the RoundState transition seam
+(consensus/round_state.py): ``rs.advance()``, ``rs.begin_round()``,
+``rs.lock()``, ``rs.relock()``, ``rs.set_valid()``,
+``rs.reset_proposal_parts()``, ``rs.drop_proposal_block()``,
+``rs.adopt_block()``, ``rs.enter_commit()``, ``rs.begin_height()``.
+Each transition re-validates its own precondition (monotonicity of
+(round, step), a live lock, ...) at the moment of the write, so a
+seam call after an await is exactly the guarded store this rule asks
+for — calls to ``_TRANSITION_METHODS`` are never findings.
 
 The dominant idiom in consensus/state.py is a local alias
 (``rs = self.rs``), so the checker tracks simple whole-object
@@ -26,8 +40,8 @@ aliases: after ``rs = self.rs``, loads/stores of ``rs.height`` count
 as ``rs.height`` state accesses.  Deeper aliasing (``votes =
 self.rs.votes``) is not chased — it bounds false positives, not
 false negatives.  Findings are triaged like any other rule:
-restructure, re-validate, or baseline with a justification
-explaining why the interleaving is benign.
+restructure onto the seam, re-validate, or baseline with a
+justification explaining why the interleaving is benign.
 """
 from __future__ import annotations
 
@@ -40,6 +54,26 @@ from ..core import Checker, FileContext, Finding, walk_scope
 _TRACKED_BASES = {"rs", "sm_state"}
 _TRACKED_DIRECT = {"rs", "sm_state", "height", "round", "step",
                    "locked_round", "valid_round"}
+
+# the RoundState transition seam: internally re-validating mutation
+# methods — the sanctioned way to write round state after an await.
+# Each entry maps to the attributes the method re-validates before
+# writing; a seam call therefore counts as a guard for exactly those
+# keys (tests/test_bftlint.py pins this table against the live
+# RoundState API so it cannot silently drift).
+_TRANSITION_GUARDS: dict[str, tuple[str, ...]] = {
+    "advance": ("round", "step"),
+    "begin_round": ("round", "step"),
+    "begin_height": ("height", "round", "step"),
+    "enter_commit": ("step", "commit_round"),
+    "lock": ("locked_round",),
+    "relock": ("locked_round",),
+    "set_valid": ("valid_round",),
+    "reset_proposal_parts": (),
+    "drop_proposal_block": (),
+    "adopt_block": (),
+}
+_TRANSITION_METHODS = frozenset(_TRANSITION_GUARDS)
 
 
 def _attr_key(node: ast.AST,
@@ -85,8 +119,9 @@ def _pos(node: ast.AST) -> tuple[int, int]:
 
 class AwaitAtomicityChecker(Checker):
     rule = "await-atomicity"
-    description = ("consensus state read before an await and written "
-                   "after it without re-validation")
+    description = ("consensus state written after an await without "
+                   "re-validation (use the RoundState transition "
+                   "seam or re-check before the store)")
     scope = ("cometbft_tpu/consensus/*",)
 
     def check(self, ctx: FileContext) -> Iterator[Finding]:
@@ -96,7 +131,6 @@ class AwaitAtomicityChecker(Checker):
     def _check_fn(self, ctx: FileContext,
                   fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
         aliases = _collect_aliases(fn)
-        loads: list[tuple[tuple[int, int], str]] = []
         stores: list[tuple[tuple[int, int], str, ast.AST]] = []
         awaits: list[tuple[int, int]] = []
         guards: list[tuple[tuple[int, int], str]] = []
@@ -107,6 +141,23 @@ class AwaitAtomicityChecker(Checker):
         for node in walk_scope(fn):
             if isinstance(node, ast.Await):
                 awaits.append(_pos(node))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _TRANSITION_GUARDS:
+                # a transition-seam call re-validates the listed keys
+                # at the write — it counts as a guard for them
+                base_key = _attr_key(node.func.value, aliases) \
+                    if isinstance(node.func.value, ast.Attribute) \
+                    else (aliases.get(node.func.value.id)
+                          if isinstance(node.func.value, ast.Name) and
+                          aliases else None)
+                if isinstance(node.func.value, ast.Name) and \
+                        node.func.value.id == "self":
+                    base_key = None       # self.advance() is not rs
+                if base_key in _TRACKED_BASES:
+                    for attr in _TRANSITION_GUARDS[node.func.attr]:
+                        guards.append((_pos(node),
+                                       f"{base_key}.{attr}"))
             elif isinstance(node, (ast.If, ast.While, ast.Assert)):
                 test = node.test
                 for sub in ast.walk(test):
@@ -118,9 +169,7 @@ class AwaitAtomicityChecker(Checker):
                 key = _attr_key(node, aliases)
                 if key is None:
                     continue
-                if isinstance(node.ctx, ast.Load):
-                    loads.append((_pos(node), key))
-                elif isinstance(node.ctx, ast.Store):
+                if isinstance(node.ctx, ast.Store):
                     stores.append((_pos(node), key, node))
         if not awaits or not stores:
             return
@@ -129,18 +178,17 @@ class AwaitAtomicityChecker(Checker):
         for spos, key, node in sorted(stores, key=lambda t: t[0]):
             if key in flagged:
                 continue
-            # earliest await that both follows a load of `key` and
-            # precedes this store
+            # the LAST await before this store: the store must be
+            # re-validated after the final suspension, not before it
             straddle = None
             for apos in awaits:
-                if apos < spos and any(
-                        lpos < apos for lpos, k in loads
-                        if k == key):
+                if apos < spos:
                     straddle = apos
+                else:
                     break
             if straddle is None:
                 continue
-            # a guard re-reading `key` between the await and the
+            # a guard re-reading `key` between that await and the
             # store counts as re-validation
             if any(straddle <= gpos <= spos for gpos, k in guards
                    if k == key):
@@ -148,10 +196,11 @@ class AwaitAtomicityChecker(Checker):
             flagged.add(key)
             yield ctx.finding(
                 self.rule, node,
-                f"self.{key} was read before an await (line "
-                f"{straddle[0]}) and is written here without "
-                f"re-validating — another task (timeout ticker, "
+                f"self.{key} is written after an await (line "
+                f"{straddle[0]}) without re-validation — another "
+                f"task (timeout ticker, pipelined apply completion, "
                 f"stop-peer one-shot) may have advanced the round "
-                f"state across that suspension; re-check "
-                f"height/round/step after the await or restructure "
-                f"to avoid the straddle")
+                f"state across that suspension; route the mutation "
+                f"through the RoundState transition seam "
+                f"(round_state.py) or re-check height/round/step "
+                f"between the await and the store")

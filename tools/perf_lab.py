@@ -372,6 +372,174 @@ def bench_rpc_cache_hit(fast: bool):
     return stats
 
 
+def bench_mempool_incremental_recheck(fast: bool):
+    """ISSUE 10: a 512-tx pool absorbing a commit that touched 16
+    keys.  Gates the incremental ``update()`` pass (remove + slice +
+    batched recheck); the full-pool recheck of the same commit rides
+    along as ``full_min_ms`` — the before/after of the 10 tx/s wall
+    (QA_r05's collapse was recheck-bound: every commit re-ran CheckTx
+    for thousands of pooled txs)."""
+    import asyncio
+
+    from cometbft_tpu.abci import types as abci_t
+    from cometbft_tpu.abci.client import AppConns
+    from cometbft_tpu.abci.kvstore import (
+        DEFAULT_LANES, KVStoreApplication, tx_recheck_keys,
+    )
+    from cometbft_tpu.config import MempoolConfig
+    from cometbft_tpu.mempool import CListMempool
+
+    n_pool, n_touch = 512, 16
+
+    async def run_once(incremental: bool) -> float:
+        app = KVStoreApplication()
+        conns = AppConns(app)
+        mp = CListMempool(
+            MempoolConfig(size=2 * n_pool,
+                          recheck_incremental=incremental),
+            conns.mempool, lanes=DEFAULT_LANES,
+            default_lane="default")
+        for i in range(n_pool):
+            await mp.check_tx(b"pk%04dx=v" % i)
+        committed = [b"pk%04dx=z" % i for i in range(n_touch)]
+        results = [abci_t.ExecTxResult(
+            code=abci_t.CODE_TYPE_OK,
+            recheck_keys=tx_recheck_keys(t)) for t in committed]
+        t0 = time.perf_counter()
+        await mp.update(1, committed, results)
+        return time.perf_counter() - t0
+
+    reps = 3 if fast else 6
+    inc = sorted(asyncio.run(run_once(True))
+                 for _ in range(reps + 1))[:reps]
+    full = sorted(asyncio.run(run_once(False))
+                  for _ in range(max(2, reps - 1) + 1))[
+                      :max(2, reps - 1)]
+    return {
+        "p50_ms": round(statistics.median(inc) * 1e3, 6),
+        "min_ms": round(inc[0] * 1e3, 6),
+        "mean_ms": round(statistics.fmean(inc) * 1e3, 6),
+        "full_min_ms": round(full[0] * 1e3, 6),
+        "pool": n_pool,
+        "touched": n_touch,
+        "reps": reps,
+        "inner": 1,
+    }
+
+
+def bench_height_pipeline_overlap(fast: bool):
+    """ISSUE 10: wall-clock for a wired 2-validator in-process net to
+    commit 4 heights with a 10 ms-FinalizeBlock app and a loaded
+    mempool.  Gates the pipelined path (commit/propose overlap +
+    incremental recheck); the serial path (pipeline_commit=False,
+    full recheck) rides along as ``serial_min_ms``."""
+    import asyncio
+
+    from cometbft_tpu.abci.client import AppConns
+    from cometbft_tpu.abci.kvstore import (
+        DEFAULT_LANES, KVStoreApplication,
+    )
+    from cometbft_tpu.config import MempoolConfig
+    from cometbft_tpu.config import test_config as _test_config
+    from cometbft_tpu.consensus.messages import (
+        BlockPartMessage, ProposalMessage, VoteMessage,
+    )
+    from cometbft_tpu.consensus.state import ConsensusState
+    from cometbft_tpu.crypto import batch as crypto_batch
+    from cometbft_tpu.db import MemDB
+    from cometbft_tpu.mempool import CListMempool
+    from cometbft_tpu.state import make_genesis_state
+    from cometbft_tpu.state.execution import BlockExecutor
+    from cometbft_tpu.state.store import Store
+    from cometbft_tpu.store import BlockStore
+    from cometbft_tpu.types.genesis import (
+        GenesisDoc, GenesisValidator,
+    )
+    from cometbft_tpu.types.priv_validator import new_mock_pv
+    from cometbft_tpu.types.timestamp import Timestamp
+
+    gossip = (ProposalMessage, BlockPartMessage, VoteMessage)
+    heights = 4
+
+    async def run_once(pipeline: bool) -> float:
+        crypto_batch.set_backend("cpu")
+        pvs = [new_mock_pv() for _ in range(2)]
+        doc = GenesisDoc(
+            chain_id="perf-pipeline",
+            genesis_time=Timestamp(1700000000, 0),
+            validators=[GenesisValidator(
+                address=b"", pub_key=pv.get_pub_key(), power=10)
+                for pv in pvs])
+        # small blocks so the preloaded pool stays occupied across
+        # every height — the serial path then pays its full-pool
+        # recheck inside the commit critical path each block, which
+        # is exactly the cost the pipeline + incremental recheck
+        # take off it
+        doc.consensus_params.block.max_bytes = 2048
+        doc.consensus_params.evidence.max_bytes = 1024
+        nodes, pools = [], []
+        for pv in pvs:
+            state = make_genesis_state(doc)
+            app = KVStoreApplication()
+            app.abci_delays = {"finalize_block": 0.01}
+            conns = AppConns(app)
+            ss, bs = Store(MemDB()), BlockStore(MemDB())
+            ss.save(state)
+            mp = CListMempool(
+                MempoolConfig(size=4096,
+                              recheck_incremental=pipeline),
+                conns.mempool, lanes=DEFAULT_LANES,
+                default_lane="default")
+            ex = BlockExecutor(ss, conns.consensus, mempool=mp,
+                               block_store=bs)
+            cfg = _test_config().consensus
+            cfg.pipeline_commit = pipeline
+            nodes.append(ConsensusState(cfg, state, ex, bs,
+                                        priv_validator=pv))
+            pools.append(mp)
+        for i, cs in enumerate(nodes):
+            def mk(idx):
+                def hook(msg):
+                    if isinstance(msg, gossip):
+                        for j, other in enumerate(nodes):
+                            if j != idx:
+                                other.send_peer(msg, f"n{idx}")
+                return hook
+            cs.broadcast_hooks.append(mk(i))
+        for mp in pools:
+            for i in range(768):
+                await mp.check_tx(b"ld%04dx=v" % i)
+        t0 = time.perf_counter()
+        for cs in nodes:
+            await cs.start()
+        try:
+            while min(cs.block_store.height for cs in nodes) \
+                    < heights:
+                if time.perf_counter() - t0 > 60:
+                    raise RuntimeError("pipeline bench net stuck")
+                await asyncio.sleep(0.005)
+            return time.perf_counter() - t0
+        finally:
+            for cs in nodes:
+                await cs.stop()
+            crypto_batch.set_backend("auto")
+
+    reps = 2 if fast else 4
+    piped = sorted(asyncio.run(run_once(True))
+                   for _ in range(reps + 1))[:reps]
+    serial = sorted(asyncio.run(run_once(False))
+                    for _ in range(2 + 1))[:2]
+    return {
+        "p50_ms": round(statistics.median(piped) * 1e3, 6),
+        "min_ms": round(piped[0] * 1e3, 6),
+        "mean_ms": round(statistics.fmean(piped) * 1e3, 6),
+        "serial_min_ms": round(serial[0] * 1e3, 6),
+        "heights": heights,
+        "reps": reps,
+        "inner": 1,
+    }
+
+
 def bench_bftlint_selfcheck(fast: bool):
     from tools.bftlint import lint_paths
     from tools.bftlint.checkers import ALL_CHECKERS
@@ -400,6 +568,9 @@ BENCHMARKS = {
     "multiproof_verify": (bench_multiproof_verify, True),
     "proofs_verify_256": (bench_proofs_verify_256, True),
     "rpc_cache_hit": (bench_rpc_cache_hit, True),
+    "mempool_incremental_recheck": (
+        bench_mempool_incremental_recheck, True),
+    "height_pipeline_overlap": (bench_height_pipeline_overlap, True),
     "bftlint_selfcheck": (bench_bftlint_selfcheck, True),
 }
 
