@@ -30,6 +30,16 @@ def cmd_init(args) -> int:
 
 def cmd_start(args) -> int:
     from ..node import Node
+    # live-stack debugging for a wedged/starved node: SIGUSR1 dumps
+    # every thread's Python stack to stderr without killing the
+    # process (faulthandler is async-signal-safe, so this works even
+    # when the event loop is livelocked and RPC cannot answer)
+    import faulthandler
+    import signal
+    try:
+        faulthandler.register(signal.SIGUSR1)
+    except (AttributeError, ValueError, OSError):
+        pass   # platform without SIGUSR1 / non-main thread
     cfg = _load_config(args.home)
     if args.proxy_app:
         cfg.base.proxy_app = args.proxy_app

@@ -127,6 +127,28 @@ class MempoolConfig:
     # async socket client pipelines them; the local client serializes
     # on its own lock, so this only bounds gather fan-out)
     recheck_batch_size: int = 64
+    # have/want set-reconciliation gossip (docs/gossip.md): instead of
+    # flooding raw txs, advertise short salted tx-hash summaries
+    # (TxHave), let peers pull only what they miss (TxWant -> Txs).
+    # Handshake-negotiated per link ("txrecon/1"); a peer that does
+    # not advertise the capability gets the flood path unchanged.
+    gossip_reconciliation: bool = True
+    # max short ids per TxHave/TxWant message (bounds message size:
+    # 256 ids = 2 KiB of summary for up to 256 txs)
+    recon_advert_max_ids: int = 256
+    # how long a pulled tx may stay in flight before the want is
+    # re-issued to another peer that advertised it
+    recon_want_timeout_ns: int = 1 * _S
+    # brand-new LOCAL txs (RPC submissions, no gossip sender) are
+    # pushed in full to ~this many peers immediately so first-hop
+    # latency does not pay an advertise/pull round trip; everyone
+    # else learns of them via summaries
+    recon_push_peers: int = 2
+    # heights per reconciliation salt epoch: the short-hash salt
+    # derives from the epoch index, so all nodes near the same height
+    # agree on it (summaries stay comparable across peers) while
+    # rotation still bounds the lifetime of any engineered collision
+    recon_salt_epoch_blocks: int = 16
 
 
 @dataclass
@@ -177,6 +199,19 @@ class ConsensusConfig:
     adaptive_timeouts: bool = False
     adaptive_timeout_floor_ns: int = 200 * _MS
     adaptive_timeout_ceiling_ns: int = 10 * _S
+    # compact-block proposal relay (docs/gossip.md): gossip a decided
+    # proposal as header skeleton + ordered tx hashes; receivers
+    # rebuild the part set from their mempool and fall back to full
+    # BlockPartMessage gossip for anything they cannot resolve.
+    # Handshake-negotiated per link ("compactblocks/1").
+    compact_blocks: bool = True
+    # after sending a peer the compact form, how long to hold off
+    # pushing full parts at it (the reconstruct-or-fallback window)
+    compact_block_grace_ns: int = 250 * _MS
+    # coalesce up to this many missing votes per wire message on the
+    # vote channel for peers that negotiated "votebatch/1"
+    # (0 = always single-vote messages)
+    vote_batch_max: int = 16
 
     def propose_timeout_ns(self, round_: int) -> int:
         return self.timeout_propose_ns + \
@@ -313,6 +348,24 @@ def validate_basic(cfg: Config) -> None:
     if cfg.mempool.recheck_batch_size <= 0:
         raise ConfigError(
             "mempool.recheck_batch_size must be positive")
+    if cfg.mempool.recon_advert_max_ids <= 0:
+        raise ConfigError(
+            "mempool.recon_advert_max_ids must be positive")
+    if cfg.mempool.recon_want_timeout_ns <= 0:
+        raise ConfigError(
+            "mempool.recon_want_timeout must be positive")
+    if cfg.mempool.recon_push_peers < 0:
+        raise ConfigError(
+            "mempool.recon_push_peers cannot be negative")
+    if cfg.mempool.recon_salt_epoch_blocks <= 0:
+        raise ConfigError(
+            "mempool.recon_salt_epoch_blocks must be positive")
+    if cfg.consensus.compact_block_grace_ns < 0:
+        raise ConfigError(
+            "consensus.compact_block_grace cannot be negative")
+    if cfg.consensus.vote_batch_max < 0:
+        raise ConfigError(
+            "consensus.vote_batch_max cannot be negative")
     if cfg.tx_index.indexer not in ("kv", "psql", "null"):
         raise ConfigError(
             f"tx_index.indexer must be kv|psql|null, "

@@ -146,6 +146,83 @@ class HasProposalBlockPartMessage:
     TYPE = "has_proposal_block_part"
 
 
+FEATURE_COMPACT_BLOCKS = "compactblocks/1"
+FEATURE_VOTE_BATCH = "votebatch/1"
+
+# below this many txs the compact form saves almost nothing over the
+# single part it replaces, and the reconstruct round trip only adds
+# latency risk — small proposals always go out as full parts
+COMPACT_MIN_TXS = 8
+
+
+@dataclass
+class CompactBlockPartMessage:
+    """The whole proposal as skeleton + ordered tx hashes
+    (docs/gossip.md): ``skeleton`` is the block's canonical proto
+    encoding with ``data.txs`` emptied, ``tx_hashes`` the
+    concatenated 32-byte tx keys in block order.  A receiver that
+    holds every tx rebuilds the byte-identical part set
+    (``reconstruct_block_bytes``) and never needs the full
+    BlockPartMessages; one that doesn't falls back to the existing
+    part gossip.  Never written to the WAL — the reconstructed parts
+    are fed through the normal BlockPartMessage path, so replay sees
+    exactly what a full-part peer would have logged."""
+    height: int
+    round: int
+    part_set_header: object        # PartSetHeader
+    skeleton: bytes
+    tx_hashes: list                # list[bytes], 32 bytes each
+
+    TYPE = "compact_block"
+
+
+@dataclass
+class CompactBlockNackMessage:
+    """Receiver-driven fallback: reconstruction failed (missing txs,
+    header mismatch), cancel the grace window and push full parts
+    immediately."""
+    height: int
+    round: int
+
+    TYPE = "compact_block_nack"
+
+
+@dataclass
+class VoteBatchMessage:
+    votes: list                    # list[Vote]
+
+    TYPE = "vote_batch"
+
+
+def make_compact_block(height: int, round_: int, block,
+                       part_set_header) -> CompactBlockPartMessage:
+    """Build the compact form from a complete proposal block."""
+    from ..types.tx import tx_key
+    d = block.to_proto()
+    data = dict(d.get("data") or {})
+    data.pop("txs", None)
+    d["data"] = data
+    from ..wire import pb, encode
+    return CompactBlockPartMessage(
+        height=height, round=round_,
+        part_set_header=part_set_header,
+        skeleton=encode(pb.BLOCK, d),
+        tx_hashes=[tx_key(tx) for tx in block.data.txs])
+
+
+def reconstruct_block_bytes(skeleton: bytes, txs: list) -> bytes:
+    """Splice resolved txs back into the skeleton and re-encode.
+    The wire codec is canonical (ascending field order, proto3 zero
+    omission), so the result is byte-identical to the proposer's
+    ``Block.make_part_set`` input whenever the txs match."""
+    from ..wire import pb, decode, encode
+    d = decode(pb.BLOCK, skeleton)
+    data = dict(d.get("data") or {})
+    data["txs"] = list(txs)
+    d["data"] = data
+    return encode(pb.BLOCK, d)
+
+
 def message_from_wal(d: dict):
     """Decode a WAL msg record back into a message object."""
     t = d.get("type")
@@ -229,6 +306,20 @@ def encode_p2p(msg) -> bytes:
             **({"height": msg.height} if msg.height else {}),
             **({"round": msg.round} if msg.round else {}),
             **({"index": msg.index} if msg.index else {})}}
+    elif isinstance(msg, CompactBlockPartMessage):
+        d = {"compact_block": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {}),
+            "part_set_header": msg.part_set_header.to_proto(),
+            "skeleton": msg.skeleton,
+            "tx_hashes": b"".join(msg.tx_hashes)}}
+    elif isinstance(msg, CompactBlockNackMessage):
+        d = {"compact_block_nack": {
+            **({"height": msg.height} if msg.height else {}),
+            **({"round": msg.round} if msg.round else {})}}
+    elif isinstance(msg, VoteBatchMessage):
+        d = {"vote_batch": {
+            "votes": [v.to_proto() for v in msg.votes]}}
     else:
         raise ValueError(f"cannot encode message {type(msg)}")
     return encode(consensus_pb.MESSAGE, d)
@@ -300,4 +391,22 @@ def decode_p2p(raw: bytes):
         return HasProposalBlockPartMessage(
             height=n.get("height", 0), round=n.get("round", 0),
             index=n.get("index", 0))
+    if "compact_block" in d:
+        n = d["compact_block"]
+        blob = n.get("tx_hashes", b"")
+        return CompactBlockPartMessage(
+            height=n.get("height", 0), round=n.get("round", 0),
+            part_set_header=PartSetHeader.from_proto(
+                n.get("part_set_header") or {}),
+            skeleton=n.get("skeleton", b""),
+            tx_hashes=[blob[i:i + 32]
+                       for i in range(0, len(blob) - 31, 32)])
+    if "compact_block_nack" in d:
+        n = d["compact_block_nack"]
+        return CompactBlockNackMessage(height=n.get("height", 0),
+                                       round=n.get("round", 0))
+    if "vote_batch" in d:
+        return VoteBatchMessage(
+            votes=[Vote.from_proto(v)
+                   for v in d["vote_batch"].get("votes", [])])
     raise ValueError(f"unknown consensus message {sorted(d)}")

@@ -81,6 +81,27 @@ class Metrics:
             "by whether the part was relevant to the block the node "
             "is trying to gather or not.",
             labels=("matches_current",))
+        # compact-block proposal relay (docs/gossip.md)
+        self.compact_blocks_sent = m.counter(
+            "consensus", "compact_blocks_sent",
+            "Compact proposals (skeleton + tx hashes) sent to "
+            "negotiated peers instead of full parts.")
+        self.compact_blocks_reconstructed = m.counter(
+            "consensus", "compact_blocks_reconstructed",
+            "Compact proposals fully rebuilt from the local mempool "
+            "— no full block parts needed.")
+        self.compact_block_misses = m.counter(
+            "consensus", "compact_block_misses",
+            "Compact proposals with at least one tx hash the local "
+            "mempool could not resolve (fell back to full parts).")
+        self.compact_block_mismatches = m.counter(
+            "consensus", "compact_block_mismatches",
+            "Compact proposals whose reconstructed part set did not "
+            "match the advertised part-set header.")
+        self.vote_batches_sent = m.counter(
+            "consensus", "vote_batches_sent",
+            "Coalesced vote messages sent on the vote channel "
+            "(votebatch/1 links).")
         self.quorum_prevote_delay = m.gauge(
             "consensus", "quorum_prevote_delay",
             "Interval in seconds between the proposal timestamp and "

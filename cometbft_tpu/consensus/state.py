@@ -38,7 +38,9 @@ from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
 from ..wire import pb, decode
 from .height_vote_set import HeightVoteSet, HeightVoteSetError
 from .messages import (
-    BlockPartMessage, ProposalMessage, VoteMessage,
+    COMPACT_MIN_TXS, BlockPartMessage, CompactBlockPartMessage,
+    ProposalMessage, VoteBatchMessage, VoteMessage,
+    reconstruct_block_bytes,
 )
 from .adaptive import AdaptiveTimeouts
 from .round_state import (
@@ -238,7 +240,26 @@ class ConsensusState:
     # AddProposalBlockPart — enqueue into peer/internal queues)
 
     def send_internal(self, msg, peer_id: str = "") -> None:
-        self._input_queue.put_nowait(("internal", msg, peer_id))
+        item = ("internal", msg, peer_id)
+        try:
+            self._input_queue.put_nowait(item)
+        except asyncio.QueueFull:
+            # overload (e.g. a 900-height catchup storm filling the
+            # queue with peer messages): our OWN vote/proposal must
+            # never crash the receive routine — and since that
+            # routine IS the consumer, blocking here would deadlock.
+            # Defer the put to a supervised task; the state machine
+            # re-validates on delivery, so the slight reordering is
+            # benign (the nemesis catchup scenario caught the old
+            # put_nowait crash wedging a node for good).
+            self.logger.info(
+                "consensus input queue full; deferring internal "
+                "message", msg_type=type(msg).__name__)
+            if self.supervisor is not None:
+                self.supervisor.spawn(
+                    lambda: self._input_queue.put(item),
+                    name="internal_requeue",
+                    kind="consensus_internal_requeue")
 
     def send_peer(self, msg, peer_id: str) -> None:
         self._input_queue.put_nowait(("peer", msg, peer_id))
@@ -344,6 +365,28 @@ class ConsensusState:
                 "(serial tally will report it)", exc_info=True)
 
     async def _handle_msg(self, msg, peer_id: str, internal: bool) -> None:
+        # a vote batch unpacks into individual VoteMessages (each
+        # WAL'd exactly as an unbatched peer would have logged it);
+        # the batch rides the input queue as ONE entry so wire-level
+        # backpressure is preserved
+        if isinstance(msg, VoteBatchMessage):
+            for v in msg.votes:
+                await self._handle_msg(VoteMessage(v), peer_id,
+                                       internal=internal)
+            return
+
+        # the compact form is never WAL'd: reconstruction feeds the
+        # rebuilt parts through the normal BlockPartMessage path
+        # below, so the WAL records exactly what a full-part peer
+        # would have logged and replay needs no mempool
+        if isinstance(msg, CompactBlockPartMessage):
+            try:
+                await self._apply_compact_block(msg, peer_id)
+            except (PartSetError, ConsensusError) as e:
+                self.logger.error("failed to apply compact block",
+                                  err=str(e), peer=peer_id)
+            return
+
         # WAL-before-process (reference: state.go:886 handleMsg; internal
         # messages are fsync'd — they may carry our own signatures).
         # During catchup replay the messages are already in the WAL.
@@ -749,6 +792,18 @@ class ConsensusState:
                 height=rs.height, round=rs.round,
                 part=block_parts.get_part(i)))
         self._broadcast(ProposalMessage(proposal))
+        # compact-block relay (docs/gossip.md): peers that negotiated
+        # it get skeleton + tx hashes and rebuild the parts from
+        # their mempool; the part broadcasts below skip them for the
+        # grace window, falling back to full parts on a nack or when
+        # the grace expires.  Small blocks always ship as parts, and
+        # so does every round > 0: a churning round means the fast
+        # path already failed once — full parts, no reconstruct race
+        # (the recon-gossip nemesis scenario wedged on exactly that
+        # under aggressive timeouts).
+        if rs.round == 0 and len(block.data.txs) >= COMPACT_MIN_TXS:
+            self._broadcast(("compact_block", rs.height, rs.round,
+                             block, block_parts.header()))
         for i in range(block_parts.total):
             self._broadcast(BlockPartMessage(
                 height=rs.height, round=rs.round,
@@ -882,6 +937,93 @@ class ConsensusState:
             self.event_bus.publish_complete_proposal(rs.event_summary())
             await self._handle_complete_proposal(msg.height)
         return added
+
+    async def _apply_compact_block(self, msg: CompactBlockPartMessage,
+                                   peer_id: str) -> bool:
+        """Rebuild the proposal's part set from the local mempool
+        (docs/gossip.md).  All-or-nothing: any unresolved tx hash (or
+        a skeleton that doesn't re-encode to the advertised part-set
+        header) falls back to the existing full-part gossip — the
+        sender resumes pushing parts once its grace window expires.
+        Safety does not rest on the sender: every rebuilt part goes
+        through ``_add_proposal_block_part``, whose merkle proofs
+        verify against the proposal's own part-set header."""
+        rs = self.rs
+
+        def nack() -> bool:
+            # receiver-driven fallback: tell the sender to cancel its
+            # grace window and push full parts NOW — waiting out the
+            # grace timer can outlive a whole round under aggressive
+            # timeouts (the wedge the recon-gossip nemesis scenario
+            # caught on its first run)
+            self._broadcast(("compact_nack", msg.height, msg.round,
+                             peer_id))
+            return False
+
+        if rs.height != msg.height:
+            return False            # stale height: ignore silently
+        if rs.round != msg.round:
+            # same height, different round (we churned past, or the
+            # compact outran the round-step gossip): reconstruction
+            # is moot but the sender must still stop holding parts
+            # back — nack so the fallback engages immediately
+            return nack()
+        parts = rs.proposal_block_parts
+        if parts is None:
+            return nack()           # reordered ahead of the proposal
+        if parts.is_complete():
+            return False            # nothing to do
+        if parts.header() != msg.part_set_header:
+            self.metrics.compact_block_mismatches.add()
+            return nack()
+        mempool = getattr(self.block_exec, "mempool", None)
+        if mempool is None:
+            return nack()
+        txs = []
+        missing = 0
+        for h in msg.tx_hashes:
+            tx = mempool.get_tx_by_hash(h)
+            if tx is None:
+                missing += 1
+            else:
+                txs.append(tx)
+        if missing:
+            self.metrics.compact_block_misses.add()
+            tracing.instant(tracing.CONSENSUS, "compact_block_miss",
+                            height=msg.height, missing=missing,
+                            total=len(msg.tx_hashes))
+            return nack()
+        try:
+            rebuilt = PartSet.from_data(
+                reconstruct_block_bytes(msg.skeleton, txs))
+        except Exception as e:
+            self.metrics.compact_block_mismatches.add()
+            self.logger.info("compact block reconstruct failed",
+                             err=str(e), peer=peer_id)
+            return nack()
+        if rebuilt.header() != msg.part_set_header:
+            # non-canonical skeleton or diverging txs: the advertised
+            # header cannot be rebuilt — full parts must flow
+            self.metrics.compact_block_mismatches.add()
+            return nack()
+        self.metrics.compact_blocks_reconstructed.add()
+        tracing.instant(tracing.CONSENSUS, "compact_block_rebuilt",
+                        height=msg.height, parts=rebuilt.total,
+                        num_txs=len(txs))
+        for i in range(rebuilt.total):
+            pm = BlockPartMessage(height=msg.height, round=msg.round,
+                                  part=rebuilt.get_part(i))
+            if not self.replay_mode:
+                self.wal.write(pm.to_wal())
+            await self._add_proposal_block_part(pm, peer_id)
+        if self.rs.height == msg.height and \
+                self.rs.proposal_block_parts is not None and \
+                self.rs.proposal_block_parts.is_complete():
+            # tell every peer we hold the full block so nobody pushes
+            # parts at us (reference: NewValidBlock re-announce)
+            self._broadcast(("valid_block",))
+            return True
+        return False
 
     async def _handle_complete_proposal(self, height: int) -> None:
         """Reference: handleCompleteProposal (:2217)."""

@@ -57,6 +57,17 @@ class Metrics:
             "p2p", "send_queue_drops",
             "Number of messages dropped by TrySend on a full "
             "per-channel send queue.", labels=("chID",))
+        # bytes-useful vs bytes-sent per channel (docs/gossip.md):
+        # reactors credit payload bytes that carried NOVEL content
+        # (a tx the pool admitted, a block part the part set lacked,
+        # a vote the peer-state bitmap lacked); the ratio against
+        # message_send/receive_bytes_total is the redundancy of each
+        # gossip plane
+        self.message_useful_bytes_total = m.counter(
+            "p2p", "message_useful_bytes_total",
+            "Received bytes whose payload was novel to this node, "
+            "credited per channel by the owning reactor.",
+            labels=("chID",))
 
     def touch_channel(self, ch_id: str) -> None:
         """Materialize the per-channel series at connection setup so
@@ -66,3 +77,4 @@ class Metrics:
         self.message_send_size_bytes.with_labels(ch_id)
         self.message_recv_size_bytes.with_labels(ch_id)
         self.queue_stall_seconds.with_labels(ch_id)
+        self.message_useful_bytes_total.with_labels(ch_id)

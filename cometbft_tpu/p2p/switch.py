@@ -38,6 +38,13 @@ class NodeInfo:
     moniker: str = "anonymous"
     block_version: int = _version.BLOCK_PROTOCOL
     p2p_version: int = _version.P2P_PROTOCOL
+    # optional protocol capabilities (e.g. "txrecon/1",
+    # "compactblocks/1", "votebatch/1"): purely additive negotiation —
+    # a capability is USED on a link only when both sides advertise
+    # it, and a peer that sends none (an older build) gets the
+    # pre-capability wire behavior (flood gossip, full block parts,
+    # single-vote messages).  Never part of compatible_with.
+    features: tuple = ()
 
     def to_json(self) -> bytes:
         return json.dumps({
@@ -46,6 +53,7 @@ class NodeInfo:
             "channels": self.channels.hex(), "moniker": self.moniker,
             "block_version": self.block_version,
             "p2p_version": self.p2p_version,
+            "features": list(self.features),
         }).encode()
 
     @classmethod
@@ -58,7 +66,8 @@ class NodeInfo:
                    channels=bytes.fromhex(d.get("channels", "")),
                    moniker=d.get("moniker", ""),
                    block_version=d.get("block_version", 0),
-                   p2p_version=d.get("p2p_version", 0))
+                   p2p_version=d.get("p2p_version", 0),
+                   features=tuple(d.get("features", ())))
 
     def compatible_with(self, other: "NodeInfo") -> Optional[str]:
         """None when compatible, else the reason (reference:
@@ -87,6 +96,10 @@ class Peer:
     @property
     def id(self) -> str:
         return self.node_info.node_id
+
+    def has_feature(self, name: str) -> bool:
+        """Did the peer advertise this capability at handshake?"""
+        return name in self.node_info.features
 
     def send(self, channel_id: int, msg: bytes) -> bool:
         return self.mconn.send(channel_id, msg)
@@ -124,6 +137,11 @@ class Reactor:
         return self._own_supervisor
 
     def get_channels(self) -> list[ChannelDescriptor]:
+        return []
+
+    def get_features(self) -> list[str]:
+        """Capability strings this reactor wants advertised in the
+        handshake NodeInfo (config-gated; see NodeInfo.features)."""
         return []
 
     async def add_peer(self, peer: Peer) -> None:
@@ -193,12 +211,16 @@ class Switch:
         reactor.switch = self
 
     def node_info(self) -> NodeInfo:
+        feats: set[str] = set()
+        for reactor in self.reactors.values():
+            feats.update(reactor.get_features())
         return NodeInfo(
             node_id=self.node_key.id,
             listen_addr=self.listen_addr,
             network=self.network,
             channels=bytes(sorted(self._chan_to_reactor)),
             moniker=self.moniker,
+            features=tuple(sorted(feats)),
         )
 
     # ------------------------------------------------------------------

@@ -86,6 +86,37 @@ HAS_PROPOSAL_BLOCK_PART = Msg(
     F(3, "index", "int32"),
 )
 
+# compact-block proposal relay (docs/gossip.md): the proposal as the
+# block's proto bytes WITHOUT data.txs plus the ordered full tx
+# hashes; receivers splice txs from their mempool, re-encode (the
+# codec is canonical) and rebuild the identical part set.  Negotiated
+# via the "compactblocks/1" handshake capability.
+COMPACT_BLOCK = Msg(
+    "cometbft.consensus.v2.CompactBlock",
+    F(1, "height", "int64"),
+    F(2, "round", "int32"),
+    F(3, "part_set_header", "msg", msg=PART_SET_HEADER, always=True),
+    F(4, "skeleton", "bytes"),
+    F(5, "tx_hashes", "bytes"),     # n * 32 bytes, block order
+)
+
+# receiver-driven fallback: "I could not rebuild your compact
+# proposal — send full parts now".  Cancels the sender's grace
+# window; without it a miss only falls back after the grace timer,
+# which can outlive a whole round under aggressive timeouts.
+COMPACT_BLOCK_NACK = Msg(
+    "cometbft.consensus.v2.CompactBlockNack",
+    F(1, "height", "int64"),
+    F(2, "round", "int32"),
+)
+
+# vote batching ("votebatch/1"): missing votes coalesced per wire
+# message on the vote channel, like the mempool's tx batching
+VOTE_BATCH = Msg(
+    "cometbft.consensus.v2.VoteBatch",
+    F(1, "votes", "msg", msg=VOTE, repeated=True),
+)
+
 MESSAGE = Msg(
     "cometbft.consensus.v2.Message",   # oneof sum
     F(1, "new_round_step", "msg", msg=NEW_ROUND_STEP),
@@ -99,4 +130,7 @@ MESSAGE = Msg(
     F(9, "vote_set_bits", "msg", msg=VOTE_SET_BITS),
     F(10, "has_proposal_block_part", "msg",
       msg=HAS_PROPOSAL_BLOCK_PART),
+    F(11, "compact_block", "msg", msg=COMPACT_BLOCK),
+    F(12, "vote_batch", "msg", msg=VOTE_BATCH),
+    F(13, "compact_block_nack", "msg", msg=COMPACT_BLOCK_NACK),
 )

@@ -1,0 +1,26 @@
+# bftlint: path=cometbft_tpu/consensus/fixture_reactor.py
+class ConsensusReactor:
+    async def gossip_data(self, ps):
+        # reactor-side peer round state: a store after an await with
+        # no re-validation — the peer may have advanced height/round
+        # (a NewRoundStep applied by the receive path) across the
+        # suspension, so the stale header lands on the wrong round
+        prs = ps.prs
+        header = self.pick_header(prs)
+        await self.sender.send(header)
+        prs.proposal_block_parts_header = header
+
+    async def gossip_catchup_blind(self, ps):
+        # strengthened rule: flagged even without a prior load of the
+        # same attribute
+        prs = ps.prs
+        await self.sender.send(b"part")
+        prs.proposal_block_parts = None
+
+    async def stale_guard(self, ps):
+        # the guard runs BEFORE the suspension: stale by store time
+        prs = ps.prs
+        if prs.round != 0:
+            return
+        await self.sender.send(b"x")
+        prs.round = 1

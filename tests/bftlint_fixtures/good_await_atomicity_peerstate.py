@@ -1,0 +1,24 @@
+# bftlint: path=cometbft_tpu/consensus/fixture_reactor_ok.py
+class ConsensusReactor:
+    async def gossip_data_revalidated(self, ps):
+        # explicit re-validation: the stored attribute itself is
+        # re-read between the last await and the store
+        prs = ps.prs
+        header = self.pick_header(prs)
+        await self.sender.send(header)
+        if prs.proposal_block_parts_header is not None:
+            return
+        prs.proposal_block_parts_header = header
+
+    async def gossip_via_seam(self, ps):
+        # the PeerState seam re-validates (height, round) at the
+        # write — a seam call after an await is the sanctioned store
+        await self.sender.send(b"part")
+        ps.set_has_proposal_block_part(1, 0, 3)
+        ps.init_catchup_parts(1, self.header)
+
+    async def no_await_before_store(self, ps):
+        # stores before the first suspension are not straddles
+        prs = ps.prs
+        prs.proposal_pol_round = 2
+        await self.sender.send(b"x")

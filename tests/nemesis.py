@@ -40,6 +40,7 @@ from cometbft_tpu.consensus.state import ConsensusState
 from cometbft_tpu.crypto import ed25519
 from cometbft_tpu.db import MemDB
 from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool.reactor import MempoolReactor
 from cometbft_tpu.p2p.fuzz import FuzzConfig, FuzzedConnection
 from cometbft_tpu.p2p.key import NodeKey
 from cometbft_tpu.p2p.switch import Switch
@@ -161,6 +162,12 @@ class NemesisNode:
                              listen_addr="127.0.0.1:0")
         self.switch.conn_wrapper = self._wrap_conn
         self.switch.add_reactor(ConsensusReactor(self.cs))
+        if self.net.mempool_gossip:
+            # reconciliation tx gossip rides the same fault injectors
+            # as consensus (docs/gossip.md): tier-1 runs it under
+            # reorder/duplicate/partition fuzz
+            self.switch.add_reactor(
+                MempoolReactor(self.mempool, MempoolConfig()))
         await self.switch.start()
         await self.cs.start()
         self.running = True
@@ -191,11 +198,13 @@ class NemesisNode:
 class NemesisNet:
     def __init__(self, n: int = 4, seed: int = 0,
                  fuzz_profile: Optional[dict] = None,
-                 wal_dir: Optional[str] = None):
+                 wal_dir: Optional[str] = None,
+                 mempool_gossip: bool = False):
         self.seed = seed
         self.rng = random.Random(seed)
         self.links = LinkTable()
         self.fuzz_profile = fuzz_profile
+        self.mempool_gossip = mempool_gossip
         self.fuzzed_conns: list[FuzzedConnection] = []
         # every random artifact (keys included) derives from the seed
         pvs = [MockPV(ed25519.Ed25519PrivKey(
@@ -421,6 +430,9 @@ class Scenario:
     # catchup replay) on every restart — the pipelined-commit crash
     # window needs the real recovery path, not just durable stores
     use_wal: bool = False
+    # register the mempool reactor on every node so have/want tx
+    # gossip + compact-block proposals run under the fault schedule
+    mempool_gossip: bool = False
 
 
 def archive_dir() -> str:
@@ -465,7 +477,8 @@ async def run_scenario(s: Scenario) -> NemesisNet:
 async def _run_scenario_inner(s: Scenario,
                               wal_dir: Optional[str]) -> NemesisNet:
     net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz,
-                     wal_dir=wal_dir)
+                     wal_dir=wal_dir,
+                     mempool_gossip=s.mempool_gossip)
     await net.start()
     try:
         try:

@@ -244,6 +244,72 @@ class TestAwaitAtomicitySeam:
 
 
 # ---------------------------------------------------------------------
+# await-atomicity over reactor-side PeerState (ISSUE 12): prs stores
+# are tracked like rs stores, with the PeerState seam as the guard
+
+class TestAwaitAtomicityPeerState:
+    BAD = os.path.join(FIXTURES, "bad_await_atomicity_peerstate.py")
+    GOOD = os.path.join(FIXTURES, "good_await_atomicity_peerstate.py")
+
+    def test_bad_peerstate_fixture_fires(self):
+        found = [f for f in _lint_file(self.BAD)
+                 if f.rule == "await-atomicity"]
+        assert len(found) >= 3, \
+            f"prs straddles not all flagged: {found}"
+        keys = "".join(f.message for f in found)
+        assert "prs.proposal_block_parts_header" in keys
+        assert "prs.round" in keys
+
+    def test_good_peerstate_fixture_passes(self):
+        found = _lint_file(self.GOOD)
+        assert not found, f"good prs fixture flagged: {found}"
+
+    def test_ps_prs_alias_tracked(self, tmp_path):
+        """The reactor idiom ``prs = ps.prs`` (base object is NOT
+        self) must alias into the tracked base."""
+        p = tmp_path / "fx.py"
+        p.write_text(
+            "# bftlint: path=cometbft_tpu/consensus/fx_reactor.py\n"
+            "class R:\n"
+            "    async def go(self, ps):\n"
+            "        prs = ps.prs\n"
+            "        await self.send(b'x')\n"
+            "        prs.step = 1\n")
+        found = [f for f in _lint_file(str(p))
+                 if f.rule == "await-atomicity"]
+        assert found, "ps.prs alias store-after-await must fire"
+
+    def test_peerstate_seam_table_matches_api(self):
+        """Every PeerState seam method the checker trusts must exist
+        on the live PeerState, and every guarded attribute must be a
+        real PeerRoundState field — no silent drift."""
+        from cometbft_tpu.consensus.reactor import (
+            PeerRoundState, PeerState,
+        )
+        from tools.bftlint.checkers.await_atomicity import (
+            _PEERSTATE_GUARDS,
+        )
+        prs_fields = set(PeerRoundState.__dataclass_fields__)
+        for meth, attrs in _PEERSTATE_GUARDS.items():
+            assert callable(getattr(PeerState, meth, None)), \
+                f"seam method {meth!r} missing from PeerState"
+            for a in attrs:
+                assert a in prs_fields, \
+                    f"{meth} guards unknown field {a!r}"
+
+    def test_reactor_py_lints_clean_no_suppressions(self):
+        """consensus/reactor.py lints clean under the prs-tracking
+        rule with no suppressions — the PeerState owner-discipline
+        claim, checked structurally."""
+        path = os.path.join(PKG, "consensus", "reactor.py")
+        found = [f for f in _lint_file(
+            path, rules={"await-atomicity"})]
+        assert not found, f"reactor.py prs straddles: {found}"
+        src = open(path).read()
+        assert "disable=await-atomicity" not in src
+
+
+# ---------------------------------------------------------------------
 # the retired AST test's invariant, carried over
 
 class TestSupervisedSpawnCarryover:

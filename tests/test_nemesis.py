@@ -110,6 +110,30 @@ class TestNemesisScenarios:
             ),
             recovery_blocks=3)))
 
+    def test_recon_gossip_under_fuzz_and_partition(self):
+        """ISSUE 12: have/want tx gossip + compact-block proposals
+        (the mempool reactor, negotiated by default) running under
+        reorder/duplicate link fuzz and a transient asymmetric
+        partition.  The load injector puts every tx in exactly ONE
+        node's pool, so blocks only fill if reconciliation moves txs
+        across the fuzzed links; liveness, bounded recovery, and
+        zero conflicting commits (the runner's full-history hash
+        check) must all hold."""
+        run(run_scenario(Scenario(
+            name="recon-gossip",
+            seed=31,
+            mempool_gossip=True,
+            fuzz=dict(prob_reorder=0.05, prob_duplicate=0.05,
+                      prob_delay=0.04, max_delay_s=0.015),
+            steps=(
+                ("wait_blocks", 3),
+                ("partition", (0,), (2, 3)),
+                ("sleep", 1.0),
+                ("heal",),
+                ("wait_blocks", 2),
+            ),
+            recovery_blocks=2)))
+
     def test_mute_validator_routes_around(self):
         """Asymmetric single-node mute: node 3's frames reach nobody,
         but it still hears the net.  The other three form a quorum and

@@ -1,0 +1,635 @@
+"""Reconciliation data plane (ISSUE 12, docs/gossip.md): have/want tx
+gossip + compact-block proposals.
+
+Unit edges: short-hash self-collision salt rotation, want-timeout
+refetch from a second advertiser, compact reconstruct with missing
+txs nacking into the full-part fallback, flood interop with a peer
+that never negotiated the capability; plus a live 2-node pull-path
+e2e over real sockets.  The fuzz/partition coverage is the
+``recon-gossip`` nemesis scenario (tests/test_nemesis.py).
+"""
+import asyncio
+
+import pytest
+
+from cometbft_tpu.abci.client import AppConns
+from cometbft_tpu.abci.kvstore import DEFAULT_LANES, KVStoreApplication
+from cometbft_tpu.config import MempoolConfig
+from cometbft_tpu.mempool import CListMempool
+from cometbft_tpu.mempool import messages as mm
+from cometbft_tpu.mempool.reactor import MEMPOOL_CHANNEL, MempoolReactor
+from cometbft_tpu.types.tx import tx_key
+
+
+def run(coro):
+    loop = asyncio.new_event_loop()
+    try:
+        return loop.run_until_complete(coro)
+    finally:
+        loop.close()
+
+
+class _NodeInfoStub:
+    def __init__(self, features):
+        self.features = tuple(features)
+
+
+class _StubPeer:
+    """Captures sends; optionally advertises capabilities."""
+
+    def __init__(self, pid="aa" * 20, features=()):
+        self.id = pid
+        self.sent = []
+        self.node_info = _NodeInfoStub(features)
+
+    def has_feature(self, name):
+        return name in self.node_info.features
+
+    def send(self, chan_id, payload):
+        self.sent.append((chan_id, payload))
+        return True
+
+    def decoded(self):
+        return [mm.decode_mempool(p) for _, p in self.sent]
+
+
+async def _mk_pool(size=5000, **cfg):
+    app = KVStoreApplication()
+    conns = AppConns(app)
+    return CListMempool(MempoolConfig(size=size, **cfg), conns.mempool,
+                        lanes=DEFAULT_LANES, default_lane="default")
+
+
+async def _wait_for(pred, timeout=5.0, what="condition"):
+    loop = asyncio.get_running_loop()
+    deadline = loop.time() + timeout
+    while not pred():
+        if loop.time() > deadline:
+            raise AssertionError(f"timed out waiting for {what}")
+        await asyncio.sleep(0.01)
+
+
+RECON = (mm.FEATURE_TXRECON,)
+
+
+class TestShortIds:
+    def test_short_id_is_salted(self):
+        k = tx_key(b"tx-1")
+        a = mm.short_id(b"salt-a", k)
+        b = mm.short_id(b"salt-b", k)
+        assert a != b
+        assert len(a) == mm.SHORT_ID_LEN
+
+    def test_bulk_matches_single(self):
+        keys = [tx_key(b"tx-%d" % i) for i in range(100)]
+        bulk = mm.short_ids(b"s", keys)
+        assert bulk == [mm.short_id(b"s", k) for k in keys]
+
+    def test_have_want_wire_roundtrip_bounds_bytes(self):
+        keys = [tx_key(b"t%04d" % i) for i in range(256)]
+        ids = mm.short_ids(b"salty-8b", keys)
+        raw = mm.encode_mempool(mm.TxHaveMessage(b"salty-8b", ids))
+        # 256 ids at 8 bytes + envelope: ~1/32nd of the 256 B txs
+        assert len(raw) < 256 * mm.SHORT_ID_LEN + 64
+        got = mm.decode_mempool(raw)
+        assert got.ids == ids and got.salt == b"salty-8b"
+
+
+class TestSaltRotation:
+    def test_summary_self_collision_rotates_salt(self, monkeypatch):
+        """Two pool txs colliding under the current salt make the
+        summary ambiguous: the sender must rotate (bump) its salt and
+        re-derive until the batch's ids are unique."""
+        # 1-byte ids over 64 txs guarantee a birthday collision
+        monkeypatch.setattr(mm, "SHORT_ID_LEN", 1)
+
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig(
+                recon_push_peers=0))
+            peer = _StubPeer(features=RECON)
+            for i in range(64):
+                await mp.check_tx(b"col%03d=v" % i)
+            await reactor.add_peer(peer)
+            await _wait_for(lambda: peer.sent, what="advert")
+            await asyncio.sleep(0.05)
+            haves = [m for m in peer.decoded()
+                     if isinstance(m, mm.TxHaveMessage)]
+            assert haves, "no TxHave sent"
+            # at 1-byte ids NO salt can make 64 keys collision-free:
+            # the rotation loop must have fired (and its bound must
+            # have stopped it from spinning) — shipping a residual
+            # collision is safe, it only suppresses one pull and the
+            # want-timeout/compact fallbacks cover it
+            assert reactor.mempool.metrics \
+                .recon_salt_rotations.value > 0
+            assert reactor._salt_bump <= 8
+            await reactor.remove_peer(peer, "done")
+        run(go())
+
+    def test_salt_follows_height_epoch(self):
+        async def go():
+            mp = await _mk_pool()
+            r = MempoolReactor(mp, MempoolConfig(
+                recon_salt_epoch_blocks=16))
+            s0 = r._current_salt()
+            mp.height = 15
+            assert r._current_salt() == s0
+            mp.height = 16
+            assert r._current_salt() != s0
+        run(go())
+
+
+class TestWantTracker:
+    def test_want_goes_to_first_advertiser_only(self):
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            a = _StubPeer(pid="aa" * 20, features=RECON)
+            b = _StubPeer(pid="bb" * 20, features=RECON)
+            reactor._recon_peers = {a.id: a, b.id: b}
+            salt = b"s" * 8
+            sid = mm.short_id(salt, tx_key(b"unknown-tx"))
+            reactor._receive_have(
+                mm.TxHaveMessage(salt, [sid]), a)
+            reactor._receive_have(
+                mm.TxHaveMessage(salt, [sid]), b)
+            wants_a = [m for m in a.decoded()
+                       if isinstance(m, mm.TxWantMessage)]
+            wants_b = [m for m in b.decoded()
+                       if isinstance(m, mm.TxWantMessage)]
+            assert wants_a and wants_a[0].ids == [sid]
+            assert not wants_b, "duplicate pull of an in-flight id"
+            w = reactor._wants.get(salt, sid)
+            assert w is not None and w.advertisers == [a.id, b.id]
+        run(go())
+
+    def test_timeout_refetches_from_second_advertiser(self):
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            a = _StubPeer(pid="aa" * 20, features=RECON)
+            b = _StubPeer(pid="bb" * 20, features=RECON)
+            reactor._recon_peers = {a.id: a, b.id: b}
+            salt = b"s" * 8
+            sid = mm.short_id(salt, tx_key(b"lost-tx"))
+            reactor._receive_have(mm.TxHaveMessage(salt, [sid]), a)
+            reactor._receive_have(mm.TxHaveMessage(salt, [sid]), b)
+            now = asyncio.get_running_loop().time()
+            reactor.sweep_wants(now + 2.0, timeout_s=1.0)
+            wants_b = [m for m in b.decoded()
+                       if isinstance(m, mm.TxWantMessage)]
+            assert wants_b and wants_b[0].ids == [sid], \
+                "timeout did not refetch from the second advertiser"
+            assert reactor.mempool.metrics \
+                .recon_want_refetches.value == 1
+            # every advertiser exhausted -> the entry is dropped
+            for i in range(6):
+                reactor.sweep_wants(now + 10.0 + 3 * i,
+                                    timeout_s=1.0)
+            assert reactor._wants.get(salt, sid) is None
+            assert reactor.mempool.metrics \
+                .recon_want_expired.value == 1
+        run(go())
+
+    def test_arriving_tx_settles_want(self):
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            a = _StubPeer(pid="aa" * 20, features=RECON)
+            reactor._recon_peers = {a.id: a}
+            tx = b"wanted=v"
+            salt = reactor._current_salt()
+            sid = mm.short_id(salt, tx_key(tx))
+            reactor._receive_have(mm.TxHaveMessage(salt, [sid]), a)
+            assert reactor._wants.get(salt, sid) is not None
+            await reactor._receive_txs(mm.TxsMessage([tx]), a)
+            assert reactor._wants.get(salt, sid) is None
+            assert mp.contains(tx_key(tx))
+        run(go())
+
+    def test_want_served_from_pool(self):
+        """A peer's TxWant under the salt we advertised with returns
+        the full txs, batched."""
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            peer = _StubPeer(features=RECON)
+            txs = [b"serve%02d=v" % i for i in range(10)]
+            for t in txs:
+                await mp.check_tx(t)
+            salt = reactor._current_salt()
+            sids = [mm.short_id(salt, tx_key(t)) for t in txs]
+            reactor._receive_want(
+                mm.TxWantMessage(salt, sids), peer)
+            got = [m for m in peer.decoded()
+                   if isinstance(m, mm.TxsMessage)]
+            assert got and sorted(
+                t for m in got for t in m.txs) == sorted(txs)
+        run(go())
+
+
+class TestFloodFallbackInterop:
+    def test_non_negotiating_peer_gets_full_txs(self):
+        """A peer that never advertised txrecon/1 (an old build) must
+        get the flood plane: full txs, never TxHave summaries."""
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            old = _StubPeer(pid="cc" * 20, features=())
+            new = _StubPeer(pid="dd" * 20, features=RECON)
+            await reactor.add_peer(old)
+            await reactor.add_peer(new)
+            # a GOSSIPED tx (has a sender): the push fast path does
+            # not apply, so the recon peer must see a summary
+            await mp.check_tx(b"interop=1", sender="ee" * 20)
+            await _wait_for(lambda: old.sent and new.sent,
+                            what="both planes to send")
+            old_msgs = old.decoded()
+            assert any(isinstance(m, mm.TxsMessage) and
+                       b"interop=1" in m.txs for m in old_msgs)
+            assert not any(isinstance(m, mm.TxHaveMessage)
+                           for m in old_msgs)
+            new_msgs = new.decoded()
+            assert any(isinstance(m, mm.TxHaveMessage)
+                       for m in new_msgs)
+            assert not any(isinstance(m, mm.TxsMessage)
+                           for m in new_msgs)
+            await reactor.remove_peer(old, "done")
+            await reactor.remove_peer(new, "done")
+        run(go())
+
+    def test_local_tx_pushed_to_fast_path_peers(self):
+        """Brand-new local txs (no gossip sender) are pushed in full:
+        with one peer and recon_push_peers=2 the lottery always
+        selects it."""
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig(
+                recon_push_peers=2))
+            peer = _StubPeer(features=RECON)
+            await reactor.add_peer(peer)
+            await mp.check_tx(b"local=1")
+            await _wait_for(lambda: peer.sent, what="push")
+            msgs = peer.decoded()
+            assert any(isinstance(m, mm.TxsMessage) and
+                       b"local=1" in m.txs for m in msgs)
+            assert reactor.mempool.metrics \
+                .recon_pushed_txs.value >= 1
+            await reactor.remove_peer(peer, "done")
+        run(go())
+
+    def test_duplicate_delivery_ratio_gauge(self):
+        async def go():
+            mp = await _mk_pool()
+            reactor = MempoolReactor(mp, MempoolConfig())
+            peer = _StubPeer(features=RECON)
+            await reactor._receive_txs(
+                mm.TxsMessage([b"d=1", b"d=2"]), peer)
+            await reactor._receive_txs(
+                mm.TxsMessage([b"d=1"]), peer)   # duplicate
+            m = mp.metrics
+            assert m.gossip_txs_received.value == 3
+            assert m.gossip_txs_duplicate.value == 1
+            assert abs(m.duplicate_delivery_ratio.value - 1 / 3) \
+                < 1e-9
+        run(go())
+
+
+class TestCompactBlock:
+    def _mk_block(self, n_txs=32):
+        from cometbft_tpu.types.block import Block, Data, Header
+        from cometbft_tpu.types.timestamp import Timestamp
+        txs = [(b"cb%04d=" % i) + b"v" * 120 for i in range(n_txs)]
+        b = Block(header=Header(chain_id="t", height=1,
+                                time=Timestamp(1700000000, 0),
+                                proposer_address=b"p" * 20),
+                  data=Data(txs=txs))
+        b.fill_header()
+        return b, b.make_part_set()
+
+    def test_reconstruct_is_byte_exact(self):
+        from cometbft_tpu.consensus.messages import (
+            make_compact_block, reconstruct_block_bytes,
+        )
+        from cometbft_tpu.types.part_set import PartSet
+        block, parts = self._mk_block(900 // 4)
+        msg = make_compact_block(1, 0, block, parts.header())
+        raw = reconstruct_block_bytes(msg.skeleton,
+                                      list(block.data.txs))
+        assert raw == parts.assemble()
+        assert PartSet.from_data(raw).header() == parts.header()
+
+    async def _mk_cs(self):
+        """A wired single-validator ConsensusState (not started) with
+        a real mempool behind the executor."""
+        from cometbft_tpu.config import test_config as _tc
+        from cometbft_tpu.consensus.state import ConsensusState
+        from cometbft_tpu.crypto import ed25519
+        from cometbft_tpu.db import MemDB
+        from cometbft_tpu.state import make_genesis_state
+        from cometbft_tpu.state.execution import BlockExecutor
+        from cometbft_tpu.state.store import Store
+        from cometbft_tpu.store import BlockStore
+        from cometbft_tpu.types.genesis import (
+            GenesisDoc, GenesisValidator,
+        )
+        from cometbft_tpu.types.priv_validator import MockPV
+        from cometbft_tpu.types.timestamp import Timestamp
+        pv = MockPV(ed25519.Ed25519PrivKey(b"\x11" * 32))
+        doc = GenesisDoc(chain_id="t",
+                         genesis_time=Timestamp(1700000000, 0),
+                         validators=[GenesisValidator(
+                             address=b"",
+                             pub_key=pv.get_pub_key(), power=10)])
+        state = make_genesis_state(doc)
+        app = KVStoreApplication()
+        conns = AppConns(app)
+        ss, bs = Store(MemDB()), BlockStore(MemDB())
+        ss.save(state)
+        mp = CListMempool(MempoolConfig(), conns.mempool,
+                          lanes=DEFAULT_LANES,
+                          default_lane="default")
+        ex = BlockExecutor(ss, conns.consensus, mempool=mp,
+                           block_store=bs)
+        return ConsensusState(_tc().consensus, state, ex, bs,
+                              priv_validator=pv), mp
+
+    def test_missing_tx_nacks_and_falls_back(self):
+        """A compact proposal with an unresolvable hash must not feed
+        any parts; it nacks the sender (the immediate full-part
+        fallback) and counts a miss."""
+        from cometbft_tpu.consensus.messages import (
+            make_compact_block,
+        )
+        from cometbft_tpu.types.part_set import PartSet
+
+        async def go():
+            cs, mp = await self._mk_cs()
+            block, parts = self._mk_block(16)
+            # all but one tx in the pool
+            for tx in block.data.txs[1:]:
+                await mp.check_tx(tx)
+            sent = []
+            cs.broadcast_hooks.append(sent.append)
+            cs.rs.proposal_block_parts = PartSet(parts.header())
+            msg = make_compact_block(cs.rs.height, cs.rs.round,
+                                     block, parts.header())
+            ok = await cs._apply_compact_block(msg, "peerX")
+            assert not ok
+            assert cs.rs.proposal_block is None
+            assert cs.metrics.compact_block_misses.value == 1
+            nacks = [m for m in sent if isinstance(m, tuple) and
+                     m[0] == "compact_nack"]
+            assert nacks == [("compact_nack", cs.rs.height,
+                              cs.rs.round, "peerX")]
+            # the missing tx arrives (the want path delivered it):
+            # a re-sent compact now reconstructs fully
+            await mp.check_tx(block.data.txs[0])
+            ok = await cs._apply_compact_block(msg, "peerX")
+            assert ok
+            assert cs.rs.proposal_block is not None
+            assert cs.rs.proposal_block.hash() == block.hash()
+            assert cs.metrics.compact_blocks_reconstructed.value == 1
+            await cs.stop()
+        run(go())
+
+    def test_header_mismatch_nacks(self):
+        from cometbft_tpu.consensus.messages import (
+            make_compact_block,
+        )
+        from cometbft_tpu.types.part_set import PartSet
+
+        async def go():
+            cs, mp = await self._mk_cs()
+            block, parts = self._mk_block(16)
+            other_block, other_parts = self._mk_block(12)
+            for tx in block.data.txs:
+                await mp.check_tx(tx)
+            sent = []
+            cs.broadcast_hooks.append(sent.append)
+            # we are collecting OTHER block's parts; the compact
+            # advertises a different header -> mismatch, nack
+            cs.rs.proposal_block_parts = PartSet(
+                other_parts.header())
+            msg = make_compact_block(cs.rs.height, cs.rs.round,
+                                     block, parts.header())
+            ok = await cs._apply_compact_block(msg, "peerY")
+            assert not ok
+            assert cs.metrics.compact_block_mismatches.value == 1
+            assert any(isinstance(m, tuple) and
+                       m[0] == "compact_nack" for m in sent)
+            await cs.stop()
+        run(go())
+
+    def test_small_blocks_skip_compact(self):
+        """Proposals under COMPACT_MIN_TXS ship as plain parts — the
+        compact tuple must not be broadcast for them."""
+        from cometbft_tpu.consensus.messages import COMPACT_MIN_TXS
+        assert COMPACT_MIN_TXS >= 2
+
+
+class TestHandshakeNegotiation:
+    def test_node_info_features_roundtrip(self):
+        from cometbft_tpu.p2p.switch import NodeInfo
+        ni = NodeInfo(node_id="x", network="n",
+                      features=("txrecon/1", "compactblocks/1"))
+        got = NodeInfo.from_json(ni.to_json())
+        assert got.features == ("txrecon/1", "compactblocks/1")
+        # an old build's JSON has no features key
+        import json
+        d = json.loads(ni.to_json())
+        del d["features"]
+        old = NodeInfo.from_json(json.dumps(d).encode())
+        assert old.features == ()
+
+    def test_switch_aggregates_reactor_features(self):
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.p2p.switch import Switch
+
+        async def go():
+            sw = Switch(NodeKey.generate(), "net")
+            mp = await _mk_pool()
+            sw.add_reactor(MempoolReactor(mp, MempoolConfig()))
+            assert mm.FEATURE_TXRECON in sw.node_info().features
+            sw2 = Switch(NodeKey.generate(), "net")
+            mp2 = await _mk_pool()
+            sw2.add_reactor(MempoolReactor(mp2, MempoolConfig(
+                gossip_reconciliation=False)))
+            assert mm.FEATURE_TXRECON not in sw2.node_info().features
+        run(go())
+
+
+class TestReconE2E:
+    def test_two_node_pull_path_over_sockets(self):
+        """Node B learns a tx it never saw via advertise -> want ->
+        pull over a real secret-connection link (push fast path
+        disabled so the reconciliation round trip itself is what
+        moves the tx)."""
+        from cometbft_tpu.p2p.key import NodeKey
+        from cometbft_tpu.p2p.switch import Switch
+
+        async def go():
+            switches, pools, reactors = [], [], []
+            for _ in range(2):
+                mp = await _mk_pool()
+                r = MempoolReactor(mp, MempoolConfig(
+                    recon_push_peers=0,
+                    recon_want_timeout_ns=500_000_000))
+                sw = Switch(NodeKey.generate(), "recon-e2e",
+                            listen_addr="127.0.0.1:0")
+                sw.add_reactor(r)
+                switches.append(sw)
+                pools.append(mp)
+                reactors.append(r)
+            for sw in switches:
+                await sw.start()
+            try:
+                await switches[0].dial_peer(switches[1].listen_addr)
+                tx = b"e2epull=" + b"v" * 64
+                await pools[0].check_tx(tx)
+                await _wait_for(
+                    lambda: pools[1].contains(tx_key(tx)),
+                    timeout=8.0, what="tx to cross via want/pull")
+                m1 = pools[1].metrics
+                assert m1.recon_wants_sent.value >= 1
+                assert m1.gossip_txs_duplicate.value == 0
+                m0 = pools[0].metrics
+                assert m0.recon_wants_received.value >= 1
+            finally:
+                for sw in switches:
+                    await sw.stop()
+        run(go())
+
+
+class TestAppendLog:
+    """The bounded (seq, key) append log: gossip cursors and short-id
+    maps read "appended since S" in O(new) instead of rescanning the
+    pool per wire message (the QA_r08 profile win)."""
+
+    def test_covers_and_orders_appends(self):
+        async def go():
+            mp = await _mk_pool()
+            txs = [b"log%02d=v" % i for i in range(5)]
+            for tx in txs:
+                await mp.check_tx(tx)
+            assert mp.keys_appended_after(-1) == \
+                [tx_key(tx) for tx in txs]
+            mid = mp._append_log[2][0]
+            assert mp.keys_appended_after(mid) == \
+                [tx_key(tx) for tx in txs[3:]]
+            assert mp.keys_appended_after(mp._seq) == []
+        run(go())
+
+    def test_trim_forces_fallback(self):
+        async def go():
+            mp = await _mk_pool()
+            mp._APPEND_LOG_MAX = 8
+            for i in range(12):
+                await mp.check_tx(b"trim%02d=v" % i)
+            # the log dropped its oldest quarter at least once: a
+            # cursor from before the drop cannot be served
+            assert mp._log_start_seq > -1
+            assert mp.keys_appended_after(-1) is None
+            assert mp.keys_appended_after(
+                mp._log_start_seq - 1) is None
+            # at the boundary (and after) it still serves
+            assert mp.keys_appended_after(
+                mp._log_start_seq) is not None
+        run(go())
+
+    def test_flush_resets_log(self):
+        async def go():
+            mp = await _mk_pool()
+            await mp.check_tx(b"fl0=v")
+            mp.flush()
+            assert mp.keys_appended_after(mp._seq) == []
+            # pre-flush cursors fall back to the (now empty) scan
+            assert mp.keys_appended_after(-1) is None
+        run(go())
+
+    def test_fresh_entries_uses_log_and_fallback(self):
+        async def go():
+            mp = await _mk_pool()
+            r = MempoolReactor(mp, MempoolConfig())
+            for i in range(6):
+                await mp.check_tx(b"fe%02d=v" % i, sender="")
+            keys = [e.key for e in r._fresh_entries(-1, "zz" * 20,
+                                                    set())]
+            assert len(keys) == 6
+            # committed/evicted entries drop out of the feed
+            mp.remove_tx_by_key(keys[0])
+            left = [e.key for e in r._fresh_entries(-1, "zz" * 20,
+                                                    set())]
+            assert keys[0] not in left and len(left) == 5
+            # a handled key is skipped; a sender match is skipped
+            left2 = r._fresh_entries(-1, "zz" * 20, {keys[1]})
+            assert keys[1] not in [e.key for e in left2]
+        run(go())
+
+
+class TestVoteGossipUntrackedSet:
+    """Regression for the QA_r08 livelock: _pick_send_vote must not
+    send into a vote set the peer-state does not track — the
+    delivery can never be marked (set_has_vote drops the write), so
+    the same votes re-send every gossip tick forever, and vote
+    batching amplified that into 315k messages across 12 heights."""
+
+    def _mk_reactor_and_ps(self, vote_batch_max=16):
+        from types import SimpleNamespace
+        from cometbft_tpu.config import ConsensusConfig
+        from cometbft_tpu.consensus.metrics import Metrics
+        from cometbft_tpu.consensus.reactor import (
+            ConsensusReactor, PeerState,
+        )
+        cfg = ConsensusConfig(vote_batch_max=vote_batch_max)
+        cs = SimpleNamespace(config=cfg, metrics=Metrics(),
+                             broadcast_hooks=[], on_new_step=[],
+                             rs=None)
+        reactor = ConsensusReactor.__new__(ConsensusReactor)
+        reactor.cs = cs
+        peer = _StubPeer(features=("votebatch/1",))
+        return reactor, PeerState(peer), peer
+
+    def _mk_vote_set(self, height=5, round_=0, n=4):
+        from types import SimpleNamespace
+        from cometbft_tpu.libs.bits import BitArray
+        from cometbft_tpu.types import canonical
+        from cometbft_tpu.types.block_id import BlockID
+        from cometbft_tpu.types.timestamp import Timestamp
+        from cometbft_tpu.types.vote import Vote
+        ours = BitArray(n)
+        ours.set_index(0, True)
+        votes = {0: Vote(type=canonical.PREVOTE_TYPE, height=height,
+                         round=round_, block_id=BlockID(),
+                         timestamp=Timestamp(1700000000, 0),
+                         validator_address=b"v" * 20,
+                         validator_index=0, signature=b"s" * 64)}
+        return SimpleNamespace(
+            height=height, round=round_,
+            signed_msg_type=canonical.PREVOTE_TYPE,
+            bit_array=lambda: ours,
+            get_by_index=lambda i: votes.get(i))
+
+    def test_untracked_set_sends_nothing(self):
+        reactor, ps, peer = self._mk_reactor_and_ps()
+        vs = self._mk_vote_set(height=5)
+        # default PeerRoundState: height 0 — the peer tracks nothing
+        # for height 5, so there is NO send (reference PickSendVote:
+        # nil bitarray -> no pick)
+        assert reactor._pick_send_vote(ps, vs) is False
+        assert peer.sent == []
+
+    def test_tracked_set_sends_and_marks(self):
+        from cometbft_tpu.libs.bits import BitArray
+        reactor, ps, peer = self._mk_reactor_and_ps()
+        vs = self._mk_vote_set(height=5)
+        ps.prs.height = 5
+        ps.prs.round = 0
+        ps.prs.prevotes = BitArray(4)
+        assert reactor._pick_send_vote(ps, vs) is True
+        assert len(peer.sent) == 1
+        # delivery marked: a second pick finds nothing missing
+        assert ps.prs.prevotes.get_index(0)
+        assert reactor._pick_send_vote(ps, vs) is False
+        assert len(peer.sent) == 1

@@ -50,9 +50,13 @@ from typing import Iterator, Optional
 
 from ..core import Checker, FileContext, Finding, walk_scope
 
-# attribute roots considered consensus-critical state
-_TRACKED_BASES = {"rs", "sm_state"}
-_TRACKED_DIRECT = {"rs", "sm_state", "height", "round", "step",
+# attribute roots considered consensus-critical state.  "prs" is the
+# reactor-side peer round state (consensus/reactor.py PeerRoundState):
+# the gossip-rewrite PR gave PeerState the same single-writer seam
+# RoundState got, and this rule tracks its stores the same way —
+# ``prs = ps.prs`` / ``prs = self.prs`` aliases included.
+_TRACKED_BASES = {"rs", "sm_state", "prs"}
+_TRACKED_DIRECT = {"rs", "sm_state", "prs", "height", "round", "step",
                    "locked_round", "valid_round"}
 
 # the RoundState transition seam: internally re-validating mutation
@@ -74,6 +78,29 @@ _TRANSITION_GUARDS: dict[str, tuple[str, ...]] = {
     "adopt_block": (),
 }
 _TRANSITION_METHODS = frozenset(_TRANSITION_GUARDS)
+
+# the PeerState seam (consensus/reactor.py): mutation methods that
+# re-validate the peer's (height, round) at the write.  Unlike the
+# RoundState seam, these are called on the PeerState object (``ps``),
+# not on the tracked ``prs`` base, so any call to one of these names
+# counts as a guard for the listed ``prs.*`` keys — the receiver is
+# not resolved (a deliberately weaker heuristic; the method names are
+# specific enough that false guards are unlikely, and the fixtures
+# pin both directions).
+_PEERSTATE_GUARDS: dict[str, tuple[str, ...]] = {
+    "apply_new_round_step": ("height", "round", "step"),
+    "apply_new_valid_block": ("proposal_block_parts",
+                              "proposal_block_parts_header"),
+    "apply_proposal": ("proposal",),
+    "apply_proposal_pol": ("proposal_pol",),
+    "set_has_proposal_block_part": ("proposal_block_parts",),
+    "init_catchup_parts": ("proposal_block_parts",
+                           "proposal_block_parts_header"),
+    "mark_compact_sent": (),
+    "mark_peer_has_full_block": (),
+    "ensure_catchup_commit_round": ("catchup_commit_round",
+                                    "catchup_commit"),
+}
 
 
 def _attr_key(node: ast.AST,
@@ -97,8 +124,10 @@ def _attr_key(node: ast.AST,
 
 
 def _collect_aliases(fn: ast.AsyncFunctionDef) -> dict[str, str]:
-    """``rs = self.rs`` / ``state = self.sm_state`` local aliases:
-    local name -> tracked base."""
+    """``rs = self.rs`` / ``state = self.sm_state`` / ``prs = ps.prs``
+    local aliases: local name -> tracked base.  The base object of a
+    ``prs`` alias is deliberately not restricted to ``self`` — the
+    reactor idiom reads peer round state off a PeerState argument."""
     aliases: dict[str, str] = {}
     for node in walk_scope(fn):
         if not isinstance(node, ast.Assign) or \
@@ -106,9 +135,11 @@ def _collect_aliases(fn: ast.AsyncFunctionDef) -> dict[str, str]:
                 not isinstance(node.targets[0], ast.Name):
             continue
         v = node.value
-        if isinstance(v, ast.Attribute) and \
-                isinstance(v.value, ast.Name) and \
-                v.value.id == "self" and v.attr in _TRACKED_BASES:
+        if not isinstance(v, ast.Attribute) or \
+                v.attr not in _TRACKED_BASES:
+            continue
+        if (isinstance(v.value, ast.Name) and
+                v.value.id == "self") or v.attr == "prs":
             aliases[node.targets[0].id] = v.attr
     return aliases
 
@@ -158,6 +189,13 @@ class AwaitAtomicityChecker(Checker):
                     for attr in _TRANSITION_GUARDS[node.func.attr]:
                         guards.append((_pos(node),
                                        f"{base_key}.{attr}"))
+            elif isinstance(node, ast.Call) and \
+                    isinstance(node.func, ast.Attribute) and \
+                    node.func.attr in _PEERSTATE_GUARDS:
+                # PeerState seam call (any receiver; see the table's
+                # docstring): guards the listed prs.* keys
+                for attr in _PEERSTATE_GUARDS[node.func.attr]:
+                    guards.append((_pos(node), f"prs.{attr}"))
             elif isinstance(node, (ast.If, ast.While, ast.Assert)):
                 test = node.test
                 for sub in ast.walk(test):

@@ -38,6 +38,9 @@ ALLOWED_NAMES = {
     "choice",        # kernel dispatch choice: closed set in ops
     "vt_label",      # vote type: {prevote, precommit}
     "timely",        # PBTS timeliness: {true, false}
+    "ch_id",         # p2p channel id string: claimed channels only
+                     # (touch_channel materializes series at reactor
+                     # registration; ids are a closed per-node set)
 }
 
 

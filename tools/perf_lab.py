@@ -540,6 +540,83 @@ def bench_height_pipeline_overlap(fast: bool):
     }
 
 
+def bench_gossip_reconcile_roundtrip(fast: bool):
+    """ISSUE 12: one reconciliation round at a 5k-tx pool — build the
+    short-id summary for a 256-tx advert batch, encode + decode the
+    TxHave, and diff it against a receiver pool missing 32 of the
+    txs (the receiver-side cost every advert pays).  The short-id
+    hashing of the full 5k pool rides along as ``pool_hash_min_ms``
+    (the per-salt map build, amortized across adverts)."""
+    from cometbft_tpu.mempool.messages import (
+        TxHaveMessage, decode_mempool, encode_mempool, short_ids,
+    )
+    from cometbft_tpu.types.tx import tx_key
+
+    n_pool, n_advert, n_missing = 5000, 256, 32
+    keys = [tx_key(b"sum%05d=" % i + b"v" * 248)
+            for i in range(n_pool)]
+    salt = b"perf-salt"
+    # receiver's short map: the pool minus the missing txs
+    have = dict(zip(short_ids(salt, keys[n_missing:]),
+                    keys[n_missing:]))
+    advert_keys = keys[:n_advert]
+
+    def run():
+        sids = short_ids(salt, advert_keys)
+        raw = encode_mempool(TxHaveMessage(salt=salt, ids=sids))
+        msg = decode_mempool(raw)
+        wants = [sid for sid in msg.ids if sid not in have]
+        if len(wants) != n_missing:
+            raise RuntimeError(f"diff found {len(wants)} missing")
+
+    stats = measure(run, reps=5 if fast else 15, inner=5, warmup=2)
+    sub = measure(lambda: short_ids(salt, keys), reps=3, inner=1,
+                  warmup=1)
+    stats["pool_hash_min_ms"] = sub["min_ms"]
+    stats["pool"] = n_pool
+    stats["advert"] = n_advert
+    return stats
+
+
+def bench_compact_block_reconstruct(fast: bool):
+    """ISSUE 12: rebuild a 900-tx / 256 KiB proposal from the mempool
+    given its compact form (skeleton + tx hashes) — resolve, splice,
+    re-encode, re-split, verify the part-set header.  The full-part
+    path this replaces shipped ~233 KB per peer; the compact form is
+    ~29 KB (``compact_bytes``/``full_bytes`` ride along)."""
+    from cometbft_tpu.consensus.messages import (
+        make_compact_block, reconstruct_block_bytes,
+    )
+    from cometbft_tpu.types.block import Block, Data, Header
+    from cometbft_tpu.types.part_set import PartSet
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.tx import tx_key
+
+    txs = [(b"cb%04d=" % i) + b"v" * 249 for i in range(900)]
+    block = Block(header=Header(chain_id="perf", height=7,
+                                time=Timestamp(1700000000, 0),
+                                proposer_address=b"p" * 20),
+                  data=Data(txs=list(txs)))
+    block.fill_header()
+    parts = block.make_part_set()
+    msg = make_compact_block(7, 0, block, parts.header())
+    pool = {tx_key(tx): tx for tx in txs}
+
+    def run():
+        resolved = [pool[h] for h in msg.tx_hashes]
+        rebuilt = PartSet.from_data(
+            reconstruct_block_bytes(msg.skeleton, resolved))
+        if rebuilt.header() != parts.header():
+            raise RuntimeError("part-set header mismatch")
+
+    stats = measure(run, reps=5 if fast else 15, inner=2, warmup=2)
+    stats["txs"] = len(txs)
+    stats["compact_bytes"] = len(msg.skeleton) + \
+        32 * len(msg.tx_hashes)
+    stats["full_bytes"] = parts.byte_size
+    return stats
+
+
 def bench_bftlint_selfcheck(fast: bool):
     from tools.bftlint import lint_paths
     from tools.bftlint.checkers import ALL_CHECKERS
@@ -571,6 +648,10 @@ BENCHMARKS = {
     "mempool_incremental_recheck": (
         bench_mempool_incremental_recheck, True),
     "height_pipeline_overlap": (bench_height_pipeline_overlap, True),
+    "gossip_reconcile_roundtrip": (
+        bench_gossip_reconcile_roundtrip, True),
+    "compact_block_reconstruct": (
+        bench_compact_block_reconstruct, True),
     "bftlint_selfcheck": (bench_bftlint_selfcheck, True),
 }
 
