@@ -212,6 +212,12 @@ class ConsensusConfig:
     # vote channel for peers that negotiated "votebatch/1"
     # (0 = always single-vote messages)
     vote_batch_max: int = 16
+    # advertise "aggcommit/1" in the handshake: this build can parse
+    # AggregateCommit wire arms (docs/aggregate_commits.md).  Whether
+    # a chain actually USES aggregate commits is consensus-param
+    # driven (feature.aggregate_commit_enable_height), not config;
+    # on such a chain peers lacking the capability are refused.
+    aggregate_commits_wire: bool = True
 
     def propose_timeout_ns(self, round_: int) -> int:
         return self.timeout_propose_ns + \

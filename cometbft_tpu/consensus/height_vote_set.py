@@ -62,6 +62,16 @@ class HeightVoteSet:
                 self._add_round(r)
         self.round = round_
 
+    def ensure_round_tracked(self, round_: int) -> None:
+        """Track one specific round without advancing the round
+        cursor.  Aggregate-commit catchup injects VERIFIED +2/3
+        evidence for a commit round this node may never have reached
+        locally (the chain decided at round 3 while we churned at 0)
+        — allocation is bounded because callers verify the aggregate
+        signature first."""
+        if round_ >= 0 and round_ not in self._round_vote_sets:
+            self._add_round(round_)
+
     # ------------------------------------------------------------------
     def add_vote(self, vote: Vote, peer_id: str = "") -> bool:
         """Returns True if added.  Unwanted rounds (beyond round+1) are

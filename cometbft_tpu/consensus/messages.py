@@ -148,6 +148,13 @@ class HasProposalBlockPartMessage:
 
 FEATURE_COMPACT_BLOCKS = "compactblocks/1"
 FEATURE_VOTE_BATCH = "votebatch/1"
+# can parse AggregateCommit wire arms (blocks/signed headers of
+# chains past feature.aggregate_commit_enable_height).  Advertised
+# whenever the software supports it; on an aggregate-commit chain the
+# consensus reactor refuses peers that do not advertise it — they
+# cannot decode the chain's blocks (docs/aggregate_commits.md).
+# Ed25519 chains ignore it entirely; compatible_with is unchanged.
+FEATURE_AGG_COMMIT = "aggcommit/1"
 
 # below this many txs the compact form saves almost nothing over the
 # single part it replaces, and the reconstruct round trip only adds
@@ -194,6 +201,24 @@ class VoteBatchMessage:
     TYPE = "vote_batch"
 
 
+@dataclass
+class AggregateCommitMessage:
+    """Catchup on an aggregate-commit chain: the stored commit for a
+    lagging peer's height is ONE aggregate signature + signer bitmap,
+    so individual precommit votes cannot be reconstructed and gossiped
+    — the aggregate itself is shipped instead and injected as the
+    height's +2/3 precommit evidence after verification
+    (docs/aggregate_commits.md).  WAL'd like a vote: replay re-verifies
+    and re-injects it."""
+    commit: object                 # types.commit.AggregateCommit
+
+    TYPE = "aggregate_commit"
+
+    def to_wal(self) -> dict:
+        return {"type": self.TYPE,
+                "commit": jsonify(self.commit.to_proto())}
+
+
 def make_compact_block(height: int, round_: int, block,
                        part_set_header) -> CompactBlockPartMessage:
     """Build the compact form from a complete proposal block."""
@@ -235,6 +260,10 @@ def message_from_wal(d: dict):
             part=Part.from_proto(dejsonify(d["part"])))
     if t == VoteMessage.TYPE:
         return VoteMessage(Vote.from_proto(dejsonify(d["vote"])))
+    if t == AggregateCommitMessage.TYPE:
+        from ..types.commit import AggregateCommit
+        return AggregateCommitMessage(
+            AggregateCommit.from_proto(dejsonify(d["commit"])))
     raise ValueError(f"unknown WAL message type {t!r}")
 
 
@@ -320,6 +349,8 @@ def encode_p2p(msg) -> bytes:
     elif isinstance(msg, VoteBatchMessage):
         d = {"vote_batch": {
             "votes": [v.to_proto() for v in msg.votes]}}
+    elif isinstance(msg, AggregateCommitMessage):
+        d = {"aggregate_commit": {"commit": msg.commit.to_proto()}}
     else:
         raise ValueError(f"cannot encode message {type(msg)}")
     return encode(consensus_pb.MESSAGE, d)
@@ -409,4 +440,8 @@ def decode_p2p(raw: bytes):
         return VoteBatchMessage(
             votes=[Vote.from_proto(v)
                    for v in d["vote_batch"].get("votes", [])])
+    if "aggregate_commit" in d:
+        from ..types.commit import AggregateCommit
+        return AggregateCommitMessage(AggregateCommit.from_proto(
+            d["aggregate_commit"].get("commit") or {}))
     raise ValueError(f"unknown consensus message {sorted(d)}")

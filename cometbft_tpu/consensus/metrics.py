@@ -222,17 +222,30 @@ class Metrics:
             self.validators.set(current_validators.size())
             self.validators_power.set(
                 current_validators.total_voting_power())
-        if last_validators is not None and block.last_commit and \
-                block.last_commit.signatures:
-            from ..types.commit import BLOCK_ID_FLAG_ABSENT
+        lc = block.last_commit
+        if last_validators is not None and lc is not None and lc.size():
+            from ..types.commit import AggregateCommit
             missing = 0
             missing_power = 0
-            for i, sig in enumerate(block.last_commit.signatures):
-                if sig.block_id_flag == BLOCK_ID_FLAG_ABSENT and \
-                        i < last_validators.size():
-                    missing += 1
-                    missing_power += \
-                        last_validators.validators[i].voting_power
+            if isinstance(lc, AggregateCommit):
+                # aggregate form: unset signer bits are "missing"
+                # (nil votes are indistinguishable from absence —
+                # both are excluded from the bitmap); complement walk
+                # keeps this O(absent), not O(n) bignum shifts
+                nvals = last_validators.size()
+                for i in lc.signers.not_().true_indices():
+                    if i < nvals:
+                        missing += 1
+                        missing_power += \
+                            last_validators.validators[i].voting_power
+            else:
+                from ..types.commit import BLOCK_ID_FLAG_ABSENT
+                for i, sig in enumerate(lc.signatures):
+                    if sig.block_id_flag == BLOCK_ID_FLAG_ABSENT and \
+                            i < last_validators.size():
+                        missing += 1
+                        missing_power += \
+                            last_validators.validators[i].voting_power
             self.missing_validators.set(missing)
             self.missing_validators_power.set(missing_power)
         byz = 0

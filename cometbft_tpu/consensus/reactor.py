@@ -21,8 +21,10 @@ from ..p2p.switch import Peer, Reactor
 from ..types import canonical
 from ..types.block_id import BlockID
 from ..types.part_set import PartSetHeader
+from ..types.commit import AggregateCommit
 from .messages import (
-    COMPACT_MIN_TXS, FEATURE_COMPACT_BLOCKS, FEATURE_VOTE_BATCH,
+    COMPACT_MIN_TXS, FEATURE_AGG_COMMIT, FEATURE_COMPACT_BLOCKS,
+    FEATURE_VOTE_BATCH, AggregateCommitMessage,
     BlockPartMessage, CompactBlockNackMessage,
     CompactBlockPartMessage, HasProposalBlockPartMessage,
     HasVoteMessage, NewRoundStepMessage, NewValidBlockMessage,
@@ -89,6 +91,12 @@ class PeerState:
         # it provably holds the complete block, so no routine should
         # push parts at it even before its part bitmap says so
         self.full_block_hr: Optional[tuple] = None
+        # aggregate-commit catchup: the height we last shipped this
+        # peer an AggregateCommitMessage for, and when (monotonic) —
+        # one aggregate replaces the whole per-vote catchup stream,
+        # so resends are purely a lost-message safety net
+        self.agg_commit_sent_height: int = 0
+        self.agg_commit_sent_at: float = 0.0
 
     # -- compact-block seam (single-writer transition methods) ------
     def mark_compact_sent(self, height: int, round_: int,
@@ -309,7 +317,37 @@ class ConsensusReactor(Reactor):
             feats.append(FEATURE_COMPACT_BLOCKS)
         if getattr(self.cs.config, "vote_batch_max", 0) > 0:
             feats.append(FEATURE_VOTE_BATCH)
+        if getattr(self.cs.config, "aggregate_commits_wire", True):
+            feats.append(FEATURE_AGG_COMMIT)
         return feats
+
+    def _chain_uses_aggregate_commits(self) -> bool:
+        """True once the chain is AT the aggregate-commit activation
+        point — the next height's commit will be an AggregateCommit,
+        so blocks/catchup from here on carry wire arms a peer without
+        aggcommit/1 cannot decode.  An enable height scheduled far in
+        the future (param update) does NOT refuse peers early: every
+        existing block is still per-signature and fully parseable;
+        such peers are re-checked at activation by the gossip loop."""
+        sm = self.cs.sm_state
+        if sm is None:
+            return False
+        h = sm.consensus_params.feature.aggregate_commit_enable_height
+        return h > 0 and sm.last_block_height + 1 >= h
+
+    def _refuse_no_aggcommit(self, peer: Peer, when: str) -> None:
+        """Drop a peer that lacks aggcommit/1 on an active
+        aggregate-commit chain (shared by admission-time screening in
+        add_peer and the activation re-check in the gossip loop)."""
+        self.logger.error(
+            "peer lacks aggcommit/1 on an aggregate-commit chain; "
+            "dropping", peer=peer.id[:12], when=when)
+        if self.switch is not None:
+            self.supervisor.spawn(
+                lambda: self.switch.stop_peer(
+                    peer, "incompatible: no aggcommit/1"),
+                name=f"stop_peer:{peer.id[:12]}",
+                kind="stop_peer")
 
     def _peer_compact(self, peer: Peer) -> bool:
         if not getattr(self.cs.config, "compact_blocks", False):
@@ -325,6 +363,18 @@ class ConsensusReactor(Reactor):
 
     # ------------------------------------------------------------------
     async def add_peer(self, peer: Peer) -> None:
+        # once aggregation is ACTIVE a peer that cannot parse
+        # AggregateCommit wire arms cannot decode this chain's blocks
+        # — refuse it up front rather than let it choke on every
+        # block part (capability declared in the handshake like
+        # txrecon/compactblocks; ed25519 chains and pre-activation
+        # heights admit it, and the gossip loop re-checks at
+        # activation)
+        if self._chain_uses_aggregate_commits():
+            has = getattr(peer, "has_feature", None)
+            if not (has and has(FEATURE_AGG_COMMIT)):
+                self._refuse_no_aggcommit(peer, when="admission")
+                return
         ps = PeerState(peer)
         self._peer_states[peer.id] = ps
         peer.data["consensus_peer_state"] = ps
@@ -483,6 +533,13 @@ class ConsensusReactor(Reactor):
                 # batch size and defeat the p2p backpressure (the
                 # catchup-storm QueueFull crash the recon nemesis
                 # scenario caught); the state machine unpacks it
+                self.cs.send_peer(msg, peer.id)
+            elif isinstance(msg, AggregateCommitMessage):
+                # aggregate-commit catchup: verified and injected as
+                # +2/3 precommit evidence by the state machine
+                tracing.instant(tracing.CONSENSUS, "agg_commit_recv",
+                                height=msg.commit.height,
+                                peer=peer.id[:12])
                 self.cs.send_peer(msg, peer.id)
         elif chan_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage) and \
@@ -675,8 +732,17 @@ class ConsensusReactor(Reactor):
 
     async def _gossip_data_routine(self, ps: PeerState) -> None:
         peer = ps.peer
+        has = getattr(peer, "has_feature", None)
+        peer_agg = bool(has and has(FEATURE_AGG_COMMIT))
         try:
             while True:
+                # activation re-check: a peer admitted while the
+                # enable height was still in the future becomes
+                # incompatible the moment the chain reaches it
+                # (add_peer only screens peers arriving afterwards)
+                if not peer_agg and self._chain_uses_aggregate_commits():
+                    self._refuse_no_aggcommit(peer, when="activation")
+                    return
                 rs = self.cs.rs
                 prs = ps.prs
                 # send proposal block parts the peer is missing
@@ -841,7 +907,14 @@ class ConsensusReactor(Reactor):
                         prs.height >= self.cs.block_store.base):
                     commit = self.cs.block_store.load_block_commit(
                         prs.height)
-                    if commit is not None and \
+                    if isinstance(commit, AggregateCommit):
+                        # aggregate chain: individual votes cannot be
+                        # reconstructed — ship the aggregate itself
+                        # (once per peer height, resent after a
+                        # cooldown as a lost-message safety net)
+                        if self._send_aggregate_commit(ps, commit):
+                            continue
+                    elif commit is not None and \
                             self._pick_send_commit_vote(ps, commit):
                         continue
                 await asyncio.sleep(self._sleep_s)
@@ -936,6 +1009,24 @@ class ConsensusReactor(Reactor):
             for v in votes:
                 ps.set_has_vote(v.height, v.round, v.type,
                                 v.validator_index)
+            return True
+        return False
+
+    _AGG_COMMIT_RESEND_S = 2.0
+
+    def _send_aggregate_commit(self, ps: PeerState, commit) -> bool:
+        """Ship the stored AggregateCommit for the peer's height —
+        the catchup analogue of _pick_send_commit_vote on aggregate
+        chains (one message replaces the per-vote stream)."""
+        prs = ps.prs
+        now = time.monotonic()
+        if ps.agg_commit_sent_height == prs.height and \
+                now - ps.agg_commit_sent_at < self._AGG_COMMIT_RESEND_S:
+            return False
+        if ps.peer.send(VOTE_CHANNEL, encode_p2p(
+                AggregateCommitMessage(commit))):
+            ps.agg_commit_sent_height = prs.height
+            ps.agg_commit_sent_at = now
             return True
         return False
 

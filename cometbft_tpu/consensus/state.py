@@ -25,7 +25,7 @@ from ..state.validation import BlockValidationError
 from ..types import canonical
 from ..types.block import Block
 from ..types.block_id import BlockID
-from ..types.commit import Commit, ExtendedCommit
+from ..types.commit import AggregateCommit, Commit, ExtendedCommit
 from ..types.events import EventBus, NopEventBus
 from ..types.params import MAX_BLOCK_SIZE_BYTES, BLOCK_PART_SIZE_BYTES
 from ..types.part_set import PartSet, PartSetError, PartSetHeader
@@ -38,9 +38,9 @@ from ..types.vote_set import ConflictingVoteError, VoteSet, VoteSetError
 from ..wire import pb, decode
 from .height_vote_set import HeightVoteSet, HeightVoteSetError
 from .messages import (
-    COMPACT_MIN_TXS, BlockPartMessage, CompactBlockPartMessage,
-    ProposalMessage, VoteBatchMessage, VoteMessage,
-    reconstruct_block_bytes,
+    COMPACT_MIN_TXS, AggregateCommitMessage, BlockPartMessage,
+    CompactBlockPartMessage, ProposalMessage, VoteBatchMessage,
+    VoteMessage, reconstruct_block_bytes,
 )
 from .adaptive import AdaptiveTimeouts
 from .round_state import (
@@ -141,6 +141,15 @@ class ConsensusState:
         self._stopped = asyncio.Event()
         self.n_steps = 0
         self.replay_mode = False
+        # peers that sent a provably-invalid aggregate catchup commit
+        # (each costs an O(n) pubkey sum + pairing to reject — see
+        # _try_add_aggregate_commit).  Peer ids are attacker-minted
+        # (fresh node key per reconnect), so this is a bounded
+        # insertion-ordered dict with oldest-evicted, not a grow-only
+        # set — an id churner gets one wasted verification per
+        # identity either way, without growing memory
+        self._agg_commit_forgers: dict = {}
+        self._agg_commit_forgers_max = 1024
         # flight recorder: (height, round, step, t0_ns) of the step in
         # progress — closed into a span when the next step begins
         self._trace_step: Optional[tuple] = None
@@ -414,6 +423,13 @@ class ConsensusState:
             except (VoteSetError, HeightVoteSetError, VoteError) as e:
                 self.logger.error("failed to add vote", err=str(e),
                                   peer=peer_id)
+        elif isinstance(msg, AggregateCommitMessage):
+            try:
+                await self._try_add_aggregate_commit(msg.commit,
+                                                     peer_id)
+            except ConsensusError as e:
+                self.logger.error("failed to add aggregate commit",
+                                  err=str(e), peer=peer_id)
         else:
             self.logger.error(f"unknown msg type {type(msg)}")
 
@@ -542,13 +558,19 @@ class ConsensusState:
             self.rs.last_commit = self._vote_set_from_commit(state, sc)
 
     def _vote_set_from_commit(self, state: SMState,
-                              commit: Commit) -> VoteSet:
+                              commit) -> VoteSet:
         """Reference: types Commit.ToVoteSet.  Votes are constructed
         once and shared between the advisory batch pre-verification
         and the serial tally: each vote marshals its sign bytes a
         single time (the per-object memo), and VoteSet.add_vote's
         signature checks hit the verified-triple memo — one batched
-        dispatch instead of per-signature verification."""
+        dispatch instead of per-signature verification.
+
+        An AggregateCommit seen commit (blocksync'd node joining
+        consensus) has no per-vote signatures to reconstruct: the
+        vote set is restored as an aggregate-backed shell that proves
+        the majority and re-proposes the stored aggregate
+        (VoteSet.from_aggregate_commit)."""
         try:
             vals = self.block_exec.store.load_validators(commit.height)
         except Exception:
@@ -557,6 +579,9 @@ class ConsensusState:
                 "state.last_validators", height=commit.height,
                 exc_info=True)
             vals = state.last_validators
+        if isinstance(commit, AggregateCommit):
+            return VoteSet.from_aggregate_commit(
+                state.chain_id, commit, vals)
         votes = [commit.get_vote(i)
                  for i, cs in enumerate(commit.signatures)
                  if not cs.absent_flag()]
@@ -827,10 +852,14 @@ class ConsensusState:
                 "for the previous block")
             return None
         proposer_addr = self.priv_validator_pub_key.address()
+        # restart-from-aggregate: no per-vote signatures exist, so the
+        # stored aggregate rides through to the block unchanged
+        last_agg = getattr(rs.last_commit, "stored_aggregate_commit",
+                           None) if rs.last_commit is not None else None
         try:
             return await self.block_exec.create_proposal_block(
                 rs.height, self.sm_state, last_ext_commit,
-                proposer_addr)
+                proposer_addr, last_aggregate_commit=last_agg)
         except Exception as e:
             self.logger.error("unable to create proposal block",
                               err=str(e))
@@ -1344,17 +1373,28 @@ class ConsensusState:
         with tracing.span(tracing.CONSENSUS, "save_block",
                           height=height):
             if self.block_store.height < block.header.height:
-                seen_ext = rs.votes.precommits(rs.commit_round) \
-                    .make_extended_commit(
-                        self.sm_state.consensus_params.feature
-                        .vote_extensions_enable_height)
+                precommits = rs.votes.precommits(rs.commit_round)
+                seen_ext = precommits.make_extended_commit(
+                    self.sm_state.consensus_params.feature
+                    .vote_extensions_enable_height)
                 if self.sm_state.consensus_params.feature \
                         .vote_extensions_enabled(block.header.height):
                     self.block_store.save_block_with_extended_commit(
                         block, block_parts, seen_ext)
                 else:
+                    seen = seen_ext.to_commit()
+                    # a height decided by an injected/restored
+                    # aggregate (catchup) may hold sub-quorum live
+                    # votes: persist the VERIFIED aggregate instead,
+                    # or restart reconstruction would restore a
+                    # majority-less vote set that cannot re-propose
+                    agg_seen = precommits.stored_aggregate_commit
+                    if agg_seen is not None and \
+                            not precommits \
+                            .has_two_thirds_votes_for_maj23():
+                        seen = agg_seen
                     self.block_store.save_block(block, block_parts,
-                                                seen_ext.to_commit())
+                                                seen)
 
         fail.fail()    # crash point: block saved, WAL barrier not yet
                        # written (state.go:1889)
@@ -1556,6 +1596,57 @@ class ConsensusState:
                              height=vote.height)
             return False
 
+    async def _try_add_aggregate_commit(self, agg,
+                                        peer_id: str) -> bool:
+        """Catchup ingestion on an aggregate-commit chain: a verified
+        AggregateCommit for the CURRENT height is this height's +2/3
+        precommit evidence — individual votes cannot be reconstructed
+        from peers' stores, so the aggregate stands in for them
+        (docs/aggregate_commits.md).  The block parts still arrive via
+        normal data gossip; entering commit here lets the existing
+        parts-complete path finalize."""
+        from ..types import validation as types_validation
+        rs = self.rs
+        if not isinstance(agg, AggregateCommit):
+            return False
+        if self.sm_state is None or \
+                not self.sm_state.consensus_params.feature \
+                .aggregate_commits_enabled(agg.height):
+            return False
+        if agg.height != rs.height or rs.step >= STEP_COMMIT:
+            return False
+        # forgery containment: verifying an aggregate costs a G1
+        # point-sum + pairing (~10 ms at 10k validators), so a peer
+        # that ever sent an invalid one — honest peers never do, they
+        # verified the commit before storing it — loses this channel
+        # (until evicted from the bounded forger table).  Bounds the
+        # attack at one wasted verification per peer identity.
+        if peer_id and peer_id in self._agg_commit_forgers:
+            return False
+        try:
+            types_validation.verify_commit(
+                self.sm_state.chain_id, rs.validators, agg.block_id,
+                agg.height, agg)
+        except types_validation.VerificationError as e:
+            self.logger.error("invalid aggregate catchup commit",
+                              err=str(e), peer=peer_id)
+            if peer_id:
+                forgers = self._agg_commit_forgers
+                forgers[peer_id] = True
+                if len(forgers) > self._agg_commit_forgers_max:
+                    del forgers[next(iter(forgers))]
+            return False
+        precommits = rs.votes.precommits(agg.round)
+        if precommits is None:
+            # the chain decided at a round we never reached locally
+            rs.votes.ensure_round_tracked(agg.round)
+            precommits = rs.votes.precommits(agg.round)
+        if precommits is None or \
+                not precommits.inject_aggregate_majority(agg):
+            return False
+        await self._enter_commit(rs.height, agg.round)
+        return True
+
     async def _add_vote(self, vote: Vote, peer_id: str) -> bool:
         """Reference: addVote (:2299)."""
         rs = self.rs
@@ -1711,8 +1802,18 @@ class ConsensusState:
     # ==================================================================
     # vote signing
 
-    def _vote_time(self, height: int) -> Timestamp:
-        """Reference: voteTime (:2578) — BFT time floor unless PBTS."""
+    def _vote_time(self, height: int, msg_type: int = 0) -> Timestamp:
+        """Reference: voteTime (:2578) — BFT time floor unless PBTS.
+
+        Aggregate-commit mode zeroes the PRECOMMIT timestamp: every
+        for-block precommit must sign the one canonical zero-timestamp
+        message so the BLS signatures sum into a single aggregate
+        (docs/aggregate_commits.md; params validation guarantees PBTS,
+        so no consumer needs per-vote timestamps)."""
+        if msg_type == canonical.PRECOMMIT_TYPE and \
+                self.sm_state.consensus_params.feature \
+                .aggregate_commits_enabled(height):
+            return Timestamp.zero()
         if self._pbts_enabled(height):
             return Timestamp.now()
         now = Timestamp.now()
@@ -1762,7 +1863,7 @@ class ConsensusState:
             height=rs.height,
             round=rs.round,
             block_id=BlockID(hash=hash_, part_set_header=psh),
-            timestamp=self._vote_time(rs.height),
+            timestamp=self._vote_time(rs.height, msg_type),
             validator_address=addr,
             validator_index=val_idx,
         )

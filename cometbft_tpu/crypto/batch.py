@@ -33,12 +33,24 @@ from .keys import BatchVerifier, PubKey
 PAD_BUCKETS = (64, 1024, 4096, 10240, 16384)
 
 _VERIFY_HIST = None
+_PAD_BUCKET_FN = None
+
+
+def register_pad_bucket_fn(fn) -> None:
+    """ops/ed25519_jax registers its live _bucket on import so label
+    values track measured bucket refinement (the kernel ladder can
+    grow finer buckets at runtime; this module must not import the
+    jax stack at process start to find out)."""
+    global _PAD_BUCKET_FN
+    _PAD_BUCKET_FN = fn
 
 
 def pad_bucket(n: int) -> int:
     """The padded lane count a batch of n signatures dispatches at
     (mirrors ops/ed25519_jax._bucket; asserted equal in
     tests/test_metrics_contract.py)."""
+    if _PAD_BUCKET_FN is not None:
+        return _PAD_BUCKET_FN(n)
     for b in PAD_BUCKETS:
         if n <= b:
             return b

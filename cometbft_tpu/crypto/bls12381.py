@@ -22,11 +22,11 @@ from __future__ import annotations
 import hashlib
 import hmac
 import secrets
-from typing import Sequence
+from typing import Optional, Sequence
 
 from . import _bls12381_math as m
 from . import tmhash
-from .keys import BatchVerifier, PrivKey, PubKey
+from .keys import BatchVerifier, PrivKey, PubKey, bisect_bad
 
 KEY_TYPE = "bls12_381"
 PRIV_KEY_SIZE = 32
@@ -191,13 +191,69 @@ def aggregate_signatures(sigs: Sequence[bytes]) -> bytes:
     """Sum compressed-G2 signatures; raises on any invalid input."""
     if not sigs:
         raise ValueError("no signatures to aggregate")
-    acc = None
+    pts = []
     for sig in sigs:
         pt = _parse_signature(sig)
         if pt is False:
             raise ValueError("invalid signature in aggregate")
-        acc = m.pt_add(m.G2_OPS, acc, pt)
-    return m.g2_compress(acc)
+        pts.append(pt)
+    return m.g2_compress(m.pt_sum(m.G2_OPS, pts))
+
+
+# the name the aggregate-commit layer uses (ISSUE 13); same operation
+aggregate = aggregate_signatures
+
+
+def aggregate_pub_keys(
+        pub_keys: Sequence[Bls12381PubKey]) -> Bls12381PubKey:
+    """Sum already-validated pubkeys into one aggregate key.
+
+    This is the only O(n) residue of aggregate-commit verification —
+    G1 point *adds*, not pairings — and it runs through the native
+    batched-inversion tree (~10 ms at 10k keys).  The result may not
+    itself pass KeyValidate (a sum can in principle be infinity), so
+    it is wrapped unchecked; verify_aggregate rejects an infinite
+    aggregate key."""
+    if not pub_keys:
+        raise ValueError("no pubkeys to aggregate")
+    # a pubkey's stored serialization IS the raw x||y be48 layout the
+    # sum consumes (validated non-infinity at construction)
+    return aggregate_pub_keys_raw(
+        b"".join(pk.bytes() for pk in pub_keys))
+
+
+def aggregate_pub_keys_raw(blob: bytes) -> Bls12381PubKey:
+    """Sum pubkeys given as concatenated 96-byte raw serializations
+    (the layout Bls12381PubKey.bytes() stores) — the zero-copy form
+    of aggregate_pub_keys for callers that keep a raw table."""
+    if not blob:
+        raise ValueError("no pubkeys to aggregate")
+    native = m._native()
+    if native is not None:
+        return Bls12381PubKey._from_point_unchecked(
+            m._g1_unraw(native.bls_g1_sum(blob)))
+    pts = [m._g1_unraw(blob[i:i + 96])
+           for i in range(0, len(blob), 96)]
+    return Bls12381PubKey._from_point_unchecked(
+        m.pt_sum(m.G1_OPS, pts))
+
+
+def verify_aggregate(agg_pub_key: Bls12381PubKey, msg: bytes,
+                     agg_sig: bytes) -> bool:
+    """O(1) verification of an aggregate signature over ONE shared
+    message: e(agg_pk, H(m)) == e(G1, agg_sig) — 2 Miller loops + one
+    final exponentiation regardless of how many signers were summed
+    into agg_pk.  The aggregate-commit verify path (types/validation)
+    lands here after the cached G1 pubkey sum."""
+    pk_pt = agg_pub_key.point()
+    if pk_pt is None:
+        return False        # infinite aggregate key never verifies
+    sig_pt = _parse_signature(agg_sig)
+    if sig_pt is False or sig_pt is None:
+        return False
+    hm = m.hash_to_g2(msg, DST)
+    return m.pairings_product_is_one(
+        [(pk_pt, hm), (m.pt_neg(m.G1_OPS, m.G1_GEN), sig_pt)])
 
 
 def fast_aggregate_verify(pub_keys: Sequence[Bls12381PubKey], msg: bytes,
@@ -210,12 +266,90 @@ def fast_aggregate_verify(pub_keys: Sequence[Bls12381PubKey], msg: bytes,
     sig_pt = _parse_signature(sig)
     if sig_pt is False or sig_pt is None:
         return False
-    agg = None
-    for pk in pub_keys:
-        agg = m.pt_add(m.G1_OPS, agg, pk.point())
+    agg = m.pt_sum(m.G1_OPS, [pk.point() for pk in pub_keys])
+    if agg is None:
+        return False
     hm = m.hash_to_g2(msg, DST)
     return m.pairings_product_is_one(
         [(agg, hm), (m.pt_neg(m.G1_OPS, m.G1_GEN), sig_pt)])
+
+
+# --- aggregate-pubkey cache -------------------------------------------------
+# Stable validator sets re-verify aggregate commits with the SAME
+# (valset, signer bitmap) over and over — one cache hit skips the G1
+# point-sum entirely, leaving the constant 2-Miller-loop pairing as
+# the whole cost of commit verification (docs/aggregate_commits.md).
+
+_AGG_PK_METRICS = None
+
+
+def _agg_pk_metrics():
+    global _AGG_PK_METRICS
+    if _AGG_PK_METRICS is None:
+        from ..libs import metrics as libmetrics
+        me = libmetrics.DEFAULT
+        _AGG_PK_METRICS = (
+            me.counter("crypto", "agg_pubkey_cache_hits",
+                       "Aggregate-pubkey cache hits (G1 point-sum "
+                       "skipped)."),
+            me.counter("crypto", "agg_pubkey_cache_misses",
+                       "Aggregate-pubkey cache misses (G1 point-sum "
+                       "performed)."),
+            me.counter("crypto", "agg_pubkey_cache_evictions",
+                       "Aggregate-pubkey cache LRU evictions."),
+        )
+    return _AGG_PK_METRICS
+
+
+class AggregatePubKeyCache:
+    """LRU of aggregate pubkeys keyed (valset_hash, signer_bitmap).
+
+    The key binds the SUM to the exact validator set revision and
+    signer subset — a validator-set change rotates valset_hash, so
+    stale sums can never serve a new set."""
+
+    def __init__(self, capacity: int = 64):
+        from collections import OrderedDict
+        self.capacity = max(1, capacity)
+        self._m: "OrderedDict[tuple[bytes, bytes], Bls12381PubKey]" = \
+            OrderedDict()
+
+    def get(self, valset_hash: bytes,
+            signer_bitmap: bytes) -> Optional[Bls12381PubKey]:
+        hits, misses, _ = _agg_pk_metrics()
+        key = (valset_hash, signer_bitmap)
+        pk = self._m.get(key)
+        if pk is not None:
+            self._m.move_to_end(key)
+            hits.add()
+        else:
+            misses.add()
+        return pk
+
+    def put(self, valset_hash: bytes, signer_bitmap: bytes,
+            pk: Bls12381PubKey) -> None:
+        """Callers insert only AFTER the aggregate signature verified
+        against this sum — a stream of forged (bitmap, signature)
+        pairs must not be able to evict the honest entries."""
+        self._m[(valset_hash, signer_bitmap)] = pk
+        if len(self._m) > self.capacity:
+            self._m.popitem(last=False)
+            _agg_pk_metrics()[2].add()
+
+    def __len__(self) -> int:
+        return len(self._m)
+
+
+_AGG_PK_CACHE: Optional[AggregatePubKeyCache] = None
+
+
+def aggregate_pubkey_cache() -> AggregatePubKeyCache:
+    """Process-global cache instance (the verify paths have no node
+    context — same pattern as the signature cache metrics)."""
+    global _AGG_PK_CACHE
+    if _AGG_PK_CACHE is None:
+        _AGG_PK_CACHE = AggregatePubKeyCache()
+    return _AGG_PK_CACHE
 
 
 class Bls12381BatchVerifier(BatchVerifier):
@@ -255,22 +389,44 @@ class Bls12381BatchVerifier(BatchVerifier):
             pt = _parse_signature(sig)
             parsed.append(None if pt is False or pt is None else pt)
         if n >= 2 and all(pt is not None for pt in parsed):
-            pairs = []
-            agg_zsig = None
-            for (pk, msg, _), sig_pt in zip(self._items, parsed):
-                z = 1 | secrets.randbits(128)
-                pairs.append((m.pt_mul(m.G1_OPS, pk.point(), z),
-                              m.hash_to_g2(msg, DST)))
-                agg_zsig = m.pt_add(
-                    m.G2_OPS, agg_zsig, m.pt_mul(m.G2_OPS, sig_pt, z))
-            if agg_zsig is not None:
-                pairs.append((m.pt_neg(m.G1_OPS, m.G1_GEN), agg_zsig))
-                if m.pairings_product_is_one(pairs):
-                    return True, [True] * n
-        # batch rejected (or degenerate): identify per signature
+            if self._rlc_holds(range(n), parsed):
+                return True, [True] * n
+            # batch rejected: bisect — re-run the RLC product on each
+            # half and descend only into failing halves, so a commit
+            # with k byzantine signatures costs O(k log n) subset
+            # products instead of n full 2-pairing verifications
+            # (every byzantine-sig commit used to re-verify the WHOLE
+            # group per signature)
+            mask = [True] * n
+            bisect_bad(
+                list(range(n)), mask,
+                lambda half: self._rlc_holds(half, parsed),
+                lambda i: self._items[i][0].verify_signature(
+                    self._items[i][1], self._items[i][2]))
+            return all(mask), mask
+        # degenerate (singleton / malformed sigs): per signature
         mask = [pk.verify_signature(msg, sig)
                 for pk, msg, sig in self._items]
         return all(mask), mask
+
+    def _rlc_holds(self, idxs, parsed) -> bool:
+        """The random-linear-combination pairings product over a
+        subset of items: fresh 128-bit randomizers every call, so a
+        subset that only passed by randomizer collision upstream
+        cannot keep passing down the bisection."""
+        pairs = []
+        zsigs = []
+        for i in idxs:
+            pk, msg, _ = self._items[i]
+            z = 1 | secrets.randbits(128)
+            pairs.append((m.pt_mul(m.G1_OPS, pk.point(), z),
+                          m.hash_to_g2(msg, DST)))
+            zsigs.append(m.pt_mul(m.G2_OPS, parsed[i], z))
+        agg_zsig = m.pt_sum(m.G2_OPS, zsigs)
+        if agg_zsig is None:
+            return False
+        pairs.append((m.pt_neg(m.G1_OPS, m.G1_GEN), agg_zsig))
+        return m.pairings_product_is_one(pairs)
 
 
 def aggregate_verify(pub_keys: Sequence[Bls12381PubKey],

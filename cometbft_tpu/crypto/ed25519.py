@@ -42,7 +42,7 @@ except ImportError:
     Ed25519PrivateKey = Ed25519PublicKey = None  # type: ignore[assignment]
 
 from . import _ed25519_ref as ref
-from .keys import BatchVerifier, PrivKey, PubKey, address_hash
+from .keys import BatchVerifier, PrivKey, PubKey, address_hash, bisect_bad
 
 KEY_TYPE = "ed25519"
 PUB_KEY_SIZE = 32
@@ -208,14 +208,30 @@ class CpuBatchVerifier(BatchVerifier):
             native = _native_msm()
             if native is not None:
                 raw = [(pk.bytes(), m, s) for pk, m, s in self._items]
-                z = secrets.token_bytes(16 * n)
                 try:
-                    if native.ed25519_batch_verify(raw, z):
+                    if self._batch_holds(native, raw):
                         return True, [True] * n
+                    # batch rejected: bisect with the native batch
+                    # equation (fresh randomizers per subset) so k bad
+                    # signatures cost O(k log n) subset checks, not a
+                    # whole-group per-signature sweep
+                    mask = [True] * n
+                    bisect_bad(
+                        list(range(n)), mask,
+                        lambda half: self._batch_holds(
+                            native, [raw[i] for i in half]),
+                        lambda i: self._items[i][0].verify_signature(
+                            self._items[i][1], self._items[i][2]))
+                    return all(mask), mask
                 except Exception:
                     pass    # malformed shapes fall through per-sig
         per = [pk.verify_signature(m, s) for pk, m, s in self._items]
         return all(per), per
+
+    @staticmethod
+    def _batch_holds(native, raw) -> bool:
+        z = secrets.token_bytes(16 * len(raw))
+        return bool(native.ed25519_batch_verify(raw, z))
 
 
 _NATIVE_MSM = False         # False = unprobed, None = unavailable

@@ -68,3 +68,25 @@ class BatchVerifier(abc.ABC):
 
     @abc.abstractmethod
     def verify(self) -> tuple[bool, Sequence[bool]]: ...
+
+
+def bisect_bad(idxs: list, mask: list, subset_holds, verify_one) -> None:
+    """Shared batch-reject bisection (ed25519 CPU batch + BLS RLC):
+    ``idxs`` is a subset whose batch equation already failed — split,
+    re-check each half with ``subset_holds(half_idxs)`` (which MUST
+    draw fresh randomizers per call, so a subset that only passed by
+    randomizer collision upstream cannot keep passing down the
+    bisection), and descend only into failing halves; k bad
+    signatures cost O(k log n) subset checks instead of a whole-group
+    per-signature sweep.  A failing singleton goes straight to
+    ``verify_one(i)`` — running the subset equation on one item first
+    would pay the full batch-check cost to learn what the exact check
+    answers anyway.  ``mask[i]`` is cleared for each bad item."""
+    if len(idxs) == 1:
+        i = idxs[0]
+        mask[i] = verify_one(i)
+        return
+    mid = len(idxs) // 2
+    for half in (idxs[:mid], idxs[mid:]):
+        if len(half) == 1 or not subset_holds(half):
+            bisect_bad(half, mask, subset_holds, verify_one)

@@ -140,7 +140,8 @@ class EvidencePool:
                 verify_commit_light_trusting(
                     chain_id, common_vals,
                     conflicting.signed_header.commit,
-                    Fraction(1, 3), count_all_signatures=True)
+                    Fraction(1, 3), count_all_signatures=True,
+                    signer_vals=conflicting.validator_set)
             elif ev.conflicting_header_is_invalid(
                     trusted_header.header):
                 raise EvidenceError(

@@ -10,6 +10,11 @@ import random
 from typing import Optional
 
 
+# bit positions set in each byte value (true_indices fast path)
+_BYTE_BITS = tuple(tuple(i for i in range(8) if b >> i & 1)
+                   for b in range(256))
+
+
 class BitArray:
     __slots__ = ("bits", "_elems")
 
@@ -79,13 +84,28 @@ class BitArray:
         return self.bits > 0 and self._elems == (1 << self.bits) - 1
 
     def true_indices(self) -> list[int]:
-        e, out, i = self._elems, [], 0
-        while e:
-            if e & 1:
-                out.append(i)
-            e >>= 1
-            i += 1
+        # one to_bytes + per-byte table walk: the bit-shift and
+        # lowest-set-bit loops are both O(bits^2/64) on big dense
+        # ints (every shift/xor rewrites the whole bignum) —
+        # aggregate-commit bitmaps hit this at 10k validators per
+        # verification
+        e = self._elems
+        if not e:
+            return []
+        out: list[int] = []
+        for base, byte in enumerate(
+                e.to_bytes((self.bits + 7) // 8, "little")):
+            if byte:
+                start = base * 8
+                out.extend(start + i for i in _BYTE_BITS[byte])
         return out
+
+    def popcount(self) -> int:
+        return bin(self._elems).count("1")
+
+    def highest_true_index(self) -> int:
+        """Index of the highest set bit, or -1 when empty."""
+        return self._elems.bit_length() - 1
 
     def pick_random(self) -> Optional[int]:
         """A uniformly random true index, or None (reference: PickRandom)."""
@@ -107,6 +127,30 @@ class BitArray:
         s = "".join("x" if self.get_index(i) else "_"
                     for i in range(self.bits))
         return f"BA{{{self.bits}:{s}}}"
+
+    def to_le_bytes(self) -> bytes:
+        """Canonical little-endian packing: (bits+7)//8 bytes, byte i
+        bit j = index 8i+j, padding bits zero (the aggregate-commit
+        signer-bitmap wire layout)."""
+        return self._elems.to_bytes((self.bits + 7) // 8, "little")
+
+    @classmethod
+    def from_le_bytes(cls, raw: bytes, bits: int) -> "BitArray":
+        """Inverse of to_le_bytes; rejects non-canonical input (wrong
+        length or padding bits set) so two wire encodings can never
+        decode to one value."""
+        if bits < 0:
+            raise ValueError("negative bits")
+        if len(raw) != (bits + 7) // 8:
+            raise ValueError(
+                f"bitmap length {len(raw)} != canonical "
+                f"{(bits + 7) // 8} for {bits} bits")
+        elems = int.from_bytes(raw, "little")
+        if elems >> bits:
+            raise ValueError("bitmap has padding bits set")
+        ba = cls(bits)
+        ba._elems = elems
+        return ba
 
     def to_proto(self) -> dict:
         # libs/bits proto: {bits: int64, elems: repeated uint64}

@@ -132,11 +132,14 @@ def verify_non_adjacent(trusted_header: SignedHeader,
 
     if cache is None:
         cache = SignatureCache()
-    # 1/3+ of the trusted valset must have signed the new commit
+    # 1/3+ of the trusted valset must have signed the new commit.
+    # For an aggregate commit the signer bitmap indexes the NEW
+    # valset (hash-checked above), so it rides along as signer_vals.
     try:
         verify_commit_light_trusting(
             trusted_header.header.chain_id, trusted_vals,
-            untrusted_header.commit, trust_level, cache=cache)
+            untrusted_header.commit, trust_level, cache=cache,
+            signer_vals=untrusted_vals)
     except NotEnoughVotingPowerError as e:
         raise NewValSetCantBeTrustedError(str(e)) from e
     except VerificationError as e:

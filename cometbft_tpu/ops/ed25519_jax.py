@@ -278,7 +278,8 @@ _jit_verify = jax.jit(_verify_kernel)
 
 # --- host orchestration -----------------------------------------------------
 
-_BUCKETS = [64, 1024, 4096, 10240, 16384]
+_BASE_BUCKETS = (64, 1024, 4096, 10240, 16384)
+_BUCKETS = list(_BASE_BUCKETS)
 _IDENTITY_BYTES = bytes([1] + [0] * 31)     # compressed identity (y=1)
 _B_BYTES = ref.compress(ref.B)
 
@@ -288,6 +289,78 @@ def _bucket(n: int) -> int:
         if n <= b:
             return b
     return _BUCKETS[-1]
+
+
+# --- measured pad-bucket refinement -----------------------------------------
+# The base buckets have a 16x gap at the bottom (64 -> 1024): a 100-sig
+# commit pads 10x.  On the CPU/XLA path kernel cost scales with padded
+# lanes, so that gap is real wasted work — but each extra bucket costs
+# a fresh compile, so refinement must be earned by measurement, not
+# hardcoded.  The host_prep vs kernel_execute split (already observed
+# per dispatch into the metrics-v2 histogram) is the steering signal:
+# refine only when kernel_execute dominates host_prep for repeatedly
+# low-occupancy warm dispatches of a bucket (on a TPU the kernel is so
+# fast that padding costs ~nothing and host_prep dominates — no
+# refinement there).
+
+_REFINE_CANDIDATES = (128, 256, 512, 2048)
+_TUNE_MIN_SAMPLES = 8
+_TUNE_WINDOW = 64
+_tune_samples: dict[int, list] = {}     # bucket -> [(n, prep_s, exec_s)]
+_REFINED_COUNTER = None
+
+
+def reset_bucket_tuning() -> None:
+    """Test hook: drop refined buckets and samples."""
+    global _BUCKETS
+    _BUCKETS = list(_BASE_BUCKETS)
+    _tune_samples.clear()
+
+
+def _tune_record(n: int, m: int, prep_s: float, exec_s: float) -> None:
+    if os.environ.get("COMETBFT_TPU_BUCKET_TUNE", "1") == "0":
+        return
+    samples = _tune_samples.setdefault(m, [])
+    samples.append((n, prep_s, exec_s))
+    if len(samples) > _TUNE_WINDOW:
+        samples.pop(0)
+    lows = [s for s in samples if s[0] <= m // 2]
+    if len(lows) < _TUNE_MIN_SAMPLES:
+        return
+    lows_sorted = sorted(p for _, p, _ in lows)
+    execs_sorted = sorted(e for _, _, e in lows)
+    med_prep = lows_sorted[len(lows_sorted) // 2]
+    med_exec = execs_sorted[len(execs_sorted) // 2]
+    # host_prep-dominated (TPU shape): padding wastes almost nothing
+    if med_exec < 2 * med_prep:
+        return
+    target = max(s[0] for s in lows)
+    prev = 0
+    for b in _BUCKETS:
+        if b >= m:
+            break
+        prev = b
+    for cand in _REFINE_CANDIDATES:
+        if cand >= m or cand in _BUCKETS or cand < target or \
+                cand <= prev:
+            continue
+        _BUCKETS.append(cand)
+        _BUCKETS.sort()
+        samples.clear()
+        _refine_counter().add()
+        return
+
+
+def _refine_counter():
+    global _REFINED_COUNTER
+    if _REFINED_COUNTER is None:
+        from ..libs import metrics as libmetrics
+        _REFINED_COUNTER = libmetrics.DEFAULT.counter(
+            "crypto", "pad_bucket_refinements",
+            "Pad buckets inserted by the measured host_prep/"
+            "kernel_execute steering (small batches were "
+            "padding into oversized buckets).")
+    return _REFINED_COUNTER
 
 
 def _windows_u8(scalars: np.ndarray) -> np.ndarray:
@@ -413,6 +486,11 @@ def _verify_chunk(items) -> np.ndarray:
     hist.with_labels("host_prep", choice, str(m), w).observe(t1 - t0)
     hist.with_labels("kernel_execute", choice, str(m),
                      w).observe(t2 - t1)
+    if warm:
+        # only warm dispatches steer bucket refinement — a cold one
+        # includes trace+compile, which is exactly the cost refinement
+        # must NOT mistake for per-lane kernel work
+        _tune_record(n, m, t1 - t0, t2 - t1)
     _SEEN_SHAPES.add((choice, m))
     return out
 
@@ -619,3 +697,10 @@ class TpuBatchVerifier(BatchVerifier):
 
     def verify(self) -> tuple[bool, Sequence[bool]]:
         return verify_batch(self._items)
+
+
+# keep crypto/batch.pad_bucket in lockstep with the live (possibly
+# measurement-refined) bucket ladder — both label the same histograms
+from ..crypto import batch as _crypto_batch  # noqa: E402
+
+_crypto_batch.register_pad_bucket_fn(_bucket)

@@ -60,7 +60,24 @@ def header_from_json(d: dict) -> Header:
     )
 
 
-def commit_from_json(d: dict) -> Commit:
+def commit_from_json(d: dict):
+    if "aggregate_signature" in d:
+        # aggregate-commit chains (docs/aggregate_commits.md);
+        # non-canonical bitmaps fail at the parse boundary exactly as
+        # the proto decoder rejects them — a masked decode would hash
+        # differently from what the server sent
+        from ..libs.bits import BitArray
+        from ..types.commit import AggregateCommit
+        count = int(d.get("signer_count", 0))
+        ba = BitArray.from_le_bytes(
+            base64.b64decode(d.get("signers", "") or ""), count)
+        return AggregateCommit(
+            height=int(d.get("height", 0)),
+            round=int(d.get("round", 0)),
+            block_id=block_id_from_json(d.get("block_id") or {}),
+            signers=ba,
+            signature=base64.b64decode(
+                d.get("aggregate_signature", "")))
     sigs = []
     for s in d.get("signatures", []):
         sig = s.get("signature")

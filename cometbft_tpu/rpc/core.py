@@ -835,6 +835,18 @@ def _header_json(h) -> dict:
 
 
 def _commit_json(c) -> dict:
+    from ..types.commit import AggregateCommit
+    if isinstance(c, AggregateCommit):
+        # aggregate-commit chains (docs/aggregate_commits.md): one
+        # BLS signature + signer bitmap instead of per-val signatures
+        return {
+            "height": str(c.height), "round": c.round,
+            "block_id": _block_id_json(c.block_id),
+            "signer_count": c.size(),
+            "signers": base64.b64encode(c.signers_bytes()).decode(),
+            "aggregate_signature":
+                base64.b64encode(c.signature).decode(),
+        }
     return {
         "height": str(c.height), "round": c.round,
         "block_id": _block_id_json(c.block_id),

@@ -15,7 +15,7 @@ from ..libs import fail
 from ..libs.log import Logger, new_logger
 from ..types.block import Block
 from ..types.block_id import BlockID
-from ..types.commit import Commit, ExtendedCommit
+from ..types.commit import AggregateCommit, Commit, ExtendedCommit
 from ..types.events import EventBus, NopEventBus
 from ..types.params import MAX_BLOCK_SIZE_BYTES, ParamsError
 from ..types.tx import compute_proto_size_overhead
@@ -79,7 +79,11 @@ def tx_results_hash(tx_results: list[abci.ExecTxResult]) -> bytes:
 
 def build_last_commit_info(block: Block, last_val_set,
                            initial_height: int) -> abci.CommitInfo:
-    """Reference: state/execution.go BuildLastCommitInfo."""
+    """Reference: state/execution.go BuildLastCommitInfo.
+
+    An AggregateCommit reports COMMIT for every signer bit and ABSENT
+    otherwise (the aggregate form cannot distinguish nil votes from
+    absence — both are excluded from the bitmap)."""
     if block.header.height == initial_height:
         return abci.CommitInfo()
     commit = block.last_commit
@@ -88,6 +92,15 @@ def build_last_commit_info(block: Block, last_val_set,
             f"commit size {commit.size()} doesn't match valset length "
             f"{last_val_set.size()} at height {block.header.height}")
     votes = []
+    if isinstance(commit, AggregateCommit):
+        for i, val in enumerate(last_val_set.validators):
+            votes.append(abci.VoteInfo(
+                validator=abci.ABCIValidator(address=val.address,
+                                             power=val.voting_power),
+                block_id_flag=(BLOCK_ID_FLAG_COMMIT
+                               if commit.signers.get_index(i)
+                               else BLOCK_ID_FLAG_ABSENT)))
+        return abci.CommitInfo(round=commit.round, votes=votes)
     for i, cs in enumerate(commit.signatures):
         val = last_val_set.validators[i]
         votes.append(abci.VoteInfo(
@@ -213,8 +226,16 @@ class BlockExecutor:
     async def create_proposal_block(
             self, height: int, state: State,
             last_ext_commit: ExtendedCommit,
-            proposer_addr: bytes) -> Block:
-        """Reference: execution.go CreateProposalBlock (:113)."""
+            proposer_addr: bytes,
+            last_aggregate_commit: Optional[AggregateCommit] = None
+            ) -> Block:
+        """Reference: execution.go CreateProposalBlock (:113).
+
+        On an aggregate-commit chain the block embeds the aggregate
+        form: normally aggregated here from the extended commit's
+        per-vote signatures; a node restored from an aggregate seen
+        commit (blocksync/statesync — no per-vote signatures on disk)
+        passes the stored aggregate as ``last_aggregate_commit``."""
         max_bytes = state.consensus_params.block.max_bytes
         empty_max_bytes = max_bytes == -1
         if empty_max_bytes:
@@ -227,7 +248,13 @@ class BlockExecutor:
                                   state.validators.size())
         reap_cap = -1 if empty_max_bytes else data_cap
         txs = self.mempool.reap_max_bytes_max_gas(reap_cap, max_gas)
-        commit = last_ext_commit.to_commit()
+        commit: Commit | AggregateCommit = last_ext_commit.to_commit()
+        if height != state.initial_height and \
+                state.consensus_params.feature \
+                .aggregate_commits_enabled(height - 1):
+            commit = last_aggregate_commit \
+                if last_aggregate_commit is not None \
+                else AggregateCommit.from_commit(commit)
         block = state.make_block(height, txs, commit, evidence,
                                  proposer_addr)
         rpp = await self.proxy_app.prepare_proposal(

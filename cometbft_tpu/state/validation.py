@@ -71,6 +71,22 @@ def validate_block(state: State, block: Block) -> None:
     else:
         if block.last_commit is None:
             raise BlockValidationError("nil LastCommit")
+        # commit-form discipline: past the aggregate enable height the
+        # chain's blocks must carry the aggregate form (and never
+        # before it), so the commit encoding is deterministic per
+        # height — a proposer cannot downgrade to per-signature
+        # commits and reintroduce O(n) verification
+        expect_agg = state.consensus_params.feature \
+            .aggregate_commits_enabled(h.height - 1)
+        is_agg = isinstance(block.last_commit,
+                            types_validation.AggregateCommit)
+        if expect_agg and not is_agg:
+            raise BlockValidationError(
+                "per-signature LastCommit on an aggregate-commit "
+                "chain")
+        if is_agg and not expect_agg:
+            raise BlockValidationError(
+                "aggregate LastCommit before the enable height")
         if block.last_commit.size() != state.last_validators.size():
             raise BlockValidationError(
                 f"invalid block commit size: want "

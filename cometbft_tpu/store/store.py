@@ -14,7 +14,7 @@ from typing import Optional
 from ..db import DB, Batch
 from ..types.block import Block, BlockMeta
 from ..types.block_id import BlockID
-from ..types.commit import Commit, ExtendedCommit
+from ..types.commit import AggregateCommit, Commit, ExtendedCommit
 from ..types.part_set import Part, PartSet
 from ..wire import pb, encode, decode
 
@@ -29,6 +29,29 @@ _STATE = b"\x06"       # base/height bookkeeping
 
 def _h(height: int) -> bytes:
     return struct.pack(">q", height)
+
+
+# Commit rows hold either kind: per-signature Commit proto bytes, or
+# an AggregateCommit proto behind a marker prefix (0xff is an invalid
+# proto tag byte — field 31 / wire type 7 — so the two encodings can
+# never collide).  Local storage only; the wire forms live in
+# pb.BLOCK / pb.SIGNED_HEADER optional fields.
+_AGG_COMMIT_PREFIX = b"\xff\x01"
+
+
+def _encode_commit_row(commit) -> bytes:
+    if isinstance(commit, AggregateCommit):
+        return _AGG_COMMIT_PREFIX + encode(pb.AGGREGATE_COMMIT,
+                                           commit.to_proto())
+    return encode(pb.COMMIT, commit.to_proto())
+
+
+def _decode_commit_row(raw: bytes):
+    if raw.startswith(_AGG_COMMIT_PREFIX):
+        return AggregateCommit.from_proto(
+            decode(pb.AGGREGATE_COMMIT,
+                   raw[len(_AGG_COMMIT_PREFIX):]))
+    return Commit.from_proto(decode(pb.COMMIT, raw))
 
 
 def _meta_key(height: int) -> bytes:
@@ -140,10 +163,9 @@ class BlockStore:
                           encode(pb.PART, part.to_proto()))
             if block.last_commit is not None:
                 batch.set(_commit_key(height - 1),
-                          encode(pb.COMMIT,
-                                 block.last_commit.to_proto()))
+                          _encode_commit_row(block.last_commit))
             batch.set(_seen_commit_key(height),
-                      encode(pb.COMMIT, seen_commit.to_proto()))
+                      _encode_commit_row(seen_commit))
             if ext_commit is not None:
                 batch.set(_ext_commit_key(height),
                           encode(pb.EXTENDED_COMMIT,
@@ -163,7 +185,7 @@ class BlockStore:
         with self._lock:
             batch = self._db.new_batch()
             batch.set(_seen_commit_key(commit.height),
-                      encode(pb.COMMIT, commit.to_proto()))
+                      _encode_commit_row(commit))
             # advance height so blocksync resumes AFTER the snapshot;
             # base points at the FIRST block we will actually store
             # (H+1) — advertising base=H would promise a block we can
@@ -213,17 +235,19 @@ class BlockStore:
             return None
         return Part.from_proto(decode(pb.PART, raw))
 
-    def load_block_commit(self, height: int) -> Optional[Commit]:
+    def load_block_commit(self, height: int
+                          ) -> Commit | AggregateCommit | None:
         raw = self._db.get(_commit_key(height))
         if raw is None:
             return None
-        return Commit.from_proto(decode(pb.COMMIT, raw))
+        return _decode_commit_row(raw)
 
-    def load_seen_commit(self, height: int) -> Optional[Commit]:
+    def load_seen_commit(self, height: int
+                         ) -> Commit | AggregateCommit | None:
         raw = self._db.get(_seen_commit_key(height))
         if raw is None:
             return None
-        return Commit.from_proto(decode(pb.COMMIT, raw))
+        return _decode_commit_row(raw)
 
     def load_block_ext_commit(self, height: int
                               ) -> Optional[ExtendedCommit]:

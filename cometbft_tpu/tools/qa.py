@@ -537,9 +537,13 @@ async def run_qa(outdir: str, n_validators: int = 12, n_full: int = 3,
             blk = ref.block_store.load_block(h)
             if blk is None:
                 continue
-            counts.append(sum(
-                1 for s in blk.last_commit.signatures
-                if s.block_id_flag in _PRESENT_SIG_FLAGS))
+            lc = blk.last_commit
+            if hasattr(lc, "signers"):       # AggregateCommit
+                counts.append(lc.signers.popcount())
+            else:
+                counts.append(sum(
+                    1 for s in lc.signatures
+                    if s.block_id_flag in _PRESENT_SIG_FLAGS))
         if counts:
             report.commit_sigs_avg = round(sum(counts) / len(counts), 1)
             report.commit_sigs_min = min(counts)

@@ -13,7 +13,7 @@ from .. import version as _version
 from ..crypto import merkle, tmhash
 from ..wire import pb, encode
 from .block_id import BlockID
-from .commit import Commit, CommitError
+from .commit import AggregateCommit, Commit, CommitError
 from .part_set import PartSet, PartSetHeader
 from .timestamp import Timestamp
 
@@ -198,7 +198,10 @@ class Block:
     header: Header = field(default_factory=Header)
     data: Data = field(default_factory=Data)
     evidence: list = field(default_factory=list)  # list[Evidence]
-    last_commit: Optional[Commit] = None
+    # per-signature Commit, or AggregateCommit on chains past the
+    # aggregate-commit enable height (docs/aggregate_commits.md); both
+    # expose size/hash/validate_basic/height/round/block_id
+    last_commit: Commit | AggregateCommit | None = None
 
     def hash(self) -> bytes:
         return self.header.hash()
@@ -251,7 +254,9 @@ class Block:
                                       for ev in self.evidence]}
             if self.evidence else {},
         }
-        if self.last_commit is not None:
+        if isinstance(self.last_commit, AggregateCommit):
+            d["last_aggregate_commit"] = self.last_commit.to_proto()
+        elif self.last_commit is not None:
             d["last_commit"] = self.last_commit.to_proto()
         return d
 
@@ -259,13 +264,23 @@ class Block:
     def from_proto(cls, d: dict) -> "Block":
         from .evidence import evidence_from_proto_wrapped
         lc = d.get("last_commit")
+        lac = d.get("last_aggregate_commit")
+        if lc is not None and lac is not None:
+            raise BlockError(
+                "block carries both per-signature and aggregate "
+                "LastCommit")
+        last_commit: Commit | AggregateCommit | None = None
+        if lc is not None:
+            last_commit = Commit.from_proto(lc)
+        elif lac is not None:
+            last_commit = AggregateCommit.from_proto(lac)
         return cls(
             header=Header.from_proto(d.get("header") or {}),
             data=Data.from_proto(d.get("data") or {}),
             evidence=[evidence_from_proto_wrapped(e)
                       for e in (d.get("evidence") or {}).get("evidence",
                                                              [])],
-            last_commit=Commit.from_proto(lc) if lc is not None else None,
+            last_commit=last_commit,
         )
 
     @classmethod
@@ -282,7 +297,8 @@ class Block:
 @dataclass
 class SignedHeader:
     header: Optional[Header] = None
-    commit: Optional[Commit] = None
+    # Commit or AggregateCommit (see Block.last_commit)
+    commit: Commit | AggregateCommit | None = None
 
     def validate_basic(self, chain_id: str) -> None:
         """Reference: block.go SignedHeader.ValidateBasic."""
@@ -309,16 +325,28 @@ class SignedHeader:
         d: dict = {}
         if self.header is not None:
             d["header"] = self.header.to_proto()
-        if self.commit is not None:
+        if isinstance(self.commit, AggregateCommit):
+            d["aggregate_commit"] = self.commit.to_proto()
+        elif self.commit is not None:
             d["commit"] = self.commit.to_proto()
         return d
 
     @classmethod
     def from_proto(cls, d: dict) -> "SignedHeader":
         h, c = d.get("header"), d.get("commit")
+        ac = d.get("aggregate_commit")
+        if c is not None and ac is not None:
+            raise BlockError(
+                "signed header carries both per-signature and "
+                "aggregate commit")
+        commit: Commit | AggregateCommit | None = None
+        if c is not None:
+            commit = Commit.from_proto(c)
+        elif ac is not None:
+            commit = AggregateCommit.from_proto(ac)
         return cls(
             header=Header.from_proto(h) if h is not None else None,
-            commit=Commit.from_proto(c) if c is not None else None,
+            commit=commit,
         )
 
 

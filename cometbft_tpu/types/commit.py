@@ -9,6 +9,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 
 from ..crypto import merkle
+from ..libs.bits import BitArray
 from ..wire import pb, encode
 from .block_id import BlockID
 from .timestamp import Timestamp
@@ -223,6 +224,151 @@ class Commit:
                     validator_address=cs.validator_address,
                     timestamp=cs.timestamp, signature=cs.signature)
                 for cs in self.signatures])
+
+
+@dataclass
+class AggregateCommit:
+    """One BLS signature + a signer bitmap for a whole commit
+    (TPU-native extension; docs/aggregate_commits.md).
+
+    In aggregate-commit mode every precommit FOR a block signs the
+    same canonical message — the zero-timestamp canonical precommit
+    over (chain_id, height, round, block_id) — so the signatures sum
+    in G2 and verification is one 2-Miller-loop pairing check
+    regardless of validator count.  Bit i of ``signers`` means
+    validator index i (in the height's validator set) precommitted
+    the block; nil and absent precommits are simply unset (their
+    signatures cover different messages and cannot be aggregated in).
+
+    There is no per-vote timestamp, so BFT time's weighted median is
+    unavailable: consensus params require PBTS at or before the
+    aggregate enable height (types/params.py FeatureParams.validate).
+    """
+    height: int = 0
+    round: int = 0
+    block_id: BlockID = field(default_factory=BlockID)
+    signers: BitArray = field(default_factory=lambda: BitArray(0))
+    signature: bytes = b""
+    _hash: bytes | None = field(default=None, repr=False, compare=False)
+
+    BLS_SIGNATURE_SIZE = 96
+
+    def size(self) -> int:
+        """Validator slots covered (= validator-set size), matching
+        Commit.size() so shared size checks work on either kind."""
+        return self.signers.size()
+
+    def signed_indices(self) -> list[int]:
+        return self.signers.true_indices()
+
+    def signers_bytes(self) -> bytes:
+        """Canonical wire form of the bitmap: little-endian packed,
+        (size+7)//8 bytes, padding bits zero."""
+        return self.signers.to_le_bytes()
+
+    def vote_sign_bytes(self, chain_id: str) -> bytes:
+        """THE message every aggregated precommit signed: canonical
+        precommit with the zero timestamp (consensus/state.py signs
+        precommits with a zero timestamp once aggregate mode is
+        enabled, so all signers share one sign-bytes message)."""
+        return canonical.vote_sign_bytes(
+            chain_id, canonical.PRECOMMIT_TYPE, self.height, self.round,
+            self.block_id, Timestamp.zero())
+
+    def validate_basic(self) -> None:
+        if self.height < 0:
+            raise CommitError("negative Height")
+        if self.round < 0:
+            raise CommitError("negative Round")
+        if self.height >= 1:
+            if self.block_id.is_nil():
+                raise CommitError(
+                    "aggregate commit cannot be for nil block")
+            if self.signers.size() == 0:
+                raise CommitError("no validator slots in "
+                                  "aggregate commit")
+            if self.signers.is_empty():
+                raise CommitError("no signers in aggregate commit")
+            if len(self.signature) != self.BLS_SIGNATURE_SIZE:
+                raise CommitError(
+                    f"aggregate signature must be "
+                    f"{self.BLS_SIGNATURE_SIZE} bytes, "
+                    f"got {len(self.signature)}")
+
+    def hash(self) -> bytes:
+        """Merkle leaf hash over the proto bytes (the aggregate
+        analogue of Commit.hash's merkle over CommitSig protos)."""
+        if self._hash is None:
+            self._hash = merkle.hash_from_byte_slices(
+                [encode(pb.AGGREGATE_COMMIT, self.to_proto())])
+        return self._hash
+
+    def median_time(self, validators) -> Timestamp:
+        """Aggregate commits carry no per-vote timestamps; BFT time is
+        never computed for them (PBTS is required by params
+        validation).  Reaching this is a wiring bug, not a data
+        error."""
+        raise CommitError(
+            "aggregate commit has no per-vote timestamps (BFT time "
+            "requires per-signature commits; enable PBTS)")
+
+    def to_proto(self) -> dict:
+        d: dict = {"block_id": self.block_id.to_proto()}
+        if self.height:
+            d["height"] = self.height
+        if self.round:
+            d["round"] = self.round
+        if self.signers.size():
+            d["signer_count"] = self.signers.size()
+        sb = self.signers_bytes()
+        if sb:
+            d["signers"] = sb
+        if self.signature:
+            d["signature"] = self.signature
+        return d
+
+    @classmethod
+    def from_proto(cls, d: dict) -> "AggregateCommit":
+        count = d.get("signer_count", 0)
+        try:
+            ba = BitArray.from_le_bytes(d.get("signers", b""), count)
+        except ValueError as e:
+            raise CommitError(f"signer bitmap: {e}") from None
+        return cls(
+            height=d.get("height", 0),
+            round=d.get("round", 0),
+            block_id=BlockID.from_proto(d.get("block_id") or {}),
+            signers=ba,
+            signature=d.get("signature", b""),
+        )
+
+    @classmethod
+    def from_commit(cls, commit: Commit) -> "AggregateCommit":
+        """Aggregate a per-signature commit's FOR-block signatures
+        (the proposer path: the precommit vote set is materialized as
+        a Commit first, then aggregated — O(n) G2 adds through the
+        native batched-inversion tree).  All COMMIT-flag signatures
+        must be BLS; nil/absent slots stay unset."""
+        from ..crypto import bls12381
+        ba = BitArray(len(commit.signatures))
+        sigs = []
+        for i, cs in enumerate(commit.signatures):
+            if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                continue
+            if len(cs.signature) != cls.BLS_SIGNATURE_SIZE:
+                raise CommitError(
+                    f"commit sig #{i} is not a BLS signature "
+                    f"({len(cs.signature)} bytes)")
+            ba.set_index(i, True)
+            sigs.append(cs.signature)
+        if not sigs:
+            raise CommitError("no FOR-block signatures to aggregate")
+        try:
+            agg = bls12381.aggregate(sigs)
+        except ValueError as e:
+            raise CommitError(f"cannot aggregate commit: {e}") from e
+        return cls(height=commit.height, round=commit.round,
+                   block_id=commit.block_id, signers=ba, signature=agg)
 
 
 @dataclass

@@ -155,33 +155,73 @@ class LightClientAttackEvidence:
         who signed the lunatic header; equivocation (same round) ->
         validators who signed both; amnesia (different rounds) ->
         unattributable, empty."""
+        from .commit import AggregateCommit
         from .vote import BLOCK_ID_FLAG_COMMIT
         out: list[Validator] = []
         conflicting = self.conflicting_block
+
+        def signer_addrs(commit, vals):
+            """Addresses that signed FOR the commit's block — signer
+            bitmap resolved through the commit's own valset for the
+            aggregate form, COMMIT-flag CommitSigs otherwise."""
+            if isinstance(commit, AggregateCommit):
+                return [vals.validators[i].address
+                        for i in commit.signed_indices()
+                        if i < vals.size()]
+            return [cs.validator_address for cs in commit.signatures
+                    if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT]
+
         if self.conflicting_header_is_invalid(
                 trusted_signed_header.header):
-            for cs in conflicting.signed_header.commit.signatures:
-                if cs.block_id_flag != BLOCK_ID_FLAG_COMMIT:
-                    continue
-                _, val = common_vals.get_by_address(
-                    cs.validator_address)
+            for addr in signer_addrs(conflicting.signed_header.commit,
+                                     conflicting.validator_set):
+                _, val = common_vals.get_by_address(addr)
                 if val is not None:
                     out.append(val)
         elif trusted_signed_header.commit.round == \
                 conflicting.signed_header.commit.round:
-            trusted_sigs = trusted_signed_header.commit.signatures
-            for i, sig_a in enumerate(
-                    conflicting.signed_header.commit.signatures):
-                if sig_a.block_id_flag != BLOCK_ID_FLAG_COMMIT:
-                    continue
-                if i >= len(trusted_sigs) or \
-                        trusted_sigs[i].block_id_flag != \
-                        BLOCK_ID_FLAG_COMMIT:
-                    continue
-                _, val = conflicting.validator_set.get_by_address(
-                    sig_a.validator_address)
-                if val is not None:
-                    out.append(val)
+            conf_commit = conflicting.signed_header.commit
+            trusted_commit = trusted_signed_header.commit
+            if isinstance(conf_commit, AggregateCommit) or \
+                    isinstance(trusted_commit, AggregateCommit):
+                # equivocation attribution needs BOTH signer sets;
+                # resolve each through its own structure and
+                # intersect by address (index alignment only holds
+                # for identical valsets, which equivocation implies
+                # at common height — address intersection is the
+                # conservative general form)
+                conf_addrs = set(signer_addrs(
+                    conf_commit, conflicting.validator_set))
+                trusted_addrs = set()
+                if isinstance(trusted_commit, AggregateCommit):
+                    # the trusted header's signers index OUR valset
+                    # at that height, which common_vals approximates;
+                    # out-of-range bits simply don't attribute
+                    trusted_addrs = set(signer_addrs(
+                        trusted_commit, common_vals))
+                else:
+                    trusted_addrs = set(
+                        cs.validator_address
+                        for cs in trusted_commit.signatures
+                        if cs.block_id_flag == BLOCK_ID_FLAG_COMMIT)
+                for addr in conf_addrs & trusted_addrs:
+                    _, val = conflicting.validator_set \
+                        .get_by_address(addr)
+                    if val is not None:
+                        out.append(val)
+            else:
+                trusted_sigs = trusted_commit.signatures
+                for i, sig_a in enumerate(conf_commit.signatures):
+                    if sig_a.block_id_flag != BLOCK_ID_FLAG_COMMIT:
+                        continue
+                    if i >= len(trusted_sigs) or \
+                            trusted_sigs[i].block_id_flag != \
+                            BLOCK_ID_FLAG_COMMIT:
+                        continue
+                    _, val = conflicting.validator_set.get_by_address(
+                        sig_a.validator_address)
+                    if val is not None:
+                        out.append(val)
         out.sort(key=lambda v: (-v.voting_power, v.address))
         return out
 

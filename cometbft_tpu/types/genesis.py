@@ -185,6 +185,8 @@ def _params_to_json(p: Optional[ConsensusParams]) -> Optional[dict]:
             "vote_extensions_enable_height": str(
                 p.feature.vote_extensions_enable_height),
             "pbts_enable_height": str(p.feature.pbts_enable_height),
+            "aggregate_commit_enable_height": str(
+                p.feature.aggregate_commit_enable_height),
         },
     }
 
@@ -224,5 +226,7 @@ def _params_from_json(d: Optional[dict]) -> Optional[ConsensusParams]:
         feature=FeatureParams(
             vote_extensions_enable_height=int(feat.get(
                 "vote_extensions_enable_height", 0)),
-            pbts_enable_height=int(feat.get("pbts_enable_height", 0))),
+            pbts_enable_height=int(feat.get("pbts_enable_height", 0)),
+            aggregate_commit_enable_height=int(feat.get(
+                "aggregate_commit_enable_height", 0))),
     )

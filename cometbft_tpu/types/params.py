@@ -129,6 +129,14 @@ class SynchronyParams:
 class FeatureParams:
     vote_extensions_enable_height: int = 0
     pbts_enable_height: int = 0
+    # TPU-native extension (docs/aggregate_commits.md): commits for
+    # heights >= this are one BLS aggregate signature + signer bitmap
+    # — O(1) pairing verification in validator count.  Requires PBTS
+    # (aggregate commits carry no per-vote timestamps, so BFT time's
+    # weighted median is unavailable) and is incompatible with vote
+    # extensions (per-validator extension signatures cannot be
+    # aggregated into one shared-message signature).
+    aggregate_commit_enable_height: int = 0
 
     def vote_extensions_enabled(self, height: int) -> bool:
         h = self.vote_extensions_enable_height
@@ -138,6 +146,11 @@ class FeatureParams:
         h = self.pbts_enable_height
         return h > 0 and height >= h
 
+    def aggregate_commits_enabled(self, height: int) -> bool:
+        """True when the commit FOR height must be the aggregate form."""
+        h = self.aggregate_commit_enable_height
+        return h > 0 and height >= h
+
     def validate(self) -> None:
         if self.vote_extensions_enable_height < 0:
             raise ParamsError(
@@ -145,6 +158,21 @@ class FeatureParams:
         if self.pbts_enable_height < 0:
             raise ParamsError(
                 "feature.PbtsEnableHeight must be non-negative")
+        agg = self.aggregate_commit_enable_height
+        if agg < 0:
+            raise ParamsError(
+                "feature.AggregateCommitEnableHeight must be "
+                "non-negative")
+        if agg > 0:
+            if not (0 < self.pbts_enable_height <= agg):
+                raise ParamsError(
+                    "feature.AggregateCommitEnableHeight requires PBTS "
+                    "enabled at or before it (aggregate commits have "
+                    "no per-vote timestamps for BFT time)")
+            if self.vote_extensions_enable_height > 0:
+                raise ParamsError(
+                    "feature.AggregateCommitEnableHeight is "
+                    "incompatible with vote extensions")
 
 
 @dataclass
@@ -162,6 +190,17 @@ class ConsensusParams:
         self.validator.validate()
         self.synchrony.validate()
         self.feature.validate()
+        if self.feature.aggregate_commit_enable_height > 0 and \
+                self.validator.pub_key_types != ["bls12_381"]:
+            # cross-struct check (FeatureParams.validate cannot see
+            # validator params): a non-BLS signer would make every
+            # post-enable proposal fail AggregateCommit.from_commit —
+            # the chain halts with the root cause buried in logs.
+            # Reject the misconfiguration at genesis/param-update
+            # instead.
+            raise ParamsError(
+                "feature.AggregateCommitEnableHeight requires "
+                "validator.PubKeyTypes == ['bls12_381']")
 
     def hash(self) -> bytes:
         """sha256 of HashedParams proto (reference: params.go:425)."""
@@ -224,6 +263,11 @@ class ConsensusParams:
                 **({"pbts_enable_height":
                     {"value": self.feature.pbts_enable_height}}
                    if self.feature.pbts_enable_height else {}),
+                **({"aggregate_commit_enable_height":
+                    {"value":
+                     self.feature.aggregate_commit_enable_height}}
+                   if self.feature.aggregate_commit_enable_height
+                   else {}),
             },
         }
 
@@ -255,7 +299,10 @@ class ConsensusParams:
                     feat.get("vote_extensions_enable_height") or {}
                 ).get("value", 0),
                 pbts_enable_height=(
-                    feat.get("pbts_enable_height") or {}).get("value", 0)),
+                    feat.get("pbts_enable_height") or {}).get("value", 0),
+                aggregate_commit_enable_height=(
+                    feat.get("aggregate_commit_enable_height") or {}
+                ).get("value", 0)),
         )
 
 

@@ -21,16 +21,63 @@ verdicts identical to the per-signature path.
 """
 from __future__ import annotations
 
+import hashlib
+import time
 from typing import Callable, NamedTuple, Optional
 
 from ..crypto import batch as crypto_batch
-from .commit import Commit, CommitSig, CommitError
+from ..libs.bits import BitArray
+from .commit import AggregateCommit, Commit, CommitSig, CommitError
 from .block_id import BlockID
 from .signature_cache import SignatureCache, SignatureCacheValue
 from .validator_set import ValidatorSet
 from .vote import BLOCK_ID_FLAG_ABSENT, BLOCK_ID_FLAG_COMMIT
 
 BATCH_VERIFY_THRESHOLD = 2
+
+# metrics v2: commit-verification latency split by commit kind
+# ("aggregate" = the O(1) BLS pairing path; "batch"/"grouped"/
+# "single" = the per-signature paths).  Process-global registry —
+# this module has no node context; /metrics merges DEFAULT in.
+_COMMIT_VERIFY_HIST = None
+
+
+def commit_verify_histogram():
+    global _COMMIT_VERIFY_HIST
+    if _COMMIT_VERIFY_HIST is None:
+        from ..libs import metrics as libmetrics
+        _COMMIT_VERIFY_HIST = libmetrics.DEFAULT.histogram(
+            "consensus", "commit_verify_seconds",
+            "Commit verification latency in seconds, by verification "
+            "kind (aggregate = O(1) BLS pairing path; "
+            "batch/grouped/single = per-signature paths).",
+            labels=("kind",),
+            buckets=(0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+                     0.1, 0.25, 0.5, 1.0, 2.5))
+    return _COMMIT_VERIFY_HIST
+
+
+class _observe_kind:
+    """Context manager timing one commit verification into the
+    kind-labeled histogram (failures observe too — a rejected commit
+    still paid the verification cost)."""
+
+    __slots__ = ("kind", "t0")
+
+    def __init__(self, kind: str):
+        self.kind = kind
+
+    def __enter__(self):
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        # bounded: every instantiation site passes one of the four
+        # literal kinds {aggregate, batch, grouped, single}
+        kind = self.kind
+        commit_verify_histogram().with_labels(kind).observe(
+            time.perf_counter() - self.t0)
+        return False
 
 
 class Fraction(NamedTuple):
@@ -78,16 +125,16 @@ def _should_group_verify(vals: ValidatorSet, commit: Commit) -> bool:
     return False
 
 
-def _verify_basic_vals_and_commit(vals: ValidatorSet, commit: Commit,
+def _verify_basic_vals_and_commit(vals: ValidatorSet, commit,
                                   height: int, block_id: BlockID) -> None:
     if vals is None:
         raise VerificationError("nil validator set")
     if commit is None:
         raise VerificationError("nil commit")
-    if vals.size() != len(commit.signatures):
+    if vals.size() != commit.size():
         raise VerificationError(
             f"invalid commit -- wrong set size: {vals.size()} vs "
-            f"{len(commit.signatures)}")
+            f"{commit.size()}")
     if height != commit.height:
         raise VerificationError(
             f"invalid commit -- wrong height: {height} vs {commit.height}")
@@ -97,63 +144,111 @@ def _verify_basic_vals_and_commit(vals: ValidatorSet, commit: Commit,
             f"got {commit.block_id}")
 
 
+def _dispatch_aggregate(chain_id: str, vals: ValidatorSet,
+                        block_id: BlockID, height: int,
+                        commit: AggregateCommit,
+                        cache: Optional[SignatureCache]) -> None:
+    """The O(1) arm shared by verify_commit and verify_commit_light:
+    one aggregate signature covers every signer, so "all signatures"
+    and "stop at 2/3" coincide."""
+    _verify_basic_vals_and_commit(vals, commit, height, block_id)
+    with _observe_kind("aggregate"):
+        _verify_aggregate_commit(
+            chain_id, vals, commit,
+            vals.total_voting_power() * 2 // 3, cache=cache)
+
+
 def verify_commit(chain_id: str, vals: ValidatorSet, block_id: BlockID,
-                  height: int, commit: Commit,
+                  height: int, commit: Commit | AggregateCommit,
                   cache: Optional[SignatureCache] = None) -> None:
-    """+2/3 signed; checks ALL signatures (reference: VerifyCommit :30)."""
+    """+2/3 signed; checks ALL signatures (reference: VerifyCommit :30).
+
+    AggregateCommit commits take the O(1) pairing path
+    (_dispatch_aggregate)."""
+    if isinstance(commit, AggregateCommit):
+        _dispatch_aggregate(chain_id, vals, block_id, height, commit,
+                            cache)
+        return
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: c.block_id_flag == BLOCK_ID_FLAG_ABSENT  # noqa: E731
     count = lambda c: c.block_id_flag == BLOCK_ID_FLAG_COMMIT  # noqa: E731
     if _should_batch_verify(vals, commit):
-        _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=True, look_up_by_index=True, cache=cache)
+        with _observe_kind("batch"):
+            _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=True, look_up_by_index=True,
+                cache=cache)
     elif _should_group_verify(vals, commit):
-        _verify_commit_grouped(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=True, look_up_by_index=True, cache=cache)
+        with _observe_kind("grouped"):
+            _verify_commit_grouped(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=True, look_up_by_index=True,
+                cache=cache)
     else:
-        _verify_commit_single(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=True, look_up_by_index=True, cache=cache)
+        with _observe_kind("single"):
+            _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=True, look_up_by_index=True,
+                cache=cache)
 
 
 def verify_commit_light(chain_id: str, vals: ValidatorSet,
-                        block_id: BlockID, height: int, commit: Commit,
+                        block_id: BlockID, height: int,
+                        commit: Commit | AggregateCommit,
                         count_all_signatures: bool = False,
                         cache: Optional[SignatureCache] = None) -> None:
     """Light-client variant: stops at 2/3 unless count_all_signatures.
 
     Reference: VerifyCommitLight / ...AllSignatures / ...WithCache (:65)."""
+    if isinstance(commit, AggregateCommit):
+        _dispatch_aggregate(chain_id, vals, block_id, height, commit,
+                            cache)
+        return
     _verify_basic_vals_and_commit(vals, commit, height, block_id)
     voting_power_needed = vals.total_voting_power() * 2 // 3
     ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
     count = lambda c: True  # noqa: E731
     if _should_batch_verify(vals, commit):
-        _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=True, cache=cache)
+        with _observe_kind("batch"):
+            _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=True, cache=cache)
     elif _should_group_verify(vals, commit):
-        _verify_commit_grouped(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=True, cache=cache)
+        with _observe_kind("grouped"):
+            _verify_commit_grouped(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=True, cache=cache)
     else:
-        _verify_commit_single(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=True, cache=cache)
+        with _observe_kind("single"):
+            _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=True, cache=cache)
 
 
 def verify_commit_light_trusting(
-        chain_id: str, vals: ValidatorSet, commit: Commit,
+        chain_id: str, vals: ValidatorSet,
+        commit: Commit | AggregateCommit,
         trust_level: Fraction, count_all_signatures: bool = False,
-        cache: Optional[SignatureCache] = None) -> None:
+        cache: Optional[SignatureCache] = None,
+        signer_vals: Optional[ValidatorSet] = None) -> None:
     """trustLevel (e.g. 1/3) of a TRUSTED validator set signed; used for
     skipping verification.  Looks validators up by address since the sets
-    need not correspond (reference: VerifyCommitLightTrusting :150)."""
+    need not correspond (reference: VerifyCommitLightTrusting :150).
+
+    For an AggregateCommit the signer bitmap indexes the set that
+    SIGNED the commit's height, so the caller must supply that set as
+    ``signer_vals`` (the light client has it — the untrusted header's
+    validator set, already checked against validators_hash).
+    signer_vals is used ONLY to map bitmap indices to addresses: the
+    pairing runs against the TRUSTED set's keys for those addresses
+    (signer_vals may be self-certified by the header under
+    verification, so its claimed keys prove nothing — see
+    _verify_aggregate_commit), and a signer outside the trusted set
+    reports as not-enough-provable-power so skipping callers bisect."""
     if vals is None:
         raise VerificationError("nil validator set")
     if trust_level.denominator == 0:
@@ -165,23 +260,217 @@ def verify_commit_light_trusting(
         raise VerificationError(
             "int64 overflow while calculating voting power needed")
     voting_power_needed = product // trust_level.denominator
+    if isinstance(commit, AggregateCommit):
+        if signer_vals is None:
+            raise VerificationError(
+                "aggregate commit trusting verification needs the "
+                "signing validator set")
+        if signer_vals.size() != commit.size():
+            raise VerificationError(
+                f"invalid commit -- wrong set size: "
+                f"{signer_vals.size()} vs {commit.size()}")
+        with _observe_kind("aggregate"):
+            _verify_aggregate_commit(
+                chain_id, signer_vals, commit, voting_power_needed,
+                cache=cache, tally_vals=vals)
+        return
     ignore = lambda c: c.block_id_flag != BLOCK_ID_FLAG_COMMIT  # noqa: E731
     count = lambda c: True  # noqa: E731
     if _should_batch_verify(vals, commit):
-        _verify_commit_batch(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=False, cache=cache)
+        with _observe_kind("batch"):
+            _verify_commit_batch(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=False, cache=cache)
     elif _should_group_verify(vals, commit):
-        _verify_commit_grouped(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=False, cache=cache)
+        with _observe_kind("grouped"):
+            _verify_commit_grouped(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=False, cache=cache)
     else:
-        _verify_commit_single(
-            chain_id, vals, commit, voting_power_needed, ignore, count,
-            count_all_signatures=count_all_signatures,
-            look_up_by_index=False, cache=cache)
+        with _observe_kind("single"):
+            _verify_commit_single(
+                chain_id, vals, commit, voting_power_needed, ignore,
+                count, count_all_signatures=count_all_signatures,
+                look_up_by_index=False, cache=cache)
+
+
+# ---------------------------------------------------------------------------
+# aggregate-commit verification: O(1) pairing work in validator count
+# (docs/aggregate_commits.md)
+
+def _agg_memo_key(commit: AggregateCommit, valset_hash: bytes,
+                  bitmap: bytes) -> bytes:
+    """Verdict-memo key binding (block_id, valset, bitmap, signature);
+    hashed so the shared SignatureCache stores 32-byte keys, prefixed
+    so it can never collide with a raw signature key.  ``valset_hash``
+    and ``bitmap`` describe the set the pubkeys were RESOLVED from —
+    on the trusting path that is the trusted set and the bitmap
+    re-indexed into it, so a verdict cached against one trusted set
+    can never answer for another."""
+    h = hashlib.sha256()
+    h.update(b"aggcommit/1\x00")
+    h.update(valset_hash)
+    h.update(commit.block_id.key())
+    h.update(bitmap)
+    h.update(commit.signature)
+    return b"agg:" + h.digest()
+
+
+# per-valset raw-pubkey table: the G1 point-sum consumes the keys'
+# raw 96-byte serializations; re-extracting them (10k method calls +
+# key-type checks) on every new signer bitmap costs more than the
+# join itself.  Keyed by valset hash, tiny LRU — a handful of live
+# valsets exist at once.
+_PK_RAWS: "OrderedDict[bytes, Optional[tuple]]" = None  # type: ignore
+
+
+def _pubkey_raws(vals: ValidatorSet, valset_hash: bytes):
+    """Tuple of 96-byte raw BLS pubkey serializations (valset order),
+    or None when any validator key is not bls12_381."""
+    global _PK_RAWS
+    if _PK_RAWS is None:
+        from collections import OrderedDict
+        _PK_RAWS = OrderedDict()
+    _MISS = object()
+    entry = _PK_RAWS.get(valset_hash, _MISS)
+    if entry is not _MISS:
+        _PK_RAWS.move_to_end(valset_hash)
+        return entry
+    from ..crypto import bls12381
+    raws = []
+    for v in vals.validators:
+        pk = v.pub_key
+        if not isinstance(pk, bls12381.Bls12381PubKey):
+            raws = None
+            break
+        raws.append(pk.bytes())
+    entry = tuple(raws) if raws is not None else None
+    _PK_RAWS[valset_hash] = entry
+    if len(_PK_RAWS) > 8:
+        _PK_RAWS.popitem(last=False)
+    return entry
+
+
+def _verify_aggregate_commit(
+        chain_id: str, vals: ValidatorSet, commit: AggregateCommit,
+        voting_power_needed: int,
+        cache: Optional[SignatureCache] = None,
+        tally_vals: Optional[ValidatorSet] = None) -> None:
+    """One pairing check for the whole commit.
+
+    ``vals`` is the set the signer bitmap indexes (the commit
+    height's validator set).  When ``tally_vals`` is given (the light
+    client's TRUSTED set — the trusting path) every signer is
+    resolved through it BY ADDRESS: the power tally and the pubkey
+    sum both use the trusted set's entries, never the claimed keys in
+    ``vals``.  ``vals`` may be self-certified by the very header under
+    verification (a skipping hop checks it only against that header's
+    validators_hash), so verifying the pairing against its keys would
+    let a rogue aggregate key (pk_r = [x]g1 - sum of trusted keys,
+    placed at a fabricated index) cancel the trusted keys and forge
+    the 1/3-trust check with zero honest signatures.  A signer whose
+    address is NOT in the trusted set cannot be authenticated at all,
+    so the hop reports zero provable power (NotEnoughVotingPowerError
+    — the light client bisects toward the trusted header until the
+    sets overlap, converging on adjacent hops whose valset is
+    chain-certified).
+
+    The G1 pubkey sum — the only O(n) step, and it is point adds, not
+    pairings — is memoized per (valset_hash, bitmap) in the
+    process-global AggregatePubKeyCache; the full verdict is memoized
+    in the SignatureCache keyed (block_id, valset_hash, bitmap,
+    signature) — both keyed on the set the keys were RESOLVED from
+    (the trusted set on the trusting path)."""
+    from ..crypto import bls12381
+
+    try:
+        commit.validate_basic()
+    except CommitError as e:
+        raise VerificationError(f"invalid aggregate commit: {e}") from e
+
+    top = commit.signers.highest_true_index()
+    if top >= vals.size():
+        raise VerificationError(
+            f"signer bit {top} out of range for validator set "
+            f"of {vals.size()}")
+
+    # voting-power tally (cheap, judged before the pairing as the
+    # batch path judges threshold before its deferred verify) —
+    # key_vals/key_bits name the set + bitmap the PAIRING runs over
+    if tally_vals is None:
+        # complement walk: healthy chains have near-full bitmaps, so
+        # summing the MISSING validators' power is O(absent), not
+        # O(n) — at 10k validators this is what keeps the warm path
+        # inside the pairing budget
+        key_vals, key_bits = vals, commit.signers
+        tallied = vals.total_voting_power()
+        for i in commit.signers.not_().true_indices():
+            tallied -= vals.validators[i].voting_power
+    else:
+        # trusting: every signer resolved through the TRUSTED set by
+        # address (see docstring — ``vals`` may be self-certified and
+        # its claimed keys are never used here); an unknown signer
+        # means zero soundly-attributable power, a repeated address
+        # means ``vals`` is malformed
+        key_vals = tally_vals
+        key_bits = BitArray(tally_vals.size())
+        tallied = 0
+        for i in commit.signed_indices():
+            addr = vals.validators[i].address
+            tidx = tally_vals.index_by_address(addr)
+            if tidx < 0:
+                raise NotEnoughVotingPowerError(0, voting_power_needed)
+            if key_bits.get_index(tidx):
+                raise VerificationError(
+                    f"duplicate signer address {addr.hex().upper()} "
+                    f"in aggregate commit signer set")
+            key_bits.set_index(tidx, True)
+            tallied += tally_vals.validators[tidx].voting_power
+    if tallied <= voting_power_needed:
+        raise NotEnoughVotingPowerError(tallied, voting_power_needed)
+
+    sign_bytes = commit.vote_sign_bytes(chain_id)
+    valset_hash = key_vals.hash()
+    bitmap = key_bits.to_le_bytes()
+
+    memo_key = _agg_memo_key(commit, valset_hash, bitmap)
+    if cache is not None:
+        cv = cache.get(memo_key)
+        if cv is not None and cv.vote_sign_bytes == sign_bytes:
+            return
+
+    def build():
+        raws = _pubkey_raws(key_vals, valset_hash)
+        if raws is None:
+            raise VerificationError(
+                "aggregate commits need a bls12_381 validator set")
+        if key_bits.popcount() == len(raws):
+            blob = b"".join(raws)
+        else:
+            blob = b"".join(raws[i] for i in key_bits.true_indices())
+        return bls12381.aggregate_pub_keys_raw(blob)
+
+    pk_cache = bls12381.aggregate_pubkey_cache()
+    agg_pk = pk_cache.get(valset_hash, bitmap)
+    fresh = agg_pk is None
+    if fresh:
+        agg_pk = build()
+
+    if not bls12381.verify_aggregate(agg_pk, sign_bytes,
+                                     commit.signature):
+        raise VerificationError(
+            f"wrong aggregate signature: "
+            f"{commit.signature.hex().upper()[:24]}...")
+
+    if fresh:
+        # insert only after success: a forged-signature stream with
+        # varying bitmaps must not evict the honest sums
+        pk_cache.put(valset_hash, bitmap, agg_pk)
+    if cache is not None:
+        cache.add(memo_key, SignatureCacheValue(b"aggregate",
+                                                sign_bytes))
 
 # ---------------------------------------------------------------------------
 

@@ -77,6 +77,9 @@ class VoteSet:
         self.maj23: Optional[BlockID] = None
         self.votes_by_block: dict[bytes, _BlockVotes] = {}
         self.peer_maj23s: dict[str, BlockID] = {}
+        # set only by from_aggregate_commit (restart without per-vote
+        # signatures); the proposal path prefers it when present
+        self.stored_aggregate_commit = None
 
     @classmethod
     def extended(cls, chain_id: str, height: int, round_: int,
@@ -84,6 +87,26 @@ class VoteSet:
         """NewExtendedVoteSet: verifies extension data on every vote."""
         return cls(chain_id, height, round_, signed_msg_type, val_set,
                    extensions_enabled=True)
+
+    @classmethod
+    def from_aggregate_commit(cls, chain_id: str, agg_commit,
+                              val_set: ValidatorSet) -> "VoteSet":
+        """LastCommit restored from an AggregateCommit (blocksync /
+        statesync restart — no per-vote signatures exist on disk, so
+        individual votes cannot be reconstructed).
+
+        The set reports the 2/3 majority (maj23) the verified
+        aggregate proves, holds the aggregate for re-proposal
+        (make_extended_commit yields all-absent signatures; the
+        proposer embeds stored_aggregate_commit instead —
+        consensus/state.py _create_proposal_block), and still accepts
+        late precommits via add_vote — sum starts at zero so live
+        votes tally normally."""
+        vs = cls(chain_id, agg_commit.height, agg_commit.round,
+                 canonical.PRECOMMIT_TYPE, val_set)
+        vs.maj23 = agg_commit.block_id
+        vs.stored_aggregate_commit = agg_commit
+        return vs
 
     # ------------------------------------------------------------------
     def size(self) -> int:
@@ -254,6 +277,35 @@ class VoteSet:
 
     def has_two_thirds_majority(self) -> bool:
         return self.maj23 is not None
+
+    def has_two_thirds_votes_for_maj23(self) -> bool:
+        """True when the INDIVIDUAL votes held for maj23 reach quorum
+        — distinguishes a live vote set from one whose majority is
+        proven only by an injected/restored aggregate commit (the
+        latter has maj23 set but few or no votes)."""
+        if self.maj23 is None:
+            return False
+        bv = self.votes_by_block.get(self.maj23.key())
+        quorum = self.val_set.total_voting_power() * 2 // 3 + 1
+        return bv is not None and bv.sum >= quorum
+
+    def inject_aggregate_majority(self, agg_commit) -> bool:
+        """Record a VERIFIED aggregate commit as this round's +2/3
+        precommit evidence (catchup on aggregate-commit chains — the
+        caller MUST have verified it against the height's validator
+        set first).  Keeps any live majority already found; refuses a
+        conflicting one (two verified majorities for different blocks
+        at one height/round is a safety violation upstream, not
+        something to paper over here)."""
+        if self.signed_msg_type != canonical.PRECOMMIT_TYPE or \
+                agg_commit.height != self.height or \
+                agg_commit.round != self.round:
+            return False
+        if self.maj23 is not None and self.maj23 != agg_commit.block_id:
+            return False
+        self.maj23 = agg_commit.block_id
+        self.stored_aggregate_commit = agg_commit
+        return True
 
     def is_commit(self) -> bool:
         return (self.signed_msg_type == canonical.PRECOMMIT_TYPE and

@@ -3,7 +3,8 @@
 Reference: proto/cometbft/consensus/v2/types.proto.
 """
 from .proto import F, Msg
-from .pb import BLOCK_ID, PART, PART_SET_HEADER, PROPOSAL, VOTE
+from .pb import (AGGREGATE_COMMIT, BLOCK_ID, PART, PART_SET_HEADER,
+                 PROPOSAL, VOTE)
 
 BIT_ARRAY = Msg(
     "cometbft.libs.bits.v1.BitArray",
@@ -117,6 +118,16 @@ VOTE_BATCH = Msg(
     F(1, "votes", "msg", msg=VOTE, repeated=True),
 )
 
+# aggregate-commit catchup (docs/aggregate_commits.md): on an
+# aggregate chain a lagging peer cannot be served reconstructed
+# precommit votes — the stored commit is one aggregate signature —
+# so the reactor ships the aggregate itself.  Only sent to peers
+# that negotiated "aggcommit/1".
+AGG_COMMIT_MSG = Msg(
+    "cometbft.consensus.v2.AggregateCommitCatchup",
+    F(1, "commit", "msg", msg=AGGREGATE_COMMIT, always=True),
+)
+
 MESSAGE = Msg(
     "cometbft.consensus.v2.Message",   # oneof sum
     F(1, "new_round_step", "msg", msg=NEW_ROUND_STEP),
@@ -133,4 +144,5 @@ MESSAGE = Msg(
     F(11, "compact_block", "msg", msg=COMPACT_BLOCK),
     F(12, "vote_batch", "msg", msg=VOTE_BATCH),
     F(13, "compact_block_nack", "msg", msg=COMPACT_BLOCK_NACK),
+    F(14, "aggregate_commit", "msg", msg=AGG_COMMIT_MSG),
 )

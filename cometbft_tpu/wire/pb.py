@@ -150,6 +150,21 @@ COMMIT = Msg(
     F(4, "signatures", "msg", msg=COMMIT_SIG, repeated=True),
 )
 
+# TPU-native extension (docs/aggregate_commits.md): one BLS signature
+# + a signer bitmap instead of per-validator CommitSigs.  Rides in new
+# OPTIONAL fields beside the Commit arms (BLOCK field 5, SIGNED_HEADER
+# field 3), so chains that never enable the feature stay byte-identical
+# on the wire.
+AGGREGATE_COMMIT = Msg(
+    "cometbft.types.v2.AggregateCommit",
+    F(1, "height", "int64"),
+    F(2, "round", "int32"),
+    F(3, "block_id", "msg", msg=BLOCK_ID, always=True),
+    F(4, "signer_count", "int64"),
+    F(5, "signers", "bytes"),
+    F(6, "signature", "bytes"),
+)
+
 EXTENDED_COMMIT_SIG = Msg(
     "cometbft.types.v2.ExtendedCommitSig",
     F(1, "block_id_flag", "enum"),
@@ -209,6 +224,7 @@ SIGNED_HEADER = Msg(
     "cometbft.types.v2.SignedHeader",
     F(1, "header", "msg", msg=HEADER),
     F(2, "commit", "msg", msg=COMMIT),
+    F(3, "aggregate_commit", "msg", msg=AGGREGATE_COMMIT),
 )
 
 LIGHT_BLOCK = Msg(
@@ -271,6 +287,7 @@ BLOCK = Msg(
     F(2, "data", "msg", msg=DATA, always=True),
     F(3, "evidence", "msg", msg=EVIDENCE_LIST, always=True),
     F(4, "last_commit", "msg", msg=COMMIT),
+    F(5, "last_aggregate_commit", "msg", msg=AGGREGATE_COMMIT),
 )
 
 # ---------------------------------------------------------------------------
@@ -354,6 +371,7 @@ FEATURE_PARAMS = Msg(
     "cometbft.types.v2.FeatureParams",
     F(1, "vote_extensions_enable_height", "msg", msg=INT64_VALUE),
     F(2, "pbts_enable_height", "msg", msg=INT64_VALUE),
+    F(3, "aggregate_commit_enable_height", "msg", msg=INT64_VALUE),
 )
 
 CONSENSUS_PARAMS = Msg(

@@ -199,7 +199,9 @@ class NemesisNet:
     def __init__(self, n: int = 4, seed: int = 0,
                  fuzz_profile: Optional[dict] = None,
                  wal_dir: Optional[str] = None,
-                 mempool_gossip: bool = False):
+                 mempool_gossip: bool = False,
+                 key_type: str = "ed25519",
+                 consensus_params=None):
         self.seed = seed
         self.rng = random.Random(seed)
         self.links = LinkTable()
@@ -207,12 +209,19 @@ class NemesisNet:
         self.mempool_gossip = mempool_gossip
         self.fuzzed_conns: list[FuzzedConnection] = []
         # every random artifact (keys included) derives from the seed
-        pvs = [MockPV(ed25519.Ed25519PrivKey(
-            self.rng.getrandbits(256).to_bytes(32, "big")))
-            for _ in range(n)]
+        if key_type == "bls12_381":
+            from cometbft_tpu.crypto import bls12381
+            pvs = [MockPV(bls12381.gen_priv_key_from_secret(
+                self.rng.getrandbits(256).to_bytes(32, "big")))
+                for _ in range(n)]
+        else:
+            pvs = [MockPV(ed25519.Ed25519PrivKey(
+                self.rng.getrandbits(256).to_bytes(32, "big")))
+                for _ in range(n)]
         doc = GenesisDoc(
             chain_id=f"nemesis-{seed}",
             genesis_time=Timestamp(1700000000, 0),
+            consensus_params=consensus_params,
             validators=[GenesisValidator(
                 address=b"", pub_key=pv.get_pub_key(), power=10)
                 for pv in pvs])
@@ -433,6 +442,11 @@ class Scenario:
     # register the mempool reactor on every node so have/want tx
     # gossip + compact-block proposals run under the fault schedule
     mempool_gossip: bool = False
+    # validator key type ("ed25519" | "bls12_381") and optional
+    # consensus-params override (the aggregate-commit scenario runs a
+    # BLS valset with feature.aggregate_commit_enable_height set)
+    key_type: str = "ed25519"
+    consensus_params: object = None
 
 
 def archive_dir() -> str:
@@ -478,7 +492,9 @@ async def _run_scenario_inner(s: Scenario,
                               wal_dir: Optional[str]) -> NemesisNet:
     net = NemesisNet(s.n, seed=s.seed, fuzz_profile=s.fuzz,
                      wal_dir=wal_dir,
-                     mempool_gossip=s.mempool_gossip)
+                     mempool_gossip=s.mempool_gossip,
+                     key_type=s.key_type,
+                     consensus_params=s.consensus_params)
     await net.start()
     try:
         try:

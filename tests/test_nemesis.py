@@ -110,6 +110,42 @@ class TestNemesisScenarios:
             ),
             recovery_blocks=3)))
 
+    def test_aggregate_commit_crash_restart_fuzz(self):
+        """Aggregate-commit chain (BLS valset, one aggregate signature
+        + signer bitmap per commit — docs/aggregate_commits.md) under
+        reorder/duplicate link fuzz plus a hard crash/restart through
+        the REAL recovery path (file WAL + ABCI handshake + catchup
+        replay).  Restart recovery replays blocks whose LastCommit is
+        the aggregate form from the store, and the node keeps
+        proposing/validating aggregates afterwards.  Gated on zero
+        safety violations and bounded recovery, like every tier-1
+        scenario."""
+        from cometbft_tpu.types.params import (
+            ConsensusParams, FeatureParams, ValidatorParams,
+        )
+        run(run_scenario(Scenario(
+            name="aggregate-commit",
+            seed=29,
+            use_wal=True,
+            key_type="bls12_381",
+            consensus_params=ConsensusParams(
+                validator=ValidatorParams(
+                    pub_key_types=["bls12_381"]),
+                feature=FeatureParams(
+                    pbts_enable_height=1,
+                    aggregate_commit_enable_height=1)),
+            fuzz=dict(prob_reorder=0.05, prob_duplicate=0.05,
+                      prob_delay=0.03, max_delay_s=0.01),
+            steps=(
+                ("wait_blocks", 3),
+                ("crash", 1),
+                ("expect_progress", (0, 2, 3), 2, 90.0),
+                ("restart", 1),
+                ("wait_blocks", 2),
+            ),
+            recovery_blocks=3,
+            recovery_timeout_s=120.0)))
+
     def test_recon_gossip_under_fuzz_and_partition(self):
         """ISSUE 12: have/want tx gossip + compact-block proposals
         (the mempool reactor, negotiated by default) running under

@@ -54,10 +54,15 @@ def collect_catalog() -> list[dict]:
                 ProxyMetrics, SupervisorMetrics, LightserveMetrics):
         cls(reg)
     # force the lazy process-global families into existence
+    from cometbft_tpu.crypto import bls12381
+    from cometbft_tpu.types import validation as types_validation
     crypto_batch.verify_seconds_histogram()
     crypto_batch.tpu_breaker()
     ed25519_jax._dispatch_histogram()
+    ed25519_jax._refine_counter()
     signature_cache._metrics()
+    bls12381._agg_pk_metrics()
+    types_validation.commit_verify_histogram()
 
     seen = set()
     out = []

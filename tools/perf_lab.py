@@ -632,6 +632,104 @@ def bench_bftlint_selfcheck(fast: bool):
 
 
 # name -> (fn, in_fast_subset)
+def _agg_commit_fixture(n: int):
+    """An n-validator BLS valset + verified-shape aggregate commit.
+
+    Tiny secret scalars keep fixture construction fast at 10k
+    validators; verification cost is independent of scalar size (the
+    pairing and the G1 point sum see full-width field elements)."""
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.crypto import _bls12381_math as m
+    from cometbft_tpu.libs.bits import BitArray
+    from cometbft_tpu.types import canonical
+    from cometbft_tpu.types.block_id import BlockID
+    from cometbft_tpu.types.commit import AggregateCommit
+    from cometbft_tpu.types.part_set import PartSetHeader
+    from cometbft_tpu.types.timestamp import Timestamp
+    from cometbft_tpu.types.validator_set import (
+        Validator, ValidatorSet,
+    )
+
+    bid = BlockID(hash=b"\x0b" * 32,
+                  part_set_header=PartSetHeader(1, b"\x0c" * 32))
+    height = 9
+    sks = list(range(2, n + 2))
+    vals_list = []
+    pk_by_addr = {}
+    for sk in sks:
+        pk = bls.Bls12381PubKey._from_point_unchecked(
+            m.pt_mul(m.G1_OPS, m.G1_GEN, sk))
+        vals_list.append(Validator(address=pk.address(), pub_key=pk,
+                                   voting_power=10))
+        pk_by_addr[pk.address()] = sk
+    vals = ValidatorSet(vals_list)
+    sb = canonical.vote_sign_bytes(
+        "perf-chain", canonical.PRECOMMIT_TYPE, height, 0, bid,
+        Timestamp.zero())
+    # aggregate signature = [sum sk]H(m): one G2 mul instead of n
+    # signs + n adds — same point the real aggregation produces
+    agg_sk = sum(pk_by_addr[v.address] for v in vals.validators) \
+        % m.R_ORDER
+    hm = m.hash_to_g2(sb, bls.DST)
+    agg_sig = m.g2_compress(m.pt_mul(m.G2_OPS, hm, agg_sk))
+    signers = BitArray(n)
+    for i in range(n):
+        signers.set_index(i, True)
+    commit = AggregateCommit(height=height, round=0, block_id=bid,
+                             signers=signers, signature=agg_sig)
+    vals.hash()   # memoize: the valset hash is not what we measure
+    return vals, commit, bid, height
+
+
+def bench_bls_aggregate_commit_verify(n: int, reps: int,
+                                      warm: bool):
+    """O(1) aggregate-commit verification (docs/aggregate_commits.md):
+    cold pays the G1 pubkey point-sum + one pairing; warm hits the
+    aggregate-pubkey cache and pays the pairing alone.  The ISSUE 13
+    acceptance gate lives at the 10k shape."""
+    from cometbft_tpu.crypto import bls12381 as bls
+    from cometbft_tpu.types import validation
+
+    def setup():
+        return _agg_commit_fixture(n)
+
+    def run(fixture):
+        vals, commit, bid, height = fixture
+        if not warm:
+            bls._AGG_PK_CACHE = None     # force the G1 point-sum
+        validation.verify_commit_light("perf-chain", vals, bid,
+                                       height, commit)
+
+    if warm:
+        fixture = _agg_commit_fixture(n)
+        run(fixture)                     # prime the pubkey cache
+        stats = measure(lambda _: run(fixture), reps=reps,
+                        setup=lambda: None, warmup=1)
+    else:
+        stats = measure(run, reps=reps, setup=setup, warmup=1)
+    stats["validators"] = n
+    stats["warm_pubkey_cache"] = warm
+    return stats
+
+
+def bench_bls_agg_verify_100_cold(fast: bool):
+    return bench_bls_aggregate_commit_verify(
+        100, reps=4 if fast else 6, warm=False)
+
+
+def bench_bls_agg_verify_1k_cold(fast: bool):
+    return bench_bls_aggregate_commit_verify(1000, reps=4, warm=False)
+
+
+def bench_bls_agg_verify_10k_cold(fast: bool):
+    return bench_bls_aggregate_commit_verify(10000, reps=4,
+                                             warm=False)
+
+
+def bench_bls_agg_verify_10k_warm(fast: bool):
+    return bench_bls_aggregate_commit_verify(10000, reps=4, warm=True)
+
+
 BENCHMARKS = {
     "batch_verify_cpu_pad64": (bench_batch_verify_pad64, True),
     "batch_verify_cpu_pad1024": (bench_batch_verify_pad1024, False),
@@ -653,6 +751,14 @@ BENCHMARKS = {
     "compact_block_reconstruct": (
         bench_compact_block_reconstruct, True),
     "bftlint_selfcheck": (bench_bftlint_selfcheck, True),
+    "bls_aggregate_commit_verify_100_cold": (
+        bench_bls_agg_verify_100_cold, True),
+    "bls_aggregate_commit_verify_1k_cold": (
+        bench_bls_agg_verify_1k_cold, False),
+    "bls_aggregate_commit_verify_10k_cold": (
+        bench_bls_agg_verify_10k_cold, False),
+    "bls_aggregate_commit_verify_10k_warm": (
+        bench_bls_agg_verify_10k_warm, False),
 }
 
 
