@@ -535,12 +535,25 @@ class ConsensusReactor(Reactor):
                 # scenario caught); the state machine unpacks it
                 self.cs.send_peer(msg, peer.id)
             elif isinstance(msg, AggregateCommitMessage):
-                # aggregate-commit catchup: verified and injected as
-                # +2/3 precommit evidence by the state machine
-                tracing.instant(tracing.CONSENSUS, "agg_commit_recv",
-                                height=msg.commit.height,
-                                peer=peer.id[:12])
-                self.cs.send_peer(msg, peer.id)
+                # aggregate-commit catchup: verified (off the event
+                # loop — ISSUE 14) and injected as +2/3 precommit
+                # evidence by the state machine.  Provably-stale or
+                # forger-peer aggregates shed HERE so the input
+                # queue — the backpressure buffer while a verdict
+                # barrier is outstanding — only carries messages
+                # that can still matter.
+                if self.cs.aggregate_commit_relevant(msg.commit,
+                                                     peer.id):
+                    tracing.instant(tracing.CONSENSUS,
+                                    "agg_commit_recv",
+                                    height=msg.commit.height,
+                                    peer=peer.id[:12])
+                    self.cs.send_peer(msg, peer.id)
+                else:
+                    tracing.instant(tracing.CONSENSUS,
+                                    "agg_commit_shed",
+                                    height=msg.commit.height,
+                                    peer=peer.id[:12])
         elif chan_id == VOTE_SET_BITS_CHANNEL:
             if isinstance(msg, VoteSetBitsMessage) and \
                     rs.height == msg.height and msg.votes is not None:

@@ -300,7 +300,7 @@ class ConsensusState:
                     except asyncio.QueueEmpty:
                         break
                 if len(burst) > 1:
-                    self._preverify_burst(burst)
+                    await self._preverify_burst(burst)
                 for i, (kind, msg, peer_id) in enumerate(burst):
                     if i:
                         # keep the old per-message fairness yield: the
@@ -322,13 +322,22 @@ class ConsensusState:
                 self.wal.flush_and_sync()
                 raise
 
-    def _preverify_burst(self, burst) -> None:
+    async def _preverify_burst(self, burst) -> None:
         """Collect the signatures of queued VoteMessages for the
         CURRENT height's validator set and batch-verify them into the
         verified-triple memo (types/vote.py) — the tally-path batching
         the reference leaves per-vote (SURVEY: vote_set.go:219-236).
-        Purely advisory: lookup failures or invalid signatures are
-        left for the serial path, whose verdicts do not change."""
+
+        The batch itself runs OFF the event loop, on the verification
+        staging worker (crypto/pipeline.py): this await is a verdict
+        barrier, not a stall — while the native kernels verify the
+        storm GIL-free, the loop keeps draining p2p recv, gossip and
+        RPC, which is exactly the stall QA_r08 profiled stacking
+        behind a synchronous burst verify.  Burst messages are
+        processed only after the barrier, so the state machine sees
+        the same serial order as before.  Purely advisory: lookup
+        failures or invalid signatures are left for the serial path,
+        whose verdicts do not change."""
         entries = []
         for kind, msg, _peer in burst:
             if kind == "timeout" or not isinstance(msg, VoteMessage):
@@ -347,7 +356,15 @@ class ConsensusState:
             self._append_vote_entries(
                 entries, vote, val.pub_key, self.sm_state.chain_id)
         if len(entries) >= 2:
-            vote_mod.preverify_signatures(entries)
+            try:
+                await asyncio.wrap_future(
+                    vote_mod.preverify_signatures_async(entries))
+            except Exception:
+                # advisory: a worker failure just means the serial
+                # tally verifies each signature itself
+                self.logger.debug(
+                    "burst pre-verification failed (serial tally "
+                    "decides)", exc_info=True)
 
     def _append_vote_entries(self, entries, vote, pub_key,
                              chain_id: str) -> None:
@@ -531,6 +548,20 @@ class ConsensusState:
             rs.start_time.sub(Timestamp.now()) / 1e9
         self.sm_state = state
         self._new_step()
+
+    async def reconstruct_last_commit_off_loop(
+            self, state: SMState) -> None:
+        """``_reconstruct_last_commit_if_needed`` on the verification
+        staging worker — the blocksync→consensus switch reconstructs
+        LastCommit while the p2p loop is live, and the commit's batch
+        signature verification (O(validators) native kernel work)
+        must not stall it.  Safe off-thread: consensus has not
+        started yet at the switch, so RoundState has no other
+        writer, and the native kernels release the GIL so the loop
+        keeps scheduling while the worker verifies."""
+        from ..crypto import pipeline
+        await pipeline.run_off_loop(
+            self._reconstruct_last_commit_if_needed, state)
 
     def _reconstruct_last_commit_if_needed(self, state: SMState) -> None:
         """Rebuild LastCommit from the stored seen commit on restart
@@ -1596,6 +1627,29 @@ class ConsensusState:
                              height=vote.height)
             return False
 
+    def aggregate_commit_relevant(self, agg, peer_id: str = "") \
+            -> bool:
+        """Cheap (no-crypto) admission screen for the reactor: False
+        when an incoming aggregate catchup commit provably cannot be
+        ingested — wrong height, already at/past commit, feature off,
+        or a known forger peer.  Shedding these BEFORE the input
+        queue keeps the queue (the backpressure buffer while a
+        verdict barrier is outstanding) for messages that can still
+        matter; the authoritative re-check in
+        ``_try_add_aggregate_commit`` is unchanged."""
+        rs = self.rs
+        if not isinstance(agg, AggregateCommit):
+            return False
+        if self.sm_state is None or \
+                not self.sm_state.consensus_params.feature \
+                .aggregate_commits_enabled(agg.height):
+            return False
+        if agg.height != rs.height or rs.step >= STEP_COMMIT:
+            return False
+        if peer_id and peer_id in self._agg_commit_forgers:
+            return False
+        return True
+
     async def _try_add_aggregate_commit(self, agg,
                                         peer_id: str) -> bool:
         """Catchup ingestion on an aggregate-commit chain: a verified
@@ -1607,24 +1661,23 @@ class ConsensusState:
         parts-complete path finalize."""
         from ..types import validation as types_validation
         rs = self.rs
-        if not isinstance(agg, AggregateCommit):
-            return False
-        if self.sm_state is None or \
-                not self.sm_state.consensus_params.feature \
-                .aggregate_commits_enabled(agg.height):
-            return False
-        if agg.height != rs.height or rs.step >= STEP_COMMIT:
-            return False
-        # forgery containment: verifying an aggregate costs a G1
-        # point-sum + pairing (~10 ms at 10k validators), so a peer
-        # that ever sent an invalid one — honest peers never do, they
-        # verified the commit before storing it — loses this channel
-        # (until evicted from the bounded forger table).  Bounds the
-        # attack at one wasted verification per peer identity.
-        if peer_id and peer_id in self._agg_commit_forgers:
+        # same admission rules the reactor screens with (ONE source
+        # of truth) — re-checked here because the reactor's verdict
+        # aged in the input queue, and the forger check bounds the
+        # attack at one wasted verification per peer identity (the
+        # pairing costs ~10 ms at 10k validators; honest peers never
+        # send an invalid aggregate — they verified before storing)
+        if not self.aggregate_commit_relevant(agg, peer_id):
             return False
         try:
-            types_validation.verify_commit(
+            # off the event loop (crypto/pipeline.py seam): the
+            # pairing runs GIL-free on the staging worker while the
+            # loop keeps serving p2p/RPC.  RoundState stays
+            # consistent across the await — this receive routine is
+            # its only writer and it is parked right here.
+            from ..crypto import pipeline as _pipeline
+            await _pipeline.run_off_loop(
+                types_validation.verify_commit,
                 self.sm_state.chain_id, rs.validators, agg.block_id,
                 agg.height, agg)
         except types_validation.VerificationError as e:

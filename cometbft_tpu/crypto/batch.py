@@ -9,6 +9,14 @@ config key from BASELINE.json's north star). Backends:
              TPU (or any JAX device) is available. Falls back to "cpu" when
              JAX import or device init fails.
   * "cpu"  — per-signature OpenSSL loop (crypto/ed25519.py).
+
+Every verifier this module hands out also answers ``verify_async()``
+(keys.BatchVerifier): an awaitable verdict future whose work runs on
+the shared verification staging worker (crypto/pipeline.py) — the
+Traced/Guarded wrappers keep their synchronous semantics because the
+wrapped ``verify()`` is exactly what executes off-loop, and large
+ed25519 CPU batches additionally pipeline pad-bucket tiles inside it
+(overlapped host_prep / GIL-free kernel, per-tile reject bisection).
 """
 from __future__ import annotations
 

@@ -187,10 +187,22 @@ class CpuBatchVerifier(BatchVerifier):
     or when the native module is unavailable — each signature is
     verified individually to produce the exact validity mask, the
     same fallback contract as the TPU path.
+
+    Batches larger than one pipeline tile (crypto/pipeline.py,
+    default 4096) verify as a tiled pipeline through the native tile
+    kernel: tile i runs GIL-free on the kernel worker while this
+    thread packs and stages tile i+1 and settles tile i-1, and a
+    reject bisects WITHIN its tile — one bad signature in a 10k
+    burst re-checks O(log tile) subsets instead of the whole batch.
+    Measured at the 10k-distinct-key commit-burst shape on the
+    1-vCPU rig: 145 ms vs 187 ms monolithic (perf_baseline
+    ed25519_pipelined_dispatch).  ``monolithic=True`` pins the
+    pre-pipeline single-dispatch path (perf_lab's comparison arm).
     """
 
-    def __init__(self):
+    def __init__(self, monolithic: bool = False):
         self._items: list[tuple[Ed25519PubKey, bytes, bytes]] = []
+        self._monolithic = monolithic
 
     def add(self, pub_key: PubKey, msg: bytes, sig: bytes) -> None:
         if not isinstance(pub_key, Ed25519PubKey):
@@ -202,6 +214,10 @@ class CpuBatchVerifier(BatchVerifier):
     def __len__(self) -> int:
         return len(self._items)
 
+    def _verify_one(self, i: int) -> bool:
+        pk, m, s = self._items[i]
+        return pk.verify_signature(m, s)
+
     def verify(self) -> tuple[bool, Sequence[bool]]:
         n = len(self._items)
         if n >= 2:
@@ -209,6 +225,11 @@ class CpuBatchVerifier(BatchVerifier):
             if native is not None:
                 raw = [(pk.bytes(), m, s) for pk, m, s in self._items]
                 try:
+                    from . import pipeline
+                    if not self._monolithic and \
+                            n > pipeline.tile_size():
+                        return pipeline.verify_items_pipelined(
+                            native, raw, self._verify_one)
                     if self._batch_holds(native, raw):
                         return True, [True] * n
                     # batch rejected: bisect with the native batch
@@ -220,8 +241,7 @@ class CpuBatchVerifier(BatchVerifier):
                         list(range(n)), mask,
                         lambda half: self._batch_holds(
                             native, [raw[i] for i in half]),
-                        lambda i: self._items[i][0].verify_signature(
-                            self._items[i][1], self._items[i][2]))
+                        self._verify_one)
                     return all(mask), mask
                 except Exception:
                     pass    # malformed shapes fall through per-sig

@@ -69,6 +69,18 @@ class BatchVerifier(abc.ABC):
     @abc.abstractmethod
     def verify(self) -> tuple[bool, Sequence[bool]]: ...
 
+    def verify_async(self):
+        """Awaitable verdict future: ``verify()`` runs on the shared
+        verification staging worker, so the awaiting event loop never
+        pays for the batch (the native kernels release the GIL; large
+        ed25519 batches additionally pipeline pad-bucket tiles inside
+        ``verify()`` — crypto/pipeline.py).  Every wrapper
+        (Traced/Guarded) keeps its synchronous semantics: the wrapped
+        ``verify()`` is what executes on the worker.  Must be awaited
+        from a running loop."""
+        from .pipeline import run_off_loop
+        return run_off_loop(self.verify)
+
 
 def bisect_bad(idxs: list, mask: list, subset_holds, verify_one) -> None:
     """Shared batch-reject bisection (ed25519 CPU batch + BLS RLC):

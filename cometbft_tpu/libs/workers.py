@@ -1,0 +1,145 @@
+"""Supervised single-thread workers for off-event-loop compute.
+
+The 1-vCPU QA rig's profile (QA_r08) shows signature-verification
+stalls stacking behind the p2p recv routine: a synchronous 10k-sig
+batch verify freezes the ENTIRE node for the duration because there
+is exactly one event loop.  The fix is structural — CPU-heavy verify
+work runs on a dedicated worker thread whose native kernels release
+the GIL, and the event loop only ever awaits a future.
+
+``SupervisedWorker`` is deliberately smaller than a generic pool:
+
+  * exactly ONE persistent thread — verification is serialized by
+    construction, so two concurrent bursts cannot double the node's
+    CPU demand (on the 1-vCPU rig an unbounded pool would just trade
+    event-loop stalls for scheduler thrash);
+  * every submitted task is timed from submit to start
+    (``<ns>_<sub>_queue_wait_seconds``) and the pending depth is
+    exported as a gauge — the queue REVEALS overload instead of
+    absorbing it silently;
+  * a task exception is captured into the returned future AND logged
+    by the worker (callers of advisory work often discard the future;
+    a swallowed crash must still be visible), and the worker thread
+    itself survives — the supervision contract the node's async tasks
+    get from libs/supervisor.py, ported to a thread.
+
+Not a replacement for asyncio.to_thread: tasks here are expected to
+release the GIL (native batch verify, pairing products), which is
+what makes the off-loop move a real win on a single core — the event
+loop keeps getting scheduled while the kernel runs in C.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future, InvalidStateError
+from typing import Callable, Optional
+
+from . import metrics as libmetrics
+from .log import Logger, new_logger
+
+_QUEUE_WAIT_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+                       0.05, 0.1, 0.25, 0.5, 1.0, 2.5)
+
+
+class SupervisedWorker:
+    """One named worker thread with task-queue metrics and crash
+    logging.  ``submit(fn, *args)`` returns a concurrent Future;
+    tasks run in submission order on the single thread."""
+
+    def __init__(self, worker_name: str, subsystem: str = "crypto",
+                 logger: Optional[Logger] = None,
+                 registry: Optional[libmetrics.Registry] = None):
+        self._name = worker_name
+        self._logger = logger or new_logger("workers")
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._depth = 0
+        self._depth_lock = threading.Lock()
+        self._stopped = False
+        reg = registry or libmetrics.DEFAULT
+        wait_hist = reg.histogram(
+            subsystem, "verify_queue_wait_seconds",
+            "Time a task submitted to a verification worker waited "
+            "in its queue before starting, by worker.",
+            labels=("worker",), buckets=_QUEUE_WAIT_BUCKETS)
+        depth_gauge = reg.gauge(
+            subsystem, "verify_executor_depth",
+            "Tasks queued or running on a verification worker, by "
+            "worker.", labels=("worker",))
+        # one child per worker, bound at construction: worker_name is
+        # hard-coded at the few construction sites (bftlint
+        # reviewed-bounded label name)
+        self._wait_hist = wait_hist.with_labels(worker_name)
+        self._depth_gauge = depth_gauge.with_labels(worker_name)
+        self._thread = threading.Thread(
+            target=self._run, name=f"worker-{worker_name}",
+            daemon=True)
+        self._thread.start()
+
+    # ------------------------------------------------------------------
+    def submit(self, fn: Callable, *args) -> Future:
+        """Queue ``fn(*args)``; the future resolves with its result or
+        exception.  Raises RuntimeError after ``stop()``."""
+        if self._stopped:
+            raise RuntimeError(f"worker {self._name} is stopped")
+        fut: Future = Future()
+        with self._depth_lock:
+            self._depth += 1
+            self._depth_gauge.set(self._depth)
+        self._q.put((fut, fn, args, time.perf_counter()))
+        return fut
+
+    def depth(self) -> int:
+        return self._depth
+
+    def stop(self, wait: bool = True) -> None:
+        """Drain-and-join: queued tasks still run (verification
+        futures someone awaits must resolve), then the thread exits."""
+        if self._stopped:
+            return
+        self._stopped = True
+        self._q.put(None)
+        if wait:
+            self._thread.join(timeout=30)
+
+    # ------------------------------------------------------------------
+    def _run(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                # drain-before-exit: a submit() racing stop() can
+                # enqueue BEHIND the sentinel (the _stopped check and
+                # the q.put are not atomic); those futures must still
+                # resolve — the stop() contract
+                while True:
+                    try:
+                        item = self._q.get_nowait()
+                    except queue.Empty:
+                        return
+                    if item is not None:
+                        self._run_task(item)
+            self._run_task(item)
+
+    def _run_task(self, item) -> None:
+        fut, fn, args, t_submit = item
+        self._wait_hist.observe(time.perf_counter() - t_submit)
+        if fut.set_running_or_notify_cancel():
+            try:
+                fut.set_result(fn(*args))
+            except BaseException as e:  # noqa: BLE001 — supervised:
+                # captured into the future AND logged (advisory
+                # callers drop futures; the crash must be visible)
+                self._logger.error(
+                    "verify worker task failed",
+                    worker=self._name, exc_info=True)
+                try:
+                    fut.set_exception(e)
+                except InvalidStateError:
+                    pass        # future cancelled while running
+        with self._depth_lock:
+            self._depth -= 1
+            self._depth_gauge.set(self._depth)
+
+
+__all__ = ["SupervisedWorker"]

@@ -413,12 +413,19 @@ class Node:
             """Reference: consensus.Reactor.SwitchToConsensus —
             reconstruct LastCommit from the stored seen commit before
             updating to the synced state."""
-            self.consensus_reactor.wait_sync = False
             if new_state.last_block_height > 0:
                 self.consensus_state.rs.last_commit = None
-                self.consensus_state._reconstruct_last_commit_if_needed(
-                    new_state)
+                # off the event loop: the seen commit's batch verify
+                # is O(validators) kernel work and the p2p loop is
+                # live during the switch (crypto/pipeline.py seam)
+                await self.consensus_state \
+                    .reconstruct_last_commit_off_loop(new_state)
             self.consensus_state.update_to_state(new_state)
+            # flip wait_sync only once RoundState reflects the synced
+            # height: the off-loop reconstruction above yields the
+            # loop, and a peer connecting mid-window must not be sent
+            # a NewRoundStep built from the stale pre-sync state
+            self.consensus_reactor.wait_sync = False
             await self.consensus_state.start()
             self.logger.info("Switched from blocksync to consensus",
                              height=height)

@@ -27,15 +27,40 @@ ARTIFACT_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
 
 def _xla_buckets() -> tuple:
     """Mirror the dispatch's runtime buckets exactly — a mismatched
-    artifact is unreachable dead weight."""
+    artifact is unreachable dead weight.  Includes the overlapped
+    pipeline's tile bucket (ed25519_jax._verify_pipelined pads every
+    balanced tile to a pad-bucket shape, so a COMETBFT_TPU_VERIFY_TILE
+    override outside the base ladder still exports an artifact)."""
     from .ed25519_jax import _BUCKETS
-    return tuple(_BUCKETS)
+    return tuple(sorted(set(_BUCKETS) | set(tile_buckets())))
+
+
+def tile_buckets() -> tuple:
+    """Pad-bucket shapes the tiled verification pipeline dispatches
+    at: every balanced tile pads to ``_bucket(tile)`` for tiles up to
+    the configured tile size (crypto/pipeline.tile_size)."""
+    from ..crypto.pipeline import tile_size
+    from .ed25519_jax import _bucket
+    return (_bucket(tile_size()),)
+
+
+def missing_tile_artifacts(kernel: str = "xla") -> list:
+    """Tile-bucket shapes the pipeline would dispatch that have no
+    committed artifact — tpu_probe surfaces these before a hardware
+    window so the window is never burned tracing a tile shape."""
+    out = []
+    for m in tile_buckets():
+        if kernel.startswith("pallas"):
+            from .ed25519_pallas import BLOCK
+            m = max(m, BLOCK)
+        if not os.path.exists(_path(kernel, m)):
+            out.append(m)
+    return out
 
 
 def _pallas_buckets() -> tuple:
-    from .ed25519_jax import _BUCKETS
     from .ed25519_pallas import BLOCK
-    return tuple(max(b, BLOCK) for b in _BUCKETS)
+    return tuple(max(b, BLOCK) for b in _xla_buckets())
 
 
 def _path(kernel: str, m: int) -> str:

@@ -143,6 +143,19 @@ def preverify_signatures(entries) -> None:
                 _memo_reject(key)
 
 
+def preverify_signatures_async(entries):
+    """``preverify_signatures`` on the verification staging worker:
+    returns a concurrent Future that resolves (to None) once the
+    burst's verdicts are memoized — the consensus receive routine
+    awaits it as a verdict barrier while the event loop keeps
+    draining gossip (consensus/state.py).  Memo reads/writes are
+    single-op dict mutations, atomic under the GIL, so the worker
+    and the loop-side ``checked_verify`` interleave safely; the memo
+    is advisory either way (a miss just re-verifies serially)."""
+    from ..crypto import pipeline
+    return pipeline.submit(preverify_signatures, entries)
+
+
 @dataclass
 class Vote:
     type: int = canonical.UNKNOWN_TYPE

@@ -859,6 +859,103 @@ PyObject* ed25519_batch_verify(PyObject*, PyObject* args) {
     return PyLong_FromLong(ok);
 }
 
+// ed25519_batch_verify_tile(pubs, msgs, lens, sigs, z) -> int
+// The pipeline's per-tile entry (KERNEL_NOTES round 6): packed-blob
+// calling convention — pubs 32n, sigs 64n, z 16n, msgs concatenated
+// with lens as n little-endian uint32 — so a tile dispatch costs four
+// buffer borrows instead of 3n PyObject extractions.  Returns 1 iff
+// the tile's RLC batch equation holds (ZIP-215), 0 on malformed
+// input or batch reject (caller bisects within the tile).  The
+// signed-digit MSM + cached fe_sqr decompression run with the GIL
+// released on the pipeline's kernel worker thread.
+PyObject* ed25519_batch_verify_tile(PyObject*, PyObject* args) {
+    const char *pubs, *msgs, *lens, *sigs, *z_bytes;
+    const char* staged = nullptr;
+    Py_ssize_t pubs_len, msgs_len, lens_len, sigs_len, z_len;
+    Py_ssize_t staged_len = 0;
+    if (!PyArg_ParseTuple(args, "y#y#y#y#y#|y#", &pubs, &pubs_len,
+                          &msgs, &msgs_len, &lens, &lens_len,
+                          &sigs, &sigs_len, &z_bytes, &z_len,
+                          &staged, &staged_len))
+        return nullptr;
+    if (lens_len % 4 != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "lens must be 4 bytes per item");
+        return nullptr;
+    }
+    Py_ssize_t n = lens_len / 4;
+    if (pubs_len != n * 32 || sigs_len != n * 64 || z_len != n * 16) {
+        PyErr_SetString(PyExc_ValueError,
+                        "need 32 pub / 64 sig / 16 z bytes per item");
+        return nullptr;
+    }
+    if (staged != nullptr && staged_len !=
+            n * Py_ssize_t(ed25519_msm::STAGED_REC)) {
+        // a mismatched staged blob is ignored, not an error: it is a
+        // pure speed memo and the verify pass decompresses itself
+        staged = nullptr;
+    }
+    std::vector<ed25519_msm::TileView> items;
+    items.reserve(size_t(n));
+    const uint8_t* lp = reinterpret_cast<const uint8_t*>(lens);
+    size_t off = 0;
+    bool shape_ok = true;
+    for (Py_ssize_t i = 0; i < n; i++) {
+        uint32_t ml;
+        std::memcpy(&ml, lp + i * 4, 4);
+        if (off + ml > size_t(msgs_len)) {
+            shape_ok = false;
+            break;
+        }
+        items.push_back(ed25519_msm::TileView{
+            reinterpret_cast<const uint8_t*>(pubs) + i * 32,
+            reinterpret_cast<const uint8_t*>(msgs) + off, size_t(ml),
+            reinterpret_cast<const uint8_t*>(sigs) + i * 64});
+        off += ml;
+    }
+    if (!shape_ok || off != size_t(msgs_len)) {
+        PyErr_SetString(PyExc_ValueError,
+                        "msgs blob does not match lens");
+        return nullptr;
+    }
+    int ok = 0;
+    const uint8_t* z = reinterpret_cast<const uint8_t*>(z_bytes);
+    Py_BEGIN_ALLOW_THREADS
+    ok = ed25519_msm::batch_verify_tile(
+        items, z, reinterpret_cast<const uint8_t*>(staged));
+    Py_END_ALLOW_THREADS
+    return PyLong_FromLong(ok);
+}
+
+// ed25519_stage_pubs(pubs_blob) -> staged points blob
+// Resolve a blob of 32-byte pubkeys to decompressed A points,
+// GIL-free — the pipeline's staging phase runs this for tile i+1
+// while tile i's MSM executes on the kernel worker.  Cache hits copy
+// out; misses decompress once and fill the shared cache.  The
+// returned blob (81 bytes per key: raw affine x || y limbs +
+// validity byte, process-internal representation) feeds the same
+// tile's ed25519_batch_verify_tile call.
+PyObject* ed25519_stage_pubs(PyObject*, PyObject* arg) {
+    char* buf;
+    Py_ssize_t len;
+    if (PyBytes_AsStringAndSize(arg, &buf, &len) < 0) return nullptr;
+    if (len % 32 != 0) {
+        PyErr_SetString(PyExc_ValueError,
+                        "blob not a multiple of 32");
+        return nullptr;
+    }
+    Py_ssize_t n = len / 32;
+    PyObject* out = PyBytes_FromStringAndSize(
+        nullptr, n * Py_ssize_t(ed25519_msm::STAGED_REC));
+    if (!out) return nullptr;
+    uint8_t* op = reinterpret_cast<uint8_t*>(PyBytes_AS_STRING(out));
+    Py_BEGIN_ALLOW_THREADS
+    ed25519_msm::stage_pubs(reinterpret_cast<const uint8_t*>(buf),
+                            size_t(n), op);
+    Py_END_ALLOW_THREADS
+    return out;
+}
+
 // chacha20poly1305_seal(key, nonce, aad, plaintext) -> ct||tag
 // The p2p secret-connection frame hot path when the python
 // `cryptography` package is absent (see crypto/_aead_fallback.py).
@@ -931,6 +1028,13 @@ PyMethodDef kMethods[] = {
     {"ed25519_prep", ed25519_prep, METH_VARARGS,
      "full batch-verify host prep: (items, m, B, identity) -> "
      "(a_b, r_b, s_win, k_win, pre_bad)"},
+    {"ed25519_batch_verify_tile", ed25519_batch_verify_tile,
+     METH_VARARGS,
+     "per-tile RLC batch verification over packed blobs "
+     "(pubs, msgs, lens, sigs, z[, staged]) -> 1/0"},
+    {"ed25519_stage_pubs", ed25519_stage_pubs, METH_O,
+     "resolve a 32n pubkey blob to a staged A-point blob "
+     "(cache-backed decompression)"},
     {"bls_pairings_product_is_one", bls_pairings_product_is_one,
      METH_O, "prod e(P_i, Q_i) == 1 over raw affine pairs"},
     {"bls_selftest", bls_selftest, METH_NOARGS,

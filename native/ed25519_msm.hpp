@@ -35,6 +35,7 @@
 #include <vector>
 
 #include "sha512.hpp"
+#include "sha512_mb.hpp"
 
 namespace ed25519_msm {
 
@@ -500,11 +501,13 @@ struct BatchItem {
 struct PubCacheSlot {
     bool used = false;
     uint8_t pub[32];
-    ge point;           // affine-extended (Z = 1)
+    fe x, y;            // affine (Z = 1; T = x*y rebuilt on get —
+                        // one mul instead of 80 more bytes per slot,
+                        // so a hit touches 2 cachelines, not 4)
 };
 
 struct PubCache {
-    // 32k slots (~6 MB): covers the north-star 10k-validator set with
+    // 32k slots (~4 MB): covers the north-star 10k-validator set with
     // headroom, so steady-state heights re-verify every validator
     // from the cache; typical sets (hundreds) always fit
     static const size_t SLOTS = 32768;
@@ -527,16 +530,21 @@ struct PubCache {
         PubCacheSlot& sl = slots[s];
         if (!sl.used || std::memcmp(sl.pub, pub, 32) != 0)
             return false;
-        *out = sl.point;
+        out->X = sl.x;
+        out->Y = sl.y;
+        out->Z = fe_one();
+        out->T = fe_mul(sl.x, sl.y);
         return true;
     }
 
     void put(const uint8_t pub[32], const ge& pt) {
+        // decompressed points are affine (Z = 1) by construction
         size_t s = slot_of(pub);
         std::lock_guard<std::mutex> g(mu[s % SHARDS]);
         PubCacheSlot& sl = slots[s];
         std::memcpy(sl.pub, pub, 32);
-        sl.point = pt;
+        sl.x = pt.X;
+        sl.y = pt.Y;
         sl.used = true;
     }
 };
@@ -696,6 +704,406 @@ inline int batch_verify(const std::vector<BatchItem>& items,
                         const uint8_t* z, int nthreads = 1) {
     try {
         return batch_verify_inner(items, z, nthreads);
+    } catch (...) {
+        return 0;       // reject -> caller's per-signature fallback
+    }
+}
+
+// ===================================================================
+// Tile kernel (KERNEL_NOTES round 6): the per-tile entry behind the
+// overlapped verification pipeline (crypto/pipeline.py).  The legacy
+// batch_verify above is preserved byte-for-byte as the monolithic
+// comparison arm (perf_lab ed25519_pipelined_dispatch) and the
+// fallback for modules built before the tile entries existed; the
+// kernel-geometry improvements below are tile-path only until the
+// round-7 unification pass:
+//
+//   * dedicated squaring (fe_sqr: 15 wide products vs fe_mul's 25)
+//     through the decompression sqrt chain — the chain is ~95%
+//     squarings, and R-point decompression is ~1/3 of the e2e path;
+//   * signed-digit Pippenger windows (digits in (-2^(c-1), 2^(c-1)]):
+//     half the buckets, so the per-window sweep — the cost tiling
+//     MULTIPLIES, one sweep per tile instead of one per batch — is
+//     halved, which is what makes a tiled pass cheaper than the
+//     monolithic MSM instead of ~10% dearer;
+//   * mixed addition for bucket accumulation (decompressed inputs are
+//     affine, Z = 1: one field mul saved per point add);
+//   * a packed-blob calling convention (pubs/msgs/lens/sigs as four
+//     contiguous buffers) so a 10k-sig burst does not pay 30k
+//     PyObject extractions per dispatch.
+
+inline fe fe_sqr(const fe& a) {
+    uint64_t a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3],
+             a4 = a.v[4];
+    uint64_t a1_2 = a1 * 2, a3_19 = a3 * 19, a4_19 = a4 * 19;
+    u128 t0 = (u128)a0 * a0 + (u128)a1_2 * a4_19 +
+              (u128)(a2 * 2) * a3_19;
+    u128 t1 = (u128)a0 * (a1 * 2) + (u128)(a2 * 2) * a4_19 +
+              (u128)a3 * a3_19;
+    u128 t2 = (u128)a0 * (a2 * 2) + (u128)a1 * a1 +
+              (u128)(a3 * 2) * a4_19;
+    u128 t3 = (u128)a0 * (a3 * 2) + (u128)a1_2 * a2 +
+              (u128)a4 * a4_19;
+    u128 t4 = (u128)a0 * (a4 * 2) + (u128)a1_2 * a3 +
+              (u128)a2 * a2;
+    fe r;
+    u128 c;
+    r.v[0] = (uint64_t)t0 & MASK51; c = t0 >> 51;
+    t1 += c;
+    r.v[1] = (uint64_t)t1 & MASK51; c = t1 >> 51;
+    t2 += c;
+    r.v[2] = (uint64_t)t2 & MASK51; c = t2 >> 51;
+    t3 += c;
+    r.v[3] = (uint64_t)t3 & MASK51; c = t3 >> 51;
+    t4 += c;
+    r.v[4] = (uint64_t)t4 & MASK51; c = t4 >> 51;
+    u128 f = c * 19 + r.v[0];
+    r.v[0] = (uint64_t)f & MASK51;
+    r.v[1] += (uint64_t)(f >> 51);
+    return r;
+}
+
+inline fe fe_pow2k_sqr(fe a, int k) {
+    while (k--) a = fe_sqr(a);
+    return a;
+}
+
+inline fe fe_pow22523_sqr(const fe& a) {
+    fe x2 = fe_sqr(a);
+    fe x4 = fe_sqr(x2);
+    fe x8 = fe_sqr(x4);
+    fe z9 = fe_mul(a, x8);
+    fe z11 = fe_mul(x2, z9);
+    fe z22 = fe_sqr(z11);
+    fe z_5_0 = fe_mul(z9, z22);
+    fe z_10_0 = fe_mul(fe_pow2k_sqr(z_5_0, 5), z_5_0);
+    fe z_20_0 = fe_mul(fe_pow2k_sqr(z_10_0, 10), z_10_0);
+    fe z_40_0 = fe_mul(fe_pow2k_sqr(z_20_0, 20), z_20_0);
+    fe z_50_0 = fe_mul(fe_pow2k_sqr(z_40_0, 10), z_10_0);
+    fe z_100_0 = fe_mul(fe_pow2k_sqr(z_50_0, 50), z_50_0);
+    fe z_200_0 = fe_mul(fe_pow2k_sqr(z_100_0, 100), z_100_0);
+    fe z_250_0 = fe_mul(fe_pow2k_sqr(z_200_0, 50), z_50_0);
+    return fe_mul(fe_pow2k_sqr(z_250_0, 2), a);
+}
+
+// ZIP-215 permissive decompression through the fe_sqr chain —
+// identical acceptance set to ge_decompress (differentially tested
+// in tests/test_verify_pipeline.py), ~17% faster.
+inline bool ge_decompress_fast(const uint8_t s[32], ge* out) {
+    uint8_t yb[32];
+    std::memcpy(yb, s, 32);
+    int sign = yb[31] >> 7;
+    yb[31] &= 0x7F;
+    fe y = fe_frombytes(yb);
+    fe yy = fe_sqr(y);
+    fe u = fe_sub(yy, fe_one());
+    fe v = fe_add(fe_mul(yy, fe_frombytes(D_BYTES)), fe_one());
+    fe v3 = fe_mul(fe_sqr(v), v);
+    fe v7 = fe_mul(fe_sqr(v3), v);
+    fe x = fe_mul(fe_mul(u, v3), fe_pow22523_sqr(fe_mul(u, v7)));
+    fe vxx = fe_mul(v, fe_sqr(x));
+    fe un = u;                  // same carry rationale as ge_decompress
+    fe_carry(un);
+    if (!fe_eq(vxx, un)) {
+        if (fe_is_zero(fe_add(vxx, un))) {
+            x = fe_mul(x, fe_frombytes(SQRTM1_BYTES));
+        } else {
+            return false;
+        }
+    }
+    if ((int)fe_parity(x) != sign) x = fe_neg(x);
+    out->X = x;
+    out->Y = y;
+    out->Z = fe_one();
+    out->T = fe_mul(x, y);
+    return true;
+}
+
+inline bool decompress_pub_cached_fast(const uint8_t pub[32],
+                                       ge* out) {
+    PubCache& c = pub_cache();
+    if (c.get(pub, out)) return true;
+    if (!ge_decompress_fast(pub, out)) return false;
+    c.put(pub, *out);
+    return true;
+}
+
+// Staged A-point record: affine x || y as raw limb structs (80
+// bytes, process-internal representation — the blob never leaves the
+// process) + 1 validity byte.  Invalid encodings mark 0 and the
+// verify pass rejects them itself.
+static const size_t STAGED_REC = 2 * sizeof(fe) + 1;
+
+// Resolve a blob of pubkeys to decompressed A points — the
+// pipeline's staging phase runs this for tile i+1 while tile i's MSM
+// executes on the kernel worker.  Each key is resolved exactly once
+// per tile (cache hit, or decompress + cache fill) and the points
+// travel to the verify pass in the staged blob, so a direct-mapped
+// collision never costs a second decompression in the kernel.
+inline void stage_pubs(const uint8_t* pubs, size_t n, uint8_t* out) {
+    PubCache& c = pub_cache();
+    ge pt;
+    for (size_t i = 0; i < n; i++) {
+        const uint8_t* pub = pubs + i * 32;
+        uint8_t* rec = out + i * STAGED_REC;
+        bool ok = c.get(pub, &pt);
+        if (!ok) {
+            ok = ge_decompress_fast(pub, &pt);
+            if (ok) c.put(pub, pt);
+        }
+        if (ok) {
+            std::memcpy(rec, &pt.X, sizeof(fe));
+            std::memcpy(rec + sizeof(fe), &pt.Y, sizeof(fe));
+            rec[2 * sizeof(fe)] = 1;
+        } else {
+            rec[2 * sizeof(fe)] = 0;
+        }
+    }
+}
+
+// cached ("niels") form of an affine point: (Y-X, Y+X, 2d*T).  The
+// mixed addition below consumes it with 7 field muls — one fewer
+// than the unified extended add (the 2d*T product is precomputed
+// once per point instead of once per bucket add), and negation is an
+// index swap plus one cheap limb negation.
+struct nge {
+    fe ymx, ypx, t2d;
+};
+
+inline nge ge_to_niels(const ge& p) {        // p affine (Z = 1)
+    return nge{fe_sub(p.Y, p.X), fe_add(p.Y, p.X),
+               fe_mul(p.T, fe_d2())};
+}
+
+// unified mixed addition p + q with q in cached affine form; sign<0
+// adds -q (swap the Y±X products, negate 2dT).  Complete for a = -1.
+inline ge ge_madd(const ge& p, const nge& q, int sign) {
+    fe a, b, c;
+    if (sign > 0) {
+        a = fe_mul(fe_sub(p.Y, p.X), q.ymx);
+        b = fe_mul(fe_add(p.Y, p.X), q.ypx);
+        c = fe_mul(p.T, q.t2d);
+    } else {
+        a = fe_mul(fe_sub(p.Y, p.X), q.ypx);
+        b = fe_mul(fe_add(p.Y, p.X), q.ymx);
+        c = fe_neg(fe_mul(p.T, q.t2d));
+    }
+    fe dd = fe_add(p.Z, p.Z);
+    fe e = fe_sub(b, a);
+    fe f = fe_sub(dd, c);
+    fe g = fe_add(dd, c);
+    fe h = fe_add(b, a);
+    return ge{fe_mul(e, f), fe_mul(g, h), fe_mul(f, g), fe_mul(e, h)};
+}
+
+inline ge ge_neg_affine(const ge& p) {
+    return ge{fe_neg(p.X), p.Y, p.Z, fe_neg(p.T)};
+}
+
+// signed-digit window width for an npts-point tile MSM (measured on
+// the 1-vCPU rig: c=11 wins for full tiles >= ~4k points, c=10 for
+// balanced ~3.3k-signature tiles and the partial tail; the ladder
+// tracks the legacy msm() shape at small n where signed/unsigned
+// behave alike)
+inline int tile_window_c(size_t npts) {
+    return npts < 8 ? 4 : npts < 64 ? 6 : npts < 512 ? 8
+         : npts < 2048 ? 9 : npts < 8192 ? 10 : npts < 24576 ? 11
+         : 12;
+}
+
+// Pippenger MSM with signed c-bit digits over AFFINE points
+// (Z = 1 — decompressed inputs).  Digits lie in
+// (-2^(c-1), 2^(c-1)]: half the buckets of the unsigned form, so the
+// per-window bucket sweep — the fixed cost a tiled pass pays once
+// per tile — is halved; bucket accumulation runs on the cached
+// (niels) form at 7 muls per add.  Scalars must be < 2^253
+// (everything mod L is), which keeps the top window's carry in
+// range.
+inline ge msm_signed(const ge* pts, const uint8_t (*scalars)[32],
+                     size_t n, int c) {
+    int windows = (256 + c - 1) / c;
+    size_t nbuckets = size_t(1) << (c - 1);
+    std::vector<ge> bucket(nbuckets);
+    std::vector<uint8_t> used(nbuckets);
+    std::vector<int16_t> dig(n * size_t(windows));
+    std::vector<nge> npts(n);
+    for (size_t i = 0; i < n; i++) {
+        npts[i] = ge_to_niels(pts[i]);
+        int carry = 0;
+        for (int w = 0; w < windows; w++) {
+            int v = int(sc_digit(scalars[i], c, w)) + carry;
+            if (v > (1 << (c - 1))) {
+                v -= (1 << c);
+                carry = 1;
+            } else {
+                carry = 0;
+            }
+            dig[i * size_t(windows) + w] = int16_t(v);
+        }
+    }
+    ge acc = ge_identity();
+    for (int w = windows - 1; w >= 0; w--) {
+        if (w != windows - 1)
+            for (int k = 0; k < c; k++) acc = ge_double(acc);
+        std::memset(used.data(), 0, nbuckets);
+        for (size_t i = 0; i < n; i++) {
+            int d = dig[i * size_t(windows) + w];
+            if (!d) continue;
+            size_t b = size_t(d > 0 ? d : -d) - 1;
+            if (used[b]) {
+                bucket[b] = ge_madd(bucket[b], npts[i], d);
+            } else {
+                bucket[b] = d > 0 ? pts[i] : ge_neg_affine(pts[i]);
+                used[b] = 1;
+            }
+        }
+        ge running = ge_identity();
+        ge sum = ge_identity();
+        bool run_any = false, sum_any = false;
+        for (size_t b = nbuckets; b >= 1; b--) {
+            if (used[b - 1]) {
+                running = run_any ? ge_add(running, bucket[b - 1])
+                                  : bucket[b - 1];
+                run_any = true;
+            }
+            if (run_any) {
+                sum = sum_any ? ge_add(sum, running) : running;
+                sum_any = true;
+            }
+        }
+        if (sum_any) acc = ge_add(acc, sum);
+    }
+    return acc;
+}
+
+struct TileView {            // one signature in the packed-blob layout
+    const uint8_t* pub;      // 32
+    const uint8_t* msg;
+    size_t msglen;
+    const uint8_t* sig;      // 64
+};
+
+// k_i = SHA-512(R || A || msg) mod L for every item, through the
+// 8-way multi-buffer hasher where the CPU has it (vote sign-bytes in
+// a tile are uniform-length, so grouping stays trivial); scalar
+// SHA-512 otherwise.
+inline void tile_k_scalars(const std::vector<TileView>& items,
+                           uint8_t (*ks)[32]) {
+    size_t n = items.size();
+    size_t i = 0;
+#if COMETBFT_SHA512MB_X86
+    if (sha512mb::available()) {
+        std::vector<uint8_t> scratch;
+        uint8_t digests[8][64];
+        while (i + 8 <= n) {
+            size_t nb = sha512mb::block_count(64 + items[i].msglen);
+            bool uniform = nb <= 128;
+            for (size_t l = 1; uniform && l < 8; l++)
+                uniform = sha512mb::block_count(
+                    64 + items[i + l].msglen) == nb;
+            if (!uniform) break;    // ragged tail: scalar below
+            size_t slot = nb * 128;
+            scratch.assign(slot * 8, 0);
+            const uint8_t* base[8];
+            for (size_t l = 0; l < 8; l++) {
+                uint8_t* buf = scratch.data() + l * slot;
+                const TileView& it = items[i + l];
+                std::memcpy(buf, it.sig, 32);
+                std::memcpy(buf + 32, it.pub, 32);
+                std::memcpy(buf + 64, it.msg, it.msglen);
+                sha512mb::write_padding(buf, 64 + it.msglen, nb);
+                base[l] = buf;
+            }
+            sha512mb::hash8(base, nb, digests);
+            for (size_t l = 0; l < 8; l++)
+                sha512::reduce_mod_l(digests[l], ks[i + l]);
+            i += 8;
+        }
+    }
+#endif
+    uint8_t digest[64];
+    for (; i < n; i++) {
+        const TileView& it = items[i];
+        sha512::Ctx c;
+        sha512::init(&c);
+        sha512::update(&c, it.sig, 32);
+        sha512::update(&c, it.pub, 32);
+        sha512::update(&c, it.msg, it.msglen);
+        sha512::final(&c, digest);
+        sha512::reduce_mod_l(digest, ks[i]);
+    }
+}
+
+// One pipeline tile: same RLC batch equation and ZIP-215 semantics as
+// batch_verify_inner, through the tile-kernel geometry (cached
+// fe_sqr decompression, signed-digit MSM, cached-form bucket adds).
+// 1 = the tile's batch equation holds; 0 = reject or malformed input
+// (the caller bisects WITHIN the tile).  Single-threaded by design:
+// tile-level concurrency belongs to the pipeline's worker threads,
+// not nested fan-out.
+inline int batch_verify_tile_inner(const std::vector<TileView>& items,
+                                   const uint8_t* z,
+                                   const uint8_t* staged) {
+    size_t n = items.size();
+    if (n == 0) return 1;
+    size_t total = 2 * n + 1;
+    std::vector<ge> pts(total);
+    std::vector<uint8_t> scal(total * 32);
+    std::vector<std::array<uint8_t, 32>> ks(n);
+    tile_k_scalars(items,
+                   reinterpret_cast<uint8_t(*)[32]>(ks[0].data()));
+    uint8_t s_sum[32] = {0};
+    uint8_t zk[32], si[32], zs[32];
+    for (size_t i = 0; i < n; i++) {
+        const TileView& it = items[i];
+        ge A, R;
+        bool a_ok;
+        if (staged != nullptr) {
+            // staging resolved this A point already (valid byte 0 =
+            // undecompressable pubkey)
+            const uint8_t* rec = staged + i * STAGED_REC;
+            a_ok = rec[2 * sizeof(fe)] != 0;
+            if (a_ok) {
+                std::memcpy(&A.X, rec, sizeof(fe));
+                std::memcpy(&A.Y, rec + sizeof(fe), sizeof(fe));
+                A.Z = fe_one();
+                A.T = fe_mul(A.X, A.Y);
+            }
+        } else {
+            a_ok = decompress_pub_cached_fast(it.pub, &A);
+        }
+        if (!sc_is_canonical(it.sig + 32) || !a_ok ||
+            !ge_decompress_fast(it.sig, &R))
+            return 0;
+        uint8_t zi[32] = {0};
+        std::memcpy(zi, z + 16 * i, 16);
+        zi[0] |= 1;
+        std::memcpy(si, it.sig + 32, 32);
+        sc_mul(zi, si, zs);
+        sc_add(s_sum, zs, s_sum);
+        sc_mul(zi, ks[i].data(), zk);
+        pts[2 * i] = R;
+        std::memcpy(&scal[(2 * i) * 32], zi, 32);
+        pts[2 * i + 1] = A;
+        std::memcpy(&scal[(2 * i + 1) * 32], zk, 32);
+    }
+    ge Bp;
+    ge_decompress_fast(B_BYTES, &Bp);
+    uint8_t neg_s[32];
+    sc_neg(s_sum, neg_s);
+    pts[2 * n] = Bp;
+    std::memcpy(&scal[(2 * n) * 32], neg_s, 32);
+    const uint8_t(*sc)[32] =
+        reinterpret_cast<const uint8_t(*)[32]>(scal.data());
+    ge r = msm_signed(pts.data(), sc, total, tile_window_c(total));
+    return ge_is_identity_cofactored(r) ? 1 : 0;
+}
+
+inline int batch_verify_tile(const std::vector<TileView>& items,
+                             const uint8_t* z,
+                             const uint8_t* staged = nullptr) {
+    try {
+        return batch_verify_tile_inner(items, z, staged);
     } catch (...) {
         return 0;       // reject -> caller's per-signature fallback
     }

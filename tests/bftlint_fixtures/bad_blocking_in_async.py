@@ -10,3 +10,15 @@ class Conn:
     async def snapshot(self, path):
         with open(path, "w") as f:
             f.write("state")
+
+
+class Tally:
+    async def on_vote_burst(self, entries, dev_future):
+        # ISSUE 14: synchronous batch verification on the loop —
+        # every reactor stalls for the whole kernel run
+        bv = object()
+        ok, mask = bv.verify()
+        preverify_signatures(entries)
+        self.signature_verifier.verify()
+        dev_future.block_until_ready()
+        return ok, mask

@@ -17,3 +17,20 @@ class Conn:
         # bftlint: disable=blocking-in-async
         with open(path, "a") as f:
             f.write("entry")
+
+
+class Tally:
+    def tally_sync(self, bv):
+        # sync context: the caller already owns a worker thread
+        return bv.verify()
+
+    async def on_vote_burst(self, entries, bv, proof, root, leaf):
+        # the off-loop seam: awaitable verdict future, loop keeps
+        # draining gossip until the barrier
+        import asyncio
+        await asyncio.wrap_future(preverify_signatures_async(entries))
+        ok, mask = await bv.verify_async()
+        # a merkle proof check is NOT a batch verifier: `verify` on
+        # non-verifier receivers must not trip the rule
+        proof.verify(root, leaf)
+        return ok, mask

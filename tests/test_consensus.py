@@ -212,7 +212,9 @@ class TestBurstPreverification:
             sig_bytes = v.sign_bytes(cs.sm_state.chain_id)
             v.signature = pv.priv_key.sign(sig_bytes)
             burst.append(("peer", VoteMessage(vote=v), f"n{i}"))
-        cs._preverify_burst(burst)
+        # the burst barrier is awaited (verification runs on the
+        # staging worker; the loop keeps draining while it does)
+        run(cs._preverify_burst(burst))
         assert len(vote_mod._VERIFIED) == len(pvs), \
             "burst pre-verification produced no memo entries"
         for _, msg, _ in burst:

@@ -291,6 +291,9 @@ _ALLOWED_LABELS = {
     "pad_bucket", "phase", "kernel", "warm", "name", "le",
     "breaker",      # code-defined breaker names (crypto_tpu_kernel)
     "state",        # breaker state enum (closed/half-open/open/latched)
+    "worker",       # verification workers: hard-coded names at the
+                    # few SupervisedWorker construction sites
+                    # (verify_stage / verify_kernel)
 }
 
 

@@ -14,6 +14,17 @@ code: ``time.sleep``, synchronous socket construction/connection,
 durability points (the consensus WAL's write-through fsync is a
 correctness requirement, not an accident) get inline suppressions or
 baseline entries with the reason.
+
+ISSUE 14 extension — synchronous signature verification is the same
+bug with a bigger constant: a 10k-signature ``BatchVerifier.verify()``
+freezes the loop for ~190 ms (QA_r08 profiled verify stalls stacking
+behind p2p recv), and ``block_until_ready()`` pins the loop on a
+device future.  Inside consensus/reactor async scopes the rule flags
+``<*verifier*>.verify()`` / ``bv.verify()``, bare or attribute
+``preverify_signatures(...)``, and any ``block_until_ready()`` —
+the off-loop seam (``verify_async()`` /
+``preverify_signatures_async()`` + the verification staging worker,
+crypto/pipeline.py) is the replacement.
 """
 from __future__ import annotations
 
@@ -34,6 +45,34 @@ _BLOCKING_CALLS = {
 }
 _BLOCKING_TAILS = {"read_text", "read_bytes", "write_text",
                    "write_bytes"}
+
+# synchronous verification inside an async scope: the receiver names
+# that identify a batch verifier (narrow on purpose — `proof.verify()`
+# shapes outside the crypto seam must not trip)
+_VERIFIER_RECEIVERS = ("bv", "verifier", "batch_verifier")
+_VERIFY_BLOCK_TAILS = {"block_until_ready", "preverify_signatures"}
+
+
+def _receiver_name(node: ast.Call) -> str:
+    """Final identifier of the call receiver: ``self._bv.verify()``
+    -> ``_bv``; bare ``verify()`` -> ''."""
+    if not isinstance(node.func, ast.Attribute):
+        return ""
+    recv = node.func.value
+    if isinstance(recv, ast.Attribute):
+        return recv.attr
+    if isinstance(recv, ast.Name):
+        return recv.id
+    return ""
+
+
+def _is_sync_verify(node: ast.Call, name: str, tail: str) -> bool:
+    if tail in _VERIFY_BLOCK_TAILS:
+        return True
+    if tail != "verify" or not isinstance(node.func, ast.Attribute):
+        return False
+    recv = _receiver_name(node).lower()
+    return recv in _VERIFIER_RECEIVERS or recv.endswith("verifier")
 
 
 class BlockingInAsyncChecker(Checker):
@@ -68,6 +107,16 @@ class BlockingInAsyncChecker(Checker):
                     f"use the asyncio equivalent (asyncio.sleep, "
                     f"loop.run_in_executor, to_thread) or justify "
                     f"the synchronous durability point")
+            elif _is_sync_verify(node, name, tail):
+                yield ctx.finding(
+                    self.rule, node,
+                    f"{name}() runs signature verification (or a "
+                    f"device-future wait) synchronously inside an "
+                    f"async def — a 10k-sig batch freezes every "
+                    f"reactor for ~200 ms; submit it through the "
+                    f"off-loop seam instead (verify_async() / "
+                    f"preverify_signatures_async(), "
+                    f"crypto/pipeline.py)")
 
 
 __all__ = ["BlockingInAsyncChecker"]

@@ -41,6 +41,11 @@ ALLOWED_NAMES = {
     "ch_id",         # p2p channel id string: claimed channels only
                      # (touch_channel materializes series at reactor
                      # registration; ids are a closed per-node set)
+    "worker_name",   # SupervisedWorker names: hard-coded at the few
+                     # construction sites (crypto/pipeline.py)
+    "pad_bucket",    # kernel pad-bucket label: str of the closed
+                     # bucket ladder / the one configured pipeline
+                     # tile size per process
 }
 
 

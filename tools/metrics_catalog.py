@@ -55,6 +55,7 @@ def collect_catalog() -> list[dict]:
         cls(reg)
     # force the lazy process-global families into existence
     from cometbft_tpu.crypto import bls12381
+    from cometbft_tpu.crypto import pipeline as crypto_pipeline
     from cometbft_tpu.types import validation as types_validation
     crypto_batch.verify_seconds_histogram()
     crypto_batch.tpu_breaker()
@@ -63,6 +64,15 @@ def collect_catalog() -> list[dict]:
     signature_cache._metrics()
     bls12381._agg_pk_metrics()
     types_validation.commit_verify_histogram()
+    # verification pipeline: overlap ratio + tile rejects, and the
+    # staging/kernel workers' queue-wait/depth families (register a
+    # worker on a throwaway registry-backed pair via the lazy
+    # singletons' metric declarations)
+    crypto_pipeline.overlap_histogram()
+    crypto_pipeline._tile_reject_counter()
+    from cometbft_tpu.libs.workers import SupervisedWorker
+    _w = SupervisedWorker("catalog_probe")
+    _w.stop()
 
     seen = set()
     out = []
