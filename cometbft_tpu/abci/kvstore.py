@@ -2,18 +2,29 @@
 
 Reference: abci/example/kvstore/kvstore.go (677 LoC) — key=value txs,
 validator-update txs ("val=<type>!<b64 pubkey>!<power>"), priority lanes,
-app hash = varint(size), /val query path.  Used by the e2e baseline
-config #1 and as the universal test app.
+/val query path.  Used by the e2e baseline config #1 and as the
+universal test app.
+
+Storage is the committed state tree (cometbft_tpu/statetree/): every
+kv pair and validator record is a tree leaf, FinalizeBlock returns
+the tree's working root as app_hash and Commit persists it as the
+height's version — so ``header.app_hash -> tree root -> key/value``
+verifies against any consensus-verified header, queries serve
+versioned (historical) reads, and /multistore proofs cover absent
+keys too.  (The reference app hashes only its size; that legacy
+scheme survives as the migration override for pre-tree chains.)
 """
 from __future__ import annotations
 
 import base64
 import json
-from typing import Optional
+from typing import Callable, Iterable, Optional
 
 from .. import version as _version
 from ..db import DB, MemDB
+from ..db.db import PrefixDB
 from ..libs.log import new_logger
+from ..statetree import StateTree
 from . import types as abci
 
 VALIDATOR_PREFIX = "val="
@@ -26,16 +37,18 @@ CODE_TYPE_INVALID_TX_FORMAT = 2
 CODE_TYPE_UNAUTHORIZED = 3
 CODE_TYPE_EXECUTED = 5
 
-_KV_PREFIX = b"kvPairKey:"
-_STATE_KEY = b"appstate"
+_KV_PREFIX = b"kvPairKey:"        # legacy (pre-tree) row prefix
+_STATE_KEY = b"appstate"          # legacy (pre-tree) height/size row
+_TREE_PREFIX = b"statetree/"
 
 # lane priorities (reference: kvstore.go NewInMemoryApplication lanes)
 DEFAULT_LANES = {"val": 9, "foo": 7, DEFAULT_LANE: 3, "bar": 1}
 
 
 def _zigzag_varint(n: int) -> bytes:
-    """Go binary.PutVarint into an 8-byte buffer (reference:
-    State.Hash — kvstore.go:669)."""
+    """Go binary.PutVarint into an 8-byte buffer — the LEGACY app
+    hash (reference: State.Hash — kvstore.go:669), kept for the
+    pre-tree migration path only."""
     zz = (n << 1) ^ (n >> 63) if n < 0 else n << 1
     out = bytearray(8)
     i = 0
@@ -136,6 +149,14 @@ def assign_lane(tx: bytes) -> str:
     return DEFAULT_LANE
 
 
+def _val_tree_key(pub_key_bytes: bytes) -> bytes:
+    """Validator record key inside the state tree.  kv tx keys can
+    never contain '=' (parse_tx requires exactly one separator), so
+    the 'val=' prefix cannot collide with a user kv key."""
+    return (VALIDATOR_PREFIX +
+            base64.b64encode(pub_key_bytes).decode()).encode()
+
+
 class KVStoreApplication(abci.Application):
     def __init__(self, db: Optional[DB] = None,
                  lane_priorities: Optional[dict[str, int]] = DEFAULT_LANES,
@@ -146,7 +167,6 @@ class KVStoreApplication(abci.Application):
         self._snapshots: dict[int, bytes] = {}
         self.retain_blocks = 0
         self.logger = new_logger("kvstore")
-        self._staged_txs: list[bytes] = []
         self._val_updates: list[abci.ValidatorUpdate] = []
         self._val_addr_to_pubkey: dict[bytes, tuple[str, bytes]] = {}
         self._gen_block_events = False
@@ -158,25 +178,83 @@ class KVStoreApplication(abci.Application):
         self.abci_delays: dict[str, float] = {}
         self._height = 0
         self._size = 0
-        # (height, sorted kv pairs, key->index, hashed leaves) for
-        # /multistore; rebuilt lazily, dropped on commit/restore
-        self._multistore_memo = None
+        # versions the tree must retain beyond the pruning horizon —
+        # the node wires this to lightserve's ResponseCache so a
+        # height the cache still serves keeps its proofs available
+        self.version_pin: Optional[Callable[[], Iterable[int]]] = None
+        self.tree = StateTree(PrefixDB(self.db, _TREE_PREFIX))
         self._load_state()
 
     # ------------------------------------------------------------------
     def _load_state(self) -> None:
+        if self.tree.latest_version is not None:
+            # the tree is the source of truth: height/size ride the
+            # version record's extra blob, written in the same atomic
+            # batch as the state — no crash window between them
+            self._height = self.tree.latest_version
+            self._size = int(
+                self.tree.version_extra().get("size", 0))
+            self._rebuild_val_map()
+            return
         raw = self.db.get(_STATE_KEY)
         if raw:
             st = json.loads(raw)
             self._height = st.get("height", 0)
             self._size = st.get("size", 0)
+        self._migrate_legacy()
 
-    def _save_state(self) -> None:
-        self.db.set(_STATE_KEY, json.dumps(
-            {"height": self._height, "size": self._size}).encode())
+    def _migrate_legacy(self) -> None:
+        """Import a pre-tree store (raw kvPairKey:/val= rows, app
+        hash = varint(size)) into the tree at the current height.
+        The legacy hash is recorded as that one version's reported
+        app_hash so ABCI handshake replay still matches the stored
+        state; every height after the migration reports the tree
+        root (valid — app_hash changes every height anyway, and all
+        upgraded replicas switch at the same height).  Migration
+        note: upgrade with the app at the block-store tip; blocks
+        finalized before the upgrade carry legacy app_hashes the
+        tree no longer reproduces, so a behind-the-store replay
+        across the upgrade boundary will refuse those headers."""
+        val_prefix = VALIDATOR_PREFIX.encode()
+        pairs = []
+        legacy_rows = []
+        for k, v in self.db.iterator():
+            if k.startswith(_KV_PREFIX):
+                pairs.append((k[len(_KV_PREFIX):], v))
+                legacy_rows.append(k)
+            elif k.startswith(val_prefix):
+                pairs.append((k, v))
+                legacy_rows.append(k)
+        if not pairs and self._height == 0:
+            return
+        self.tree.import_snapshot(
+            self._height, pairs,
+            app_hash_override=_zigzag_varint(self._size),
+            extra={"size": self._size})
+        for k in legacy_rows:
+            self.db.delete(k)
+        self._rebuild_val_map()
+        self.logger.info("Migrated legacy kvstore rows into the "
+                         "state tree", height=self._height,
+                         pairs=len(pairs))
+
+    def _rebuild_val_map(self) -> None:
+        from ..crypto import encoding as crypto_encoding
+        self._val_addr_to_pubkey.clear()
+        val_prefix = VALIDATOR_PREFIX.encode()
+        for key, raw_val in self.tree.pairs():
+            if not key.startswith(val_prefix):
+                continue
+            pub = base64.b64decode(key[len(val_prefix):])
+            key_type, _ = _parse_val_value(raw_val)
+            pk = crypto_encoding.pub_key_from_type_and_bytes(
+                key_type, pub)
+            self._val_addr_to_pubkey[pk.address()] = (key_type, pub)
 
     def _app_hash(self) -> bytes:
-        return _zigzag_varint(self._size)
+        """The committed app hash: the state tree root (or, for the
+        one migrated legacy version, its recorded override)."""
+        return self.tree.reported_hash()
 
     def set_gen_block_events(self) -> None:
         self._gen_block_events = True
@@ -198,9 +276,16 @@ class KVStoreApplication(abci.Application):
 
     async def init_chain(self, req: abci.InitChainRequest
                          ) -> abci.InitChainResponse:
+        self.tree.reset_working()
         for v in req.validators:
-            self._update_validator(v)
-        return abci.InitChainResponse(app_hash=self._app_hash())
+            self._stage_validator(v)
+            self._track_validator(v)
+        # genesis state = tree version 0; its root is the app_hash
+        # block 1's header carries.  Re-running InitChain over an
+        # already-committed version 0 (crash before height 1, then
+        # handshake replay) is an idempotent no-op in the tree.
+        app_hash = self.tree.commit(0, extra={"size": self._size})
+        return abci.InitChainResponse(app_hash=app_hash)
 
     async def _delay(self, call: str) -> None:
         d = self.abci_delays.get(call, 0.0)
@@ -265,7 +350,9 @@ class KVStoreApplication(abci.Application):
                              ) -> abci.FinalizeBlockResponse:
         await self._delay("finalize_block")
         self._val_updates = []
-        self._staged_txs = []
+        # a previous FinalizeBlock whose Commit never arrived (crash
+        # replay) must not leak staged writes into this block
+        self.tree.reset_working()
 
         # punish equivocators by one power unit per offence
         # (reference: kvstore.go:318), ONE update per address — a
@@ -298,7 +385,9 @@ class KVStoreApplication(abci.Application):
                     power=power, pub_key_type=key_type,
                     pub_key_bytes=pub))
             else:
-                self._staged_txs.append(tx)
+                parts = tx.split(b"=")
+                if len(parts) == 2:
+                    self.tree.set(parts[0], parts[1])
             parts = tx.split(b"=")
             if len(parts) == 2:
                 key, value = parts[0].decode(), parts[1].decode()
@@ -328,10 +417,14 @@ class KVStoreApplication(abci.Application):
         by_key: dict[bytes, abci.ValidatorUpdate] = {}
         for u in self._val_updates:
             by_key[u.pub_key_bytes] = u
+        for u in by_key.values():
+            self._stage_validator(u)
+        # the app hash IS this height's tree root; Commit persists
+        # the same staged view (the tree caches the computation)
         resp = abci.FinalizeBlockResponse(
             tx_results=tx_results,
             validator_updates=list(by_key.values()),
-            app_hash=self._app_hash(),
+            app_hash=self.tree.working_root(req.height),
             next_block_delay_ns=self.next_block_delay_ns,
         )
         if self._gen_block_events:
@@ -341,19 +434,12 @@ class KVStoreApplication(abci.Application):
         return resp
 
     async def commit(self, req: abci.CommitRequest) -> abci.CommitResponse:
-        for v in self._val_updates:
-            self._update_validator(v)
-        for tx in self._staged_txs:
-            parts = tx.split(b"=")
-            if len(parts) != 2:
-                raise RuntimeError(f"unexpected tx format: {tx!r}")
-            self.db.set(_KV_PREFIX + parts[0], parts[1])
-        # the kv writes land HERE, not in finalize_block (which
-        # already bumped _height) — drop the multistore memo so a
-        # prove=true batch never re-serves the pre-commit snapshot
-        # under the new height for the rest of the block
-        self._multistore_memo = None
-        self._save_state()
+        # one atomic batch: kv writes, validator records, version
+        # metadata (height implicit, size in extra) — a crash either
+        # side of this line replays to the exact same root
+        self.tree.commit(self._height, extra={"size": self._size})
+        for u in self._dedup_val_updates():
+            self._track_validator(u)
         if self.snapshot_interval > 0 and self._height > 0 and \
                 self._height % self.snapshot_interval == 0:
             self._snapshots[self._height] = self._serialize_state()
@@ -365,40 +451,41 @@ class KVStoreApplication(abci.Application):
         resp = abci.CommitResponse()
         if self.retain_blocks > 0 and self._height >= self.retain_blocks:
             resp.retain_height = self._height - self.retain_blocks + 1
+            # prune tree versions below the retention horizon, except
+            # any the lightserve cache still serves (a cached height
+            # must stay provable — the acceptance invariant)
+            pinned = self.version_pin() if self.version_pin else ()
+            self.tree.prune(resp.retain_height - 1, pinned=pinned)
         return resp
+
+    def _dedup_val_updates(self) -> list[abci.ValidatorUpdate]:
+        by_key: dict[bytes, abci.ValidatorUpdate] = {}
+        for u in self._val_updates:
+            by_key[u.pub_key_bytes] = u
+        return list(by_key.values())
 
     # ------------------------------------------------------------------
     # snapshots (reference: the e2e app's snapshot support; single-chunk
     # full-state snapshots keyed by height)
 
     def _serialize_state(self) -> bytes:
-        import json as _json
-        items = [[k.hex(), v.hex()] for k, v in self.db.iterator()]
-        return _json.dumps({"height": self._height,
-                            "size": self._size,
-                            "items": items}).encode()
+        pairs = [[k.hex(), v.hex()] for k, v in self.tree.pairs()]
+        return json.dumps({"height": self._height,
+                           "size": self._size,
+                           "pairs": pairs}).encode()
 
     def _restore_state(self, raw: bytes) -> None:
-        import json as _json
-        self._multistore_memo = None
-        d = _json.loads(raw)
-        for k, _ in list(self.db.iterator()):
-            self.db.delete(k)
-        for k, v in d["items"]:
-            self.db.set(bytes.fromhex(k), bytes.fromhex(v))
-        self._load_state()
-        # rebuild the validator pubkey map from restored entries
-        self._val_addr_to_pubkey.clear()
-        for key, raw_val in self.db.iterator():
-            if key.startswith(VALIDATOR_PREFIX.encode()):
-                pub_b64 = key[len(VALIDATOR_PREFIX):]
-                pub = base64.b64decode(pub_b64)
-                key_type, _ = _parse_val_value(raw_val)
-                from ..crypto import encoding as crypto_encoding
-                pk = crypto_encoding.pub_key_from_type_and_bytes(
-                    key_type, pub)
-                self._val_addr_to_pubkey[pk.address()] = (key_type,
-                                                          pub)
+        d = json.loads(raw)
+        self._height = d["height"]
+        self._size = d["size"]
+        # import reproduces a byte-identical root: same pairs, same
+        # sorted order, same leaf binding as the snapshot producer
+        self.tree.import_snapshot(
+            self._height,
+            [(bytes.fromhex(k), bytes.fromhex(v))
+             for k, v in d["pairs"]],
+            extra={"size": self._size})
+        self._rebuild_val_map()
 
     async def list_snapshots(self, req: abci.ListSnapshotsRequest
                              ) -> abci.ListSnapshotsResponse:
@@ -437,23 +524,51 @@ class KVStoreApplication(abci.Application):
         return abci.ApplySnapshotChunkResponse(
             result=abci.APPLY_SNAPSHOT_CHUNK_RESULT_ACCEPT)
 
+    def _resolve_version(self, height: int) -> Optional[int]:
+        """Query height -> tree version (they coincide: version H is
+        the state after block H).  0 = latest.  Raises ValueError for
+        a height the tree cannot serve (pruned / not yet committed);
+        returns None when nothing was ever committed."""
+        latest = self.tree.latest_version
+        if latest is None:
+            if height > 0:
+                raise ValueError("no committed state")
+            return None
+        if height == 0:
+            return latest
+        if height > latest:
+            raise ValueError(f"height {height} not yet committed "
+                             f"(latest {latest})")
+        if height < self.tree.base_version:
+            raise ValueError(f"height {height} pruned (oldest "
+                             f"retained {self.tree.base_version})")
+        return height
+
     async def query(self, req: abci.QueryRequest) -> abci.QueryResponse:
         if req.path == "/multistore":
             return self._multistore_query(req)
+        try:
+            v = self._resolve_version(req.height)
+        except ValueError as e:
+            return abci.QueryResponse(code=CODE_TYPE_ENCODING_ERROR,
+                                      log=str(e), height=self._height)
         if req.path == "/val":
-            value = self.db.get(
-                (VALIDATOR_PREFIX + req.data.decode()).encode()) or b""
+            value = b""
+            if v is not None:
+                value = self.tree.get(
+                    (VALIDATOR_PREFIX + req.data.decode()).encode(),
+                    v) or b""
             if value:
                 # external contract stays the bare power (the key
                 # type tag is internal to the stored value)
                 value = str(_parse_val_value(value)[1]).encode()
             return abci.QueryResponse(key=req.data, value=value)
-        value = self.db.get(_KV_PREFIX + req.data)
+        value = self.tree.get(req.data, v) if v is not None else None
         return abci.QueryResponse(
             key=req.data,
             value=value or b"",
             log="exists" if value is not None else "does not exist",
-            height=self._height,
+            height=v if v is not None else self._height,
         )
 
     # ------------------------------------------------------------------
@@ -461,20 +576,13 @@ class KVStoreApplication(abci.Application):
                           ) -> abci.QueryResponse:
         """Batched provable lookup (lightserve.core.MULTISTORE_PATH):
         request data is JSON {"keys": [hex...]}; the response value is
-        JSON carrying every found (key, value) pair plus ONE compact
-        multiproof over the app's state tree — sorted kv pairs hashed
-        with the ValueOp leaf binding, so a client replays
-        merkle.value_op_leaf per pair and verifies the batch in one
-        Multiproof.verify.  The root is the state-tree commitment;
-        like the per-key kvstore query it is not bound into app_hash
-        (the reference app hashes only its size).
-
-        The sorted pair list + hashed leaves are memoized per
-        committed height — thousands of light clients batching
-        queries against one height must not each pay an O(n) store
-        scan and re-hash (only the requested-indices proof walk is
-        per-request)."""
-        from ..crypto import merkle
+        the statetree proof envelope — every found (key, value) pair,
+        a non-inclusion arm per absent key, and ONE compact multiproof
+        whose root IS the app_hash committed by the header at
+        version + 1.  Historical heights prove against that height's
+        committed root (the tree memoizes materialized versions, so
+        thousands of light clients batching against one height pay
+        one O(n) scan, not one each)."""
         try:
             keys = [bytes.fromhex(k)
                     for k in json.loads(req.data)["keys"]]
@@ -482,62 +590,48 @@ class KVStoreApplication(abci.Application):
             return abci.QueryResponse(
                 code=CODE_TYPE_ENCODING_ERROR,
                 log=f"bad multistore request: {e}")
-        memo = self._multistore_memo
-        if memo is None or memo[0] != self._height:
-            pairs = sorted(
-                (k[len(_KV_PREFIX):], v)
-                for k, v in self.db.iterator()
-                if k.startswith(_KV_PREFIX))
-            index_of = {k: i for i, (k, _) in enumerate(pairs)}
-            leaves = [merkle.value_op_leaf(k, v) for k, v in pairs]
-            memo = (self._height, pairs, index_of, leaves)
-            self._multistore_memo = memo
-        _, pairs, index_of, leaves = memo
-        indices = sorted(index_of[k] for k in set(keys)
-                         if k in index_of)
-        missing = sorted(k.hex() for k in set(keys)
-                         if k not in index_of)
-        root, mp = merkle.multiproof_from_byte_slices(leaves, indices)
+        try:
+            v = self._resolve_version(req.height)
+            envelope = self.tree.prove(keys, v)
+        except (ValueError, KeyError) as e:
+            return abci.QueryResponse(
+                code=CODE_TYPE_ENCODING_ERROR,
+                log=f"multistore: {e}", height=self._height)
         return abci.QueryResponse(
             key=req.data,
-            value=json.dumps({
-                "root": root.hex(),
-                "total": len(pairs),
-                "indices": indices,
-                "keys": [pairs[i][0].hex() for i in indices],
-                "values": [pairs[i][1].hex() for i in indices],
-                "missing": missing,
-                "multiproof": mp.to_dict(),
-            }).encode(),
-            height=self._height,
+            value=json.dumps(envelope).encode(),
+            height=int(envelope["version"]),
         )
 
     # ------------------------------------------------------------------
-    def _update_validator(self, v: abci.ValidatorUpdate) -> None:
-        from ..crypto import encoding as crypto_encoding
-        pub = crypto_encoding.pub_key_from_type_and_bytes(
-            v.pub_key_type, v.pub_key_bytes)
-        addr = pub.address()
-        key = (VALIDATOR_PREFIX +
-               base64.b64encode(v.pub_key_bytes).decode()).encode()
+    def _stage_validator(self, v: abci.ValidatorUpdate) -> None:
+        """Stage a validator record into the tree's working set —
+        validator state is part of the committed app state, so it is
+        provable (and prunable) like any kv pair."""
+        key = _val_tree_key(v.pub_key_bytes)
         if v.power == 0:
-            self.db.delete(key)
-            self._val_addr_to_pubkey.pop(addr, None)
+            self.tree.delete(key)
         else:
             # record the key TYPE with the power: snapshot restore
             # must rebuild a mixed-key validator map (the b64 pubkey
             # alone can't distinguish ed25519 from secp256k1)
-            self.db.set(key,
-                        f"{v.pub_key_type}!{v.power}".encode())
+            self.tree.set(key, f"{v.pub_key_type}!{v.power}".encode())
+
+    def _track_validator(self, v: abci.ValidatorUpdate) -> None:
+        from ..crypto import encoding as crypto_encoding
+        pub = crypto_encoding.pub_key_from_type_and_bytes(
+            v.pub_key_type, v.pub_key_bytes)
+        addr = pub.address()
+        if v.power == 0:
+            self._val_addr_to_pubkey.pop(addr, None)
+        else:
             self._val_addr_to_pubkey[addr] = (v.pub_key_type,
                                               v.pub_key_bytes)
 
     def get_validators(self) -> list[abci.ValidatorUpdate]:
         out = []
         for addr, (key_type, pub) in self._val_addr_to_pubkey.items():
-            key = (VALIDATOR_PREFIX +
-                   base64.b64encode(pub).decode()).encode()
-            raw = self.db.get(key)
+            raw = self.tree.get(_val_tree_key(pub))
             if raw:
                 out.append(abci.ValidatorUpdate(
                     power=_parse_val_value(raw)[1],

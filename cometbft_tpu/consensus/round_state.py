@@ -185,6 +185,66 @@ class RoundState:
         self.proposal_block = block
         self.proposal_block_parts = parts
 
+    def set_last_commit(self, vote_set) -> None:
+        """Install the previous height's precommits (updateToState /
+        WAL-replay reconstruction).  None is legal only before the
+        initial block; a VoteSet must actually hold a +2/3 majority —
+        the property every later consumer (proposals, last_commit
+        gossip) assumes."""
+        if vote_set is not None and \
+                hasattr(vote_set, "has_two_thirds_majority") and \
+                not vote_set.has_two_thirds_majority():
+            raise RoundState.TransitionError(
+                "set_last_commit: vote set lacks a +2/3 majority")
+        self.last_commit = vote_set
+
+    def apply_proposal(self, proposal, recv_time) -> None:
+        """Adopt the round's signed proposal (setProposal): at most
+        once per round, and only for the CURRENT (height, round) —
+        the re-check that a proposal validated before a suspension
+        cannot land on a round the machine has already left.  Starts
+        part collection when the part-set header isn't known yet."""
+        if self.proposal is not None:
+            raise RoundState.TransitionError(
+                f"apply_proposal: {self} already has a proposal")
+        if proposal.height != self.height or \
+                proposal.round != self.round:
+            raise RoundState.TransitionError(
+                f"apply_proposal({proposal.height}/{proposal.round}) "
+                f"does not match {self}")
+        self.proposal = proposal
+        self.proposal_receive_time = recv_time
+        if self.proposal_block_parts is None:
+            self.proposal_block_parts = PartSet(
+                proposal.block_id.part_set_header)
+
+    def complete_proposal_block(self, block) -> None:
+        """Install the block assembled from the completed part set."""
+        if self.proposal_block_parts is None or \
+                not self.proposal_block_parts.is_complete():
+            raise RoundState.TransitionError(
+                "complete_proposal_block without a complete part set")
+        self.proposal_block = block
+
+    def mark_timeout_precommit(self, round_: int) -> None:
+        """Record that the precommit-wait timeout was scheduled for
+        round_ (enterPrecommitWait), exactly once per round."""
+        if round_ < self.round or \
+                (round_ == self.round and
+                 self.triggered_timeout_precommit):
+            raise RoundState.TransitionError(
+                f"mark_timeout_precommit({round_}) already triggered "
+                f"or behind {self}")
+        self.triggered_timeout_precommit = True
+
+    def rebuild_votes(self, validators, votes) -> None:
+        """Pipeline reconcile: swap in the rebuilt validator set and
+        height vote set after a pipelined apply landed with changed
+        consensus params, keeping next-round vote tracking."""
+        self.validators = validators
+        self.votes = votes
+        self.votes.set_round(self.round + 1)
+
     def enter_commit(self, commit_round: int, commit_time) -> None:
         """Enter the commit step for commit_round."""
         if self.step >= STEP_COMMIT:

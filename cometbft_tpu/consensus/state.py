@@ -507,13 +507,13 @@ class ConsensusState:
 
         validators = state.validators
         if state.last_block_height == 0:
-            rs.last_commit = None
+            rs.set_last_commit(None)
         elif rs.commit_round > -1 and rs.votes is not None:
             precommits = rs.votes.precommits(rs.commit_round)
             if not precommits.has_two_thirds_majority():
                 raise ConsensusError(
                     "wanted to form a commit but precommits lack 2/3+")
-            rs.last_commit = precommits
+            rs.set_last_commit(precommits)
         elif rs.last_commit is None:
             raise ConsensusError(
                 f"last commit cannot be empty after initial block "
@@ -577,8 +577,8 @@ class ConsensusState:
                 raise ConsensusError(
                     f"failed to reconstruct last extended commit; commit "
                     f"for height {state.last_block_height} not found")
-            self.rs.last_commit = self._vote_set_from_extended_commit(
-                state, ec)
+            self.rs.set_last_commit(self._vote_set_from_extended_commit(
+                state, ec))
         else:
             sc = self.block_store.load_seen_commit(
                 state.last_block_height)
@@ -586,7 +586,7 @@ class ConsensusState:
                 raise ConsensusError(
                     f"failed to reconstruct last commit; seen commit for "
                     f"height {state.last_block_height} not found")
-            self.rs.last_commit = self._vote_set_from_commit(state, sc)
+            self.rs.set_last_commit(self._vote_set_from_commit(state, sc))
 
     def _vote_set_from_commit(self, state: SMState,
                               commit) -> VoteSet:
@@ -932,8 +932,7 @@ class ConsensusState:
                 (max_bytes - 1) // BLOCK_PART_SIZE_BYTES + 1:
             raise ConsensusError("proposal has too many parts")
 
-        rs.proposal = proposal
-        rs.proposal_receive_time = recv_time
+        rs.apply_proposal(proposal, recv_time)
         diff_s = recv_time.sub(proposal.timestamp) / 1e9
         timely = "true"
         if self._pbts_enabled(rs.height):
@@ -943,9 +942,6 @@ class ConsensusState:
                 recv_time, sp) else "false"
         self.metrics.proposal_timestamp_difference.with_labels(
             timely).observe(diff_s)
-        if rs.proposal_block_parts is None:
-            rs.proposal_block_parts = PartSet(
-                proposal.block_id.part_set_header)
         tracing.instant(tracing.CONSENSUS, "proposal_received",
                         height=proposal.height, round=proposal.round,
                         parts=proposal.block_id.part_set_header.total)
@@ -986,7 +982,8 @@ class ConsensusState:
                 f"{max_bytes})")
         if rs.proposal_block_parts.is_complete():
             raw = rs.proposal_block_parts.assemble()
-            rs.proposal_block = Block.from_proto(decode(pb.BLOCK, raw))
+            rs.complete_proposal_block(
+                Block.from_proto(decode(pb.BLOCK, raw)))
             tracing.instant(tracing.CONSENSUS, "proposal_complete",
                             height=msg.height,
                             bytes=rs.proposal_block_parts.byte_size)
@@ -1303,7 +1300,7 @@ class ConsensusState:
         if not rs.votes.precommits(round_).has_two_thirds_any():
             raise ConsensusError(
                 "entering precommit wait without any +2/3 precommits")
-        rs.triggered_timeout_precommit = True
+        rs.mark_timeout_precommit(round_)
         self._new_step()
         self._schedule_timeout(self._vote_wait_timeout_ns(round_),
                                height, round_, STEP_PRECOMMIT_WAIT)
@@ -1595,11 +1592,10 @@ class ConsensusState:
                 # its peers about the round's proposer
                 vals = vals.copy()
                 vals.increment_proposer_priority(rs.round)
-            rs.validators = vals
-            rs.votes = HeightVoteSet(new_state.chain_id, rs.height,
-                                     vals,
-                                     extensions_enabled=real_ext)
-            rs.votes.set_round(rs.round + 1)
+            rs.rebuild_votes(
+                vals,
+                HeightVoteSet(new_state.chain_id, rs.height, vals,
+                              extensions_enabled=real_ext))
 
     # ==================================================================
     # votes

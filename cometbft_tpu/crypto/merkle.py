@@ -289,6 +289,16 @@ def _root_from_leaf_hashes(hashes: Sequence[bytes]) -> bytes:
                       _root_from_leaf_hashes(hashes[k:]))
 
 
+def root_from_leaf_hashes(hashes: Sequence[bytes]) -> bytes:
+    """Merkle root over pre-hashed leaves (``leaf_hash(item)`` each).
+    The statetree caches kv leaf hashes across commits and recomputes
+    only the changed ones, so the root builder must accept hashes
+    directly rather than re-hash every item per block."""
+    if not hashes:
+        return empty_hash()
+    return _root_from_leaf_hashes(hashes)
+
+
 def multiproof_from_byte_slices(
         items: Sequence[bytes],
         indices: Sequence[int]) -> tuple[bytes, Multiproof]:

@@ -1,6 +1,7 @@
 """Light client: header verification with sequential or skipping
 (bisection) modes, provider abstraction, trusted store, attack detection.
 """
+from .state_proof import verify_state_proof
 from .verifier import (
     DEFAULT_TRUST_LEVEL, LightClientError, header_expired,
     validate_trust_level, verify, verify_adjacent, verify_backwards,
@@ -10,5 +11,5 @@ from .verifier import (
 __all__ = [
     "DEFAULT_TRUST_LEVEL", "LightClientError", "header_expired",
     "validate_trust_level", "verify", "verify_adjacent",
-    "verify_backwards", "verify_non_adjacent",
+    "verify_backwards", "verify_non_adjacent", "verify_state_proof",
 ]

@@ -120,6 +120,14 @@ class ResponseCache:
             self.metrics.bytes.set(self._bytes)
         return True
 
+    def heights(self, method: Optional[str] = None) -> set[int]:
+        """Distinct heights with resident entries (optionally for one
+        method).  The statetree's pruning pins these: a height the
+        cache can still serve must keep its committed version so a
+        follow-up prove=true query stays answerable."""
+        return {h for m, h, _ in self._entries
+                if method is None or m == method}
+
     # ------------------------------------------------------------------
     def __len__(self) -> int:
         return len(self._entries)

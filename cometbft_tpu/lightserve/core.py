@@ -143,7 +143,11 @@ def _parse_keys(data) -> list[bytes]:
 async def abci_query_batch(env, path, data, height, prove) -> dict:
     """N abci_query calls in one response.  With prove=true the app is
     asked once, via MULTISTORE_PATH, for all keys plus a single
-    compact multiproof over its state tree; apps without a provable
+    compact multiproof over its state tree — existence for present
+    keys, non-inclusion arms for absent ones — whose root is the
+    app_hash committed by the header at proof.header_height (the
+    statetree is the kvstore's storage engine, so the chain
+    header.app_hash -> root -> key closes).  Apps without a provable
     store answer per key with proof=null."""
     from ..abci import types as abci
     from ..rpc.core import _parse_bool
@@ -186,23 +190,52 @@ def _batch_from_multistore(keys: list[bytes], res) -> dict:
             "value": base64.b64encode(v or b"").decode(),
             "height": str(res.height), "codespace": "",
         })
-    return {
-        "responses": responses,
-        "proof": {
-            "root": st["root"].upper(),
-            "total": str(st["total"]),
-            "indices": list(st["indices"]),
-            "missing": list(st.get("missing", [])),
-            "multiproof": st["multiproof"],
-        },
+    proof = {
+        "root": st["root"].upper(),
+        "total": str(st["total"]),
+        "indices": list(st["indices"]),
+        "missing": list(st.get("missing", [])),
+        "multiproof": st["multiproof"],
     }
+    # statetree envelope extras: the version/header binding and the
+    # self-contained leaves + non-inclusion arms clients verify with
+    # verify_kv_multiproof / light.state_proof.verify_state_proof
+    for field in ("version", "header_height", "keys", "values",
+                  "absent"):
+        if field in st:
+            proof[field] = st[field]
+    return {"responses": responses, "proof": proof}
 
 
-def verify_kv_multiproof(proof: dict, keys_values: list) -> None:
+def verify_kv_multiproof(proof: dict, keys_values: list,
+                         absent_keys: list = (),
+                         verified_header=None) -> None:
     """Client-side check of an abci_query_batch proof envelope:
-    reconstructs the ValueOp-parity kv leaves for the (key, value)
-    pairs (in proof index order) and verifies the single multiproof
-    against the advertised root.  Raises ValueError on mismatch."""
+    every (key, value) in ``keys_values`` exists and every key in
+    ``absent_keys`` does not, at the proven version.  Pass the
+    ``verified_header`` whose app_hash commits the root (its height
+    must equal the proof's header_height) to chain the proof to
+    consensus; without it only the envelope's own root is checked
+    (membership, not commitment).  Raises ValueError on mismatch."""
+    if "keys" in proof:
+        from ..statetree import verify_proof_envelope
+        expected_root = None
+        if verified_header is not None:
+            if int(proof["header_height"]) != verified_header.height:
+                raise ValueError(
+                    f"proof targets header height "
+                    f"{proof['header_height']}, verified header is "
+                    f"{verified_header.height}")
+            expected_root = verified_header.app_hash
+        verify_proof_envelope(proof, present=keys_values,
+                              absent=absent_keys,
+                              expected_root=expected_root)
+        return
+    # pre-statetree envelope: caller supplies the leaves in proof
+    # index order; absent keys are unprovable in this format
+    if absent_keys:
+        raise ValueError(
+            "proof envelope has no non-inclusion arms")
     mp = merkle.Multiproof.from_dict(proof["multiproof"])
     leaves = [merkle.value_op_leaf(k, v) for k, v in keys_values]
     mp.verify(bytes.fromhex(proof["root"]), leaves)

@@ -188,6 +188,12 @@ class Node:
             self.lightserve_cache = ResponseCache(
                 config.rpc.cache_max_bytes,
                 metrics=LightserveMetrics(self.metrics_registry))
+            # statetree pruning must not drop a version the cache
+            # still serves responses for — a client that just read a
+            # cached height could no longer get it proven
+            if hasattr(self.app, "version_pin"):
+                cache = self.lightserve_cache
+                self.app.version_pin = cache.heights
 
         # --- mempool ----------------------------------------------------
         self.mempool: Optional[CListMempool] = None

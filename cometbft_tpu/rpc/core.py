@@ -401,10 +401,24 @@ async def _multiproof(env, height, indices):
 
 async def _abci_query_batch(env, path, data, height, prove):
     from ..lightserve import core as lightserve
-    # app state is not height-immutable from here (the app serves its
-    # latest state regardless of the height param) — never cached
-    return await lightserve.abci_query_batch(env, path, data, height,
-                                             prove)
+
+    def build():
+        return lightserve.abci_query_batch(env, path, data, height,
+                                           prove)
+    try:
+        h = int(height)
+    except (TypeError, ValueError):
+        h = 0
+    if h <= 0 or not _parse_bool(prove):
+        # height 0 = latest: mutable, never cached.  Unproven batches
+        # fan out per key against whatever state the app serves —
+        # also not immutable — while a proven batch at an explicit
+        # height is pinned to that height's committed statetree
+        # version, so it can be cached like any settled response.
+        return await build()
+    keys = tuple(k.hex() for k in lightserve._parse_keys(data))
+    return await _cached(env, "abci_query_batch", h,
+                         (str(path), keys), build)
 
 
 async def _block_by_hash(env, hash):

@@ -1,0 +1,12 @@
+"""Versioned, merkle-committed key-value state tree.
+
+The missing link between ``abci_query_batch`` proofs and consensus
+(ROADMAP item 3): the tree's per-version root IS the kvstore's
+app_hash, so ``header.app_hash -> tree root -> key/value`` verifies
+against any consensus-verified header — for present keys (existence)
+and absent keys (non-inclusion via sorted-neighbor adjacency).
+"""
+from .tree import StateTree
+from .proof import build_proof_envelope, verify_proof_envelope
+
+__all__ = ["StateTree", "build_proof_envelope", "verify_proof_envelope"]

@@ -64,7 +64,9 @@ class TestKVStore:
             assert q2.log == "does not exist"
             info = await app.info(abci.InfoRequest())
             assert info.last_block_height == 2
-            assert len(info.last_block_app_hash) == 8
+            # app hash is the committed state tree root
+            assert len(info.last_block_app_hash) == 32
+            assert info.last_block_app_hash == app.tree.root(2)
         run(go())
 
     def test_validator_updates(self):

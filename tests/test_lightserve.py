@@ -264,9 +264,10 @@ class TestLightserveRPC:
                     cli = HTTPClient(
                         f"http://{node._rpc_server.listen_addr}",
                         timeout=30.0)
+                    commits = []
                     for i in range(2):
-                        await cli.broadcast_tx_commit(
-                            b"lk%d=lv%d" % (i, i))
+                        commits.append(await cli.broadcast_tx_commit(
+                            b"lk%d=lv%d" % (i, i)))
                     while node.height < 5:
                         await asyncio.sleep(0.02)
 
@@ -329,6 +330,72 @@ class TestLightserveRPC:
                     bad["root"] = "00" * 32
                     with pytest.raises(ValueError):
                         verify_kv_multiproof(bad, kv)
+
+                    # --- the absent key carries a real non-inclusion
+                    # arm under the SAME multiproof
+                    verify_kv_multiproof(res["proof"], kv,
+                                         absent_keys=[b"absent"])
+
+                    # --- the full trust chain at a pinned height:
+                    # header.app_hash -> tree root -> key, for both
+                    # present and absent keys.  hq is old enough that
+                    # the app committed it (pipelined commit lag) and
+                    # header hq+1 is in the store.
+                    from cometbft_tpu.light import verify_state_proof
+                    h_commit = max(int(r["height"]) for r in commits)
+                    while node.height < h_commit + 2:
+                        await asyncio.sleep(0.02)
+                    hq = node.height - 2
+                    res3 = await cli.call(
+                        "abci_query_batch",
+                        data="0x" + b"lk0".hex() + ",0x" +
+                             b"absent".hex(),
+                        height=str(hq), prove=True)
+                    proof = res3["proof"]
+                    assert int(proof["version"]) == hq
+                    assert int(proof["header_height"]) == hq + 1
+                    hdr = node.block_store.load_block_meta(
+                        hq + 1).header
+                    present = [(b"lk0", b"lv0")]
+                    verify_state_proof(hdr, proof, present=present,
+                                       absent=[b"absent"])
+                    verify_kv_multiproof(proof, present,
+                                         absent_keys=[b"absent"],
+                                         verified_header=hdr)
+                    # chaining to a header at any OTHER height is
+                    # refused — a stale-version proof cannot be
+                    # replayed against a newer header
+                    other = node.block_store.load_block_meta(
+                        hq + 2).header
+                    with pytest.raises(ValueError):
+                        verify_state_proof(other, proof,
+                                           present=present)
+                    # a forged root fails the app_hash comparison
+                    forged = json.loads(json.dumps(proof))
+                    forged["root"] = "11" * 32
+                    with pytest.raises(ValueError):
+                        verify_state_proof(hdr, forged,
+                                           present=present)
+                    # a pre-statetree envelope (no header binding)
+                    # cannot chain to consensus at all
+                    legacy = {k: v for k, v in proof.items()
+                              if k not in ("header_height",)}
+                    with pytest.raises(ValueError,
+                                       match="no header binding"):
+                        verify_state_proof(hdr, legacy,
+                                           present=present)
+
+                    # --- proven batches at a pinned height < tip are
+                    # immutable, so they cache
+                    before3 = node.lightserve_cache.stats()
+                    res4 = await cli.call(
+                        "abci_query_batch",
+                        data="0x" + b"lk0".hex() + ",0x" +
+                             b"absent".hex(),
+                        height=str(hq), prove=True)
+                    after3 = node.lightserve_cache.stats()
+                    assert after3["hits"] >= before3["hits"] + 1
+                    assert res4["proof"] == proof
 
                     # --- prove=false degrades to per-key fanout
                     res2 = await cli.call(

@@ -70,8 +70,10 @@ class TestHandshake:
             ss.save(state)
             h = Handshaker(ss, state, bs, doc)
             app_hash = await h.handshake(conns)
-            # kvstore initial app hash = varint(0)
-            assert app_hash == bytes(8)
+            # kvstore initial app hash = the version-0 state tree root
+            # (genesis validators committed by InitChain)
+            assert len(app_hash) == 32
+            assert app_hash == app.tree.root(0)
             info = await conns.query.info(abci.InfoRequest())
             assert info.last_block_height == 0
         run(go())
@@ -85,6 +87,10 @@ class TestHandshake:
             conns = AppConns(app)
             ss, bs = Store(MemDB()), BlockStore(MemDB())
             ss.save(state)
+            # production flow: handshake (InitChain) before consensus —
+            # genesis validators are part of the committed state tree,
+            # so the replayed app must see the same InitChain
+            await Handshaker(ss, state, bs, doc).handshake(conns)
             cfg = _test_config().consensus
             exec_ = BlockExecutor(ss, conns.consensus, block_store=bs)
             cs = ConsensusState(cfg, state, exec_, bs,

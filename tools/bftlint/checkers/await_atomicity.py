@@ -28,7 +28,10 @@ The sanctioned mutation path is the RoundState transition seam
 (consensus/round_state.py): ``rs.advance()``, ``rs.begin_round()``,
 ``rs.lock()``, ``rs.relock()``, ``rs.set_valid()``,
 ``rs.reset_proposal_parts()``, ``rs.drop_proposal_block()``,
-``rs.adopt_block()``, ``rs.enter_commit()``, ``rs.begin_height()``.
+``rs.adopt_block()``, ``rs.enter_commit()``, ``rs.begin_height()``,
+``rs.set_last_commit()``, ``rs.apply_proposal()``,
+``rs.complete_proposal_block()``, ``rs.mark_timeout_precommit()``,
+``rs.rebuild_votes()``.
 Each transition re-validates its own precondition (monotonicity of
 (round, step), a live lock, ...) at the moment of the write, so a
 seam call after an await is exactly the guarded store this rule asks
@@ -76,6 +79,15 @@ _TRANSITION_GUARDS: dict[str, tuple[str, ...]] = {
     "reset_proposal_parts": (),
     "drop_proposal_block": (),
     "adopt_block": (),
+    # sync-mutation-site extension (ROADMAP carry-over): the seam now
+    # covers every RoundState write in consensus/state.py, sync or
+    # async — these re-validate their own preconditions at the write
+    "set_last_commit": ("last_commit",),
+    "apply_proposal": ("proposal", "proposal_receive_time",
+                       "proposal_block_parts"),
+    "complete_proposal_block": ("proposal_block",),
+    "mark_timeout_precommit": ("triggered_timeout_precommit",),
+    "rebuild_votes": ("validators", "votes"),
 }
 _TRANSITION_METHODS = frozenset(_TRANSITION_GUARDS)
 

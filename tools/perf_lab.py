@@ -26,6 +26,11 @@ decomposition the flight recorder attributes per height):
     baseline);
   * ``rpc_cache_hit``          — lightserve response-cache lookup
     (the path thousands of light clients ride per request);
+  * ``statetree_commit`` / ``statetree_proof_build`` /
+    ``statetree_proof_verify`` — the committed state tree behind the
+    kvstore's app_hash (docs/state_tree.md): a 1k-key write+commit,
+    and building/verifying a 256-key proof envelope (224 existence +
+    32 non-inclusion arms under one multiproof);
   * ``bftlint_selfcheck``      — the full-package bftlint run that
     gates tier-1 (tests/test_bftlint.py); a pathological checker
     (an accidental O(n^2) walk) must not blow the tier-1 budget, so
@@ -376,6 +381,85 @@ def bench_rpc_cache_hit(fast: bool):
     # per-op: each run() does 512 lookups
     for k in ("p50_ms", "min_ms", "mean_ms"):
         stats[k] = round(stats[k] / 512, 6)
+    return stats
+
+
+# statetree: the committed state tree that IS the kvstore's app_hash
+# (docs/state_tree.md).  Pinned geometry: 1024 committed keys, and a
+# 256-key request batch of which 32 are absent — so the verify number
+# includes the non-inclusion adjacency arms, not just membership.
+
+_STATETREE_KEYS = 1024
+_STATETREE_REQ_PRESENT = 224
+_STATETREE_REQ_ABSENT = 32
+
+
+def _statetree_fixture():
+    from cometbft_tpu.db import MemDB
+    from cometbft_tpu.statetree import StateTree
+    t = StateTree(MemDB())
+    for i in range(_STATETREE_KEYS):
+        t.set(b"st-key-%05d" % (2 * i), b"st-val-%d" % i)
+    root = t.commit(1)
+    # even keys exist; odd keys fall in the gaps between them
+    req = [b"st-key-%05d" % (2 * i)
+           for i in range(_STATETREE_REQ_PRESENT)] + \
+          [b"st-key-%05d" % (2 * i + 1)
+           for i in range(_STATETREE_REQ_ABSENT)]
+    return t, req, root
+
+
+def bench_statetree_commit(fast: bool):
+    """1k-key write + version commit — the per-block ceiling for a
+    block that rewrites every key of a 1k-key app (the ISSUE 17
+    gate shape)."""
+    from cometbft_tpu.db import MemDB
+    from cometbft_tpu.statetree import StateTree
+
+    def setup():
+        t = StateTree(MemDB())
+        for i in range(_STATETREE_KEYS):
+            t.set(b"st-key-%05d" % (2 * i), b"v0")
+        t.commit(1)
+        return {"tree": t, "version": 1}
+
+    def run(state):
+        state["version"] += 1
+        v = state["version"]
+        t = state["tree"]
+        for i in range(_STATETREE_KEYS):
+            t.set(b"st-key-%05d" % (2 * i), b"v%d" % v)
+        t.commit(v)
+
+    stats = measure(run, reps=5 if fast else 15, setup=setup,
+                    warmup=1)
+    stats["keys"] = _STATETREE_KEYS
+    return stats
+
+
+def bench_statetree_proof_build(fast: bool):
+    t, req, _ = _statetree_fixture()
+    stats = measure(lambda: t.prove(req, 1),
+                    reps=5 if fast else 15, inner=3, warmup=1)
+    stats["keys"] = len(req)
+    stats["absent_keys"] = _STATETREE_REQ_ABSENT
+    return stats
+
+
+def bench_statetree_proof_verify(fast: bool):
+    from cometbft_tpu.statetree import verify_proof_envelope
+    t, req, root = _statetree_fixture()
+    env = t.prove(req, 1)
+    present = [(b"st-key-%05d" % (2 * i), b"st-val-%d" % i)
+               for i in range(_STATETREE_REQ_PRESENT)]
+    absent = req[_STATETREE_REQ_PRESENT:]
+    stats = measure(
+        lambda: verify_proof_envelope(env, present=present,
+                                      absent=absent,
+                                      expected_root=root),
+        reps=5 if fast else 15, inner=3, warmup=2)
+    stats["keys"] = len(req)
+    stats["absent_keys"] = _STATETREE_REQ_ABSENT
     return stats
 
 
@@ -871,6 +955,9 @@ BENCHMARKS = {
     "multiproof_verify": (bench_multiproof_verify, True),
     "proofs_verify_256": (bench_proofs_verify_256, True),
     "rpc_cache_hit": (bench_rpc_cache_hit, True),
+    "statetree_commit": (bench_statetree_commit, True),
+    "statetree_proof_build": (bench_statetree_proof_build, True),
+    "statetree_proof_verify": (bench_statetree_proof_verify, True),
     "mempool_incremental_recheck": (
         bench_mempool_incremental_recheck, True),
     "height_pipeline_overlap": (bench_height_pipeline_overlap, True),
