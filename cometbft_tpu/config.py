@@ -265,6 +265,13 @@ class InstrumentationConfig:
     # safety violations, /debug/pprof/trace?dump=1) land; empty means
     # the node's data dir (never the process CWD)
     dump_dir: str = ""
+    # clock-anchor refresh cadence: how often the recorder pairs a
+    # monotonic reading with wall time so tools/fleet_report.py can
+    # align this node's timeline with the rest of the fleet
+    trace_anchor_interval_s: float = 30.0
+    # event-loop lag sampler (libs/health.py) cadence; 0 disables —
+    # feeds cometbft_node_event_loop_lag_seconds and /health's p95
+    loop_lag_interval_s: float = 0.25
 
 
 @dataclass
@@ -384,6 +391,13 @@ def validate_basic(cfg: Config) -> None:
     if cfg.instrumentation.trace_buffer_size <= 0:
         raise ConfigError(
             "instrumentation.trace_buffer_size must be positive")
+    if cfg.instrumentation.trace_anchor_interval_s <= 0:
+        raise ConfigError(
+            "instrumentation.trace_anchor_interval_s must be "
+            "positive")
+    if cfg.instrumentation.loop_lag_interval_s < 0:
+        raise ConfigError(
+            "instrumentation.loop_lag_interval_s cannot be negative")
     if cfg.base.signature_cache_size <= 0:
         raise ConfigError(
             "base.signature_cache_size must be positive")

@@ -476,6 +476,14 @@ class ConsensusReactor(Reactor):
             return   # ignore data/votes while syncing
         elif chan_id == DATA_CHANNEL:
             if isinstance(msg, ProposalMessage):
+                # first-seen marker for the fleet critical path: which
+                # link delivered the proposal to this node, and when —
+                # the state machine's proposal_received instant has no
+                # peer attribution (it runs after the input queue)
+                tracing.instant(tracing.CONSENSUS, "proposal_recv",
+                                height=msg.proposal.height,
+                                round=msg.proposal.round,
+                                peer=peer.id[:12], chan=chan_id)
                 ps.apply_proposal(msg)
                 self.cs.send_peer(msg, peer.id)
             elif isinstance(msg, ProposalPOLMessage):
@@ -486,7 +494,7 @@ class ConsensusReactor(Reactor):
                 tracing.instant(tracing.CONSENSUS, "block_part_recv",
                                 height=msg.height,
                                 index=msg.part.index,
-                                peer=peer.id[:12])
+                                peer=peer.id[:12], chan=chan_id)
                 self._credit_useful_part(chan_id, msg)
                 self.cs.send_peer(msg, peer.id)
             elif isinstance(msg, CompactBlockPartMessage):
@@ -499,7 +507,7 @@ class ConsensusReactor(Reactor):
                                 "compact_block_recv",
                                 height=msg.height,
                                 txs=len(msg.tx_hashes),
-                                peer=peer.id[:12])
+                                peer=peer.id[:12], chan=chan_id)
                 self.cs.send_peer(msg, peer.id)
             elif isinstance(msg, CompactBlockNackMessage):
                 # the peer could not rebuild our compact proposal:
@@ -507,6 +515,10 @@ class ConsensusReactor(Reactor):
                 # lacks right now — the per-peer gossip routine backs
                 # this up for anything the queue drops
                 ps.clear_compact_grace(msg.height, msg.round)
+                tracing.instant(tracing.CONSENSUS,
+                                "compact_block_nack",
+                                height=msg.height,
+                                peer=peer.id[:12], chan=chan_id)
                 self._push_parts_now(ps, msg.height, msg.round)
         elif chan_id == VOTE_CHANNEL:
             if isinstance(msg, VoteMessage):
@@ -517,7 +529,8 @@ class ConsensusReactor(Reactor):
                                 v.validator_index)
                 tracing.instant(tracing.CONSENSUS, "vote_recv",
                                 height=v.height, round=v.round,
-                                type=v.type, peer=peer.id[:12])
+                                type=v.type, index=v.validator_index,
+                                peer=peer.id[:12], chan=chan_id)
                 self.cs.send_peer(msg, peer.id)
             elif isinstance(msg, VoteBatchMessage):
                 per = len(msg_bytes) // max(1, len(msg.votes))
@@ -527,7 +540,9 @@ class ConsensusReactor(Reactor):
                                     v.validator_index)
                     tracing.instant(tracing.CONSENSUS, "vote_recv",
                                     height=v.height, round=v.round,
-                                    type=v.type, peer=peer.id[:12])
+                                    type=v.type,
+                                    index=v.validator_index,
+                                    peer=peer.id[:12], chan=chan_id)
                 # ONE input-queue entry per wire message — expanding
                 # the batch here would multiply queue pressure by the
                 # batch size and defeat the p2p backpressure (the
@@ -547,7 +562,7 @@ class ConsensusReactor(Reactor):
                     tracing.instant(tracing.CONSENSUS,
                                     "agg_commit_recv",
                                     height=msg.commit.height,
-                                    peer=peer.id[:12])
+                                    peer=peer.id[:12], chan=chan_id)
                     self.cs.send_peer(msg, peer.id)
                 else:
                     tracing.instant(tracing.CONSENSUS,

@@ -848,6 +848,13 @@ class ConsensusState:
                 height=rs.height, round=rs.round,
                 part=block_parts.get_part(i)))
         self._broadcast(ProposalMessage(proposal))
+        # first-sent marker: the proposer-side t0 the fleet report
+        # pairs with every other node's proposal_recv (first-seen) to
+        # measure proposal propagation per link
+        tracing.instant(tracing.CONSENSUS, "proposal_broadcast",
+                        height=height, round=round_,
+                        parts=block_parts.total,
+                        txs=len(block.data.txs))
         # compact-block relay (docs/gossip.md): peers that negotiated
         # it get skeleton + tx hashes and rebuild the parts from
         # their mempool; the part broadcasts below skip them for the
@@ -1553,6 +1560,9 @@ class ConsensusState:
         self._pipeline = None
         self.metrics.pipeline_barrier_wait_seconds.observe(
             time.monotonic() - t0)
+        tracing.record_span(tracing.CONSENSUS, "barrier_wait",
+                            start_ns=int(t0 * 1e9),
+                            height=p.height)
         self._reconcile_applied_state(p.height, new_state)
 
     def _reconcile_applied_state(self, applied_height: int,

@@ -1,0 +1,66 @@
+"""Liveness-plane sampling: the event-loop lag histogram.
+
+ISSUE 14's perf-lab stall measurement (``verify_event_loop_stall``)
+proved the event loop is the scarce resource on a validator — every
+reactor, the RPC server, and the consensus state machine share it.
+This module turns that lab-only measurement into an always-on live
+metric: a supervised sampler sleeps for a fixed interval and observes
+how much later than scheduled it actually woke
+(``cometbft_node_event_loop_lag_seconds``).  A loop stalled by a
+blocking call or GC pause shows up here within one interval, and
+``/health`` serves the p95 so the replica tier's load balancer
+(ROADMAP item 4) and the soak gates (item 5) can shed to a healthier
+node without scraping Prometheus.
+
+The sampler costs one timer wakeup per interval (default 250 ms — 4
+observations/s) and is spawned under the node supervisor, so it dies
+with the node and restarts if it crashes.
+"""
+from __future__ import annotations
+
+import asyncio
+
+from .metrics import Histogram, Registry
+
+# lag buckets: a healthy loop wakes within single-digit milliseconds;
+# the tail we care about (blocking verify dispatch, GC, snapshot I/O)
+# lives in the 10ms-2.5s range
+_LAG_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                0.5, 1.0, 2.5)
+
+
+class Metrics:
+    """Node-liveness metric family (subsystem ``node``)."""
+
+    def __init__(self, registry: Registry):
+        self.event_loop_lag_seconds: Histogram = registry.histogram(
+            "node", "event_loop_lag_seconds",
+            "Observed oversleep of a fixed-interval sampler on the "
+            "node event loop: wakeup_actual - wakeup_scheduled.",
+            buckets=_LAG_BUCKETS)
+
+
+class LoopLagSampler:
+    """Fixed-interval oversleep sampler.
+
+    ``await asyncio.sleep(dt)`` never returns early; any extra delay
+    is time the loop spent running other callbacks past their
+    deadline — the same gap-sampling model as perf_lab's
+    ``verify_event_loop_stall`` ticker, at a cadence cheap enough to
+    leave on in production."""
+
+    def __init__(self, metrics: Metrics,
+                 interval_s: float = 0.25):
+        self.metrics = metrics
+        self.interval_s = max(0.001, float(interval_s))
+
+    async def run(self) -> None:
+        loop = asyncio.get_running_loop()
+        interval = self.interval_s
+        hist = self.metrics.event_loop_lag_seconds
+        last = loop.time()
+        while True:
+            await asyncio.sleep(interval)
+            now = loop.time()
+            hist.observe(max(0.0, now - last - interval))
+            last = now

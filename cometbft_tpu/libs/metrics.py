@@ -289,6 +289,30 @@ class Histogram(_Metric):
             # bftlint: disable=monotonic-clock
             self._exemplars[idx] = (v, time.time(), exemplar)
 
+    def quantile(self, q: float) -> float:
+        """Estimate the q-quantile (0 < q <= 1) by linear
+        interpolation over the cumulative bucket counts — the same
+        estimate Prometheus' histogram_quantile() would give a
+        scraper, computed in-process so ``/health`` can serve a p95
+        without a metrics pipeline.  Returns 0.0 with no samples; the
+        +Inf bucket clamps to the largest finite bound (observations
+        past the last bucket are unbounded, so the estimate is a
+        floor there, not a value)."""
+        if self._count == 0:
+            return 0.0
+        rank = q * self._count
+        prev_bound, prev_cum = 0.0, 0
+        for i, b in enumerate(self.buckets):
+            cum = self._counts[i]
+            if cum >= rank:
+                width = cum - prev_cum
+                if width <= 0:
+                    return b
+                return prev_bound + (b - prev_bound) * \
+                    (rank - prev_cum) / width
+            prev_bound, prev_cum = b, cum
+        return self.buckets[-1] if self.buckets else 0.0
+
     def _child_samples(self, labels_prefix: str):
         out = []
         for i, b in enumerate(self.buckets):

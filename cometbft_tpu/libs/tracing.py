@@ -29,6 +29,18 @@ Events are tuples ``(ts_ns, dur_ns, name, height, attrs)`` on a
 ``deque(maxlen=size)`` per category; ``time.monotonic_ns()`` is the
 only clock, so timelines are immune to wall-clock steps and strictly
 ordered within a process.
+
+Clock anchors: monotonic timestamps are process-local, so two nodes'
+timelines cannot be compared directly.  The recorder keeps a bounded
+list of periodically refreshed ``(monotonic_ns, wall_ns)`` anchor
+pairs — sampled together, refreshed passively whenever an event is
+recorded past the anchor interval — exposed in every dump and at the
+``/trace`` RPC.  ``tools/fleet_report.py`` fits offset + drift from
+the pairs and merges N nodes' dumps onto one wall timeline (the
+cluster critical path the committee-consensus measurement papers
+decompose).  Wall time is never used for interval arithmetic here;
+anchors are alignment metadata, the same boundary class as the pex
+addrbook save/load conversion.
 """
 from __future__ import annotations
 
@@ -61,9 +73,14 @@ class Recorder:
     The module-global instance behind :func:`span`/:func:`instant` is
     what the node wires; tests may construct private recorders."""
 
+    #: bound on the anchor list; old middle anchors are evicted but the
+    #: very first is kept so drift fits retain the longest baseline
+    ANCHORS_MAX = 64
+
     def __init__(self, buffer_size: int = 4096, enabled: bool = True,
                  categories: Optional[str] = None,
-                 dump_dir: str = ""):
+                 dump_dir: str = "", node_id: str = "",
+                 anchor_interval_s: float = 30.0):
         self.buffer_size = max(1, int(buffer_size))
         self.enabled = enabled
         # None = every category; else the enabled set
@@ -73,7 +90,16 @@ class Recorder:
             if isinstance(categories, str) and categories.strip()
             else (frozenset(categories) if categories else None))
         self.dump_dir = dump_dir
+        self.node_id = node_id
         self.last_dump_path = ""
+        # (monotonic_ns, wall_ns) pairs for cross-node alignment; the
+        # first is taken here so even a dump written in the first
+        # interval carries one.  time.time_ns is sampled ONLY to pair
+        # with a monotonic reading — never for interval arithmetic.
+        self.anchor_interval_ns = max(1, int(anchor_interval_s * 1e9))
+        self.anchors: list[tuple[int, int]] = []
+        self._next_anchor_ns = 0
+        self.refresh_anchor(force=True)
         # best-effort height context: the consensus step machine
         # stamps the height in progress, and events recorded without
         # an explicit height (crypto dispatches, p2p frames, abci
@@ -107,12 +133,31 @@ class Recorder:
         self._ring(category).append(
             (start_ns, end_ns - start_ns, name,
              height or self.current_height, attrs))
+        if end_ns >= self._next_anchor_ns:
+            self.refresh_anchor()
 
     def record_instant(self, category: str, name: str, height: int,
                        attrs: Optional[dict]) -> None:
+        ts = now_ns()
         self._ring(category).append(
-            (now_ns(), 0, name, height or self.current_height,
-             attrs))
+            (ts, 0, name, height or self.current_height, attrs))
+        if ts >= self._next_anchor_ns:
+            self.refresh_anchor()
+
+    def refresh_anchor(self, force: bool = False) -> None:
+        """Sample a fresh (monotonic_ns, wall_ns) pair.  Driven
+        passively from the record paths — one int comparison per event
+        — so a recorder that sees traffic keeps current anchors with
+        no timer task; idle recorders still hold their construction
+        anchor."""
+        mono = now_ns()
+        if not force and mono < self._next_anchor_ns:
+            return
+        self._next_anchor_ns = mono + self.anchor_interval_ns
+        self.anchors.append((mono, time.time_ns()))
+        if len(self.anchors) > self.ANCHORS_MAX:
+            # keep the first (longest drift baseline) and the newest
+            del self.anchors[1]
 
     # -- readers -----------------------------------------------------
     def snapshot(self, height: Optional[int] = None,
@@ -173,12 +218,15 @@ class Recorder:
                 path = os.path.join(
                     self.resolved_dump_dir(),
                     f"flight-{os.getpid()}-{seq:03d}-{slug}.json")
+            self.refresh_anchor(force=True)
             record = {
                 "reason": reason,
                 "wall_time": time.strftime(
                     "%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
                 "monotonic_ns": now_ns(),
                 "pid": os.getpid(),
+                "node": self.node_id,
+                "anchors": [list(a) for a in self.anchors],
                 "extra": extra or {},
                 "events": self.snapshot(),
             }
@@ -314,14 +362,26 @@ def clear() -> None:
 
 def configure(enabled: bool = True, buffer_size: int = 4096,
               categories: Optional[str] = None,
-              dump_dir: str = "") -> Recorder:
+              dump_dir: str = "", node_id: str = "",
+              anchor_interval_s: float = 30.0) -> Recorder:
     """(Re)configure the process-global recorder — called by the node
     from instrumentation.trace_* config.  Existing rings are dropped
     so the new buffer size takes effect."""
     global _R
     _R = Recorder(buffer_size=buffer_size, enabled=enabled,
-                  categories=categories, dump_dir=dump_dir)
+                  categories=categories, dump_dir=dump_dir,
+                  node_id=node_id,
+                  anchor_interval_s=anchor_interval_s)
     return _R
+
+
+def refresh_anchor(force: bool = False) -> None:
+    """Take a fresh clock anchor on the process-global recorder."""
+    _R.refresh_anchor(force=force)
+
+
+def anchors() -> list[tuple[int, int]]:
+    return list(_R.anchors)
 
 
 def recorder() -> Recorder:

@@ -32,6 +32,7 @@ from collections import OrderedDict
 from typing import Optional
 
 from ..config import MempoolConfig
+from ..libs import tracing
 from ..libs.log import Logger
 from ..libs.supervisor import RestartPolicy
 from ..p2p.conn import ChannelDescriptor
@@ -225,11 +226,23 @@ class MempoolReactor(Reactor):
         except Exception as e:
             self.logger.error("bad mempool message", err=str(e))
             return
+        # one peer-attributed instant per wire message (not per tx):
+        # bounded by the p2p recv rate, and what lets fleet_report
+        # attribute reconciliation chatter to links
         if isinstance(msg, TxsMessage):
+            tracing.instant(tracing.MEMPOOL, "txs_recv",
+                            txs=len(msg.txs), peer=peer.id[:12],
+                            chan=chan_id)
             await self._receive_txs(msg, peer)
         elif isinstance(msg, TxHaveMessage):
+            tracing.instant(tracing.MEMPOOL, "have_recv",
+                            ids=len(msg.ids), peer=peer.id[:12],
+                            chan=chan_id)
             self._receive_have(msg, peer)
         elif isinstance(msg, TxWantMessage):
+            tracing.instant(tracing.MEMPOOL, "want_recv",
+                            ids=len(msg.ids), peer=peer.id[:12],
+                            chan=chan_id)
             self._receive_want(msg, peer)
 
     async def _receive_txs(self, msg: TxsMessage, peer: Peer) -> None:

@@ -91,7 +91,9 @@ class Node:
             buffer_size=config.instrumentation.trace_buffer_size,
             categories=config.instrumentation.trace_categories or None,
             dump_dir=config.base.path(dump_dir) if dump_dir
-            else db_dir)
+            else db_dir,
+            anchor_interval_s=config.instrumentation
+            .trace_anchor_interval_s)
         from ..types import signature_cache
         signature_cache.set_default_capacity(
             config.base.signature_cache_size)
@@ -102,6 +104,9 @@ class Node:
                 config.base.genesis_file))
         self.node_key = NodeKey.load_or_gen(
             config.base.path(config.base.node_key_file))
+        # stamp the recorder with our identity (the key loads after
+        # configure) so every dump/scrape names the node it came from
+        tracing.recorder().node_id = self.node_key.id[:12]
         if config.base.priv_validator_laddr:
             # remote signer: key lives in an external process
             # (reference: createAndStartPrivValidatorSocketClient,
@@ -176,6 +181,11 @@ class Node:
             self.metrics_registry)
         self.supervisor = Supervisor("node", logger=self.logger,
                                      metrics=self.supervisor_metrics)
+        # liveness plane (libs/health.py): event-loop lag histogram
+        # sampled by a supervised task started in start(), served by
+        # /health and /metrics
+        from ..libs.health import Metrics as HealthMetrics
+        self.health_metrics = HealthMetrics(self.metrics_registry)
 
         # --- lightserve: height-keyed RPC response cache ----------------
         # immutable responses (blocks/commits/light blocks/multiproofs
@@ -468,6 +478,17 @@ class Node:
             self.statesync_reactor = StatesyncReactor(
                 self.app_conns, metrics=self.statesync_metrics)
         self.switch.add_reactor(self.statesync_reactor)
+
+        # event-loop lag sampler: always-on liveness signal behind
+        # /health and cometbft_node_event_loop_lag_seconds; dies with
+        # the supervisor in stop()
+        if cfg.instrumentation.loop_lag_interval_s > 0:
+            from ..libs.health import LoopLagSampler
+            sampler = LoopLagSampler(
+                self.health_metrics,
+                interval_s=cfg.instrumentation.loop_lag_interval_s)
+            self.supervisor.spawn(sampler.run, name="loop_lag",
+                                  kind="loop_lag")
 
         # RPC before p2p (reference: OnStart order)
         if cfg.rpc.laddr:

@@ -118,7 +118,58 @@ def routes(env: Environment) -> dict:
 
 
 async def _health(env):
-    return {}
+    """Readiness/lag plane (docs/observability.md): what a load
+    balancer in front of the replica tier — or the QA soak gates —
+    polls instead of scraping Prometheus.  Height lag is measured
+    against the best height any peer has advertised (consensus
+    round states while in consensus, the blocksync pool while
+    syncing); the p95s are computed in-process from the live
+    histograms."""
+    node = env.node
+    height = env.block_store.height
+    best_peer = 0
+    cr = getattr(node, "consensus_reactor", None)
+    if cr is not None:
+        for ps in list(cr._peer_states.values()):
+            # prs.height is the height the peer is WORKING on; its
+            # committed head is one behind
+            best_peer = max(best_peer, ps.prs.height - 1)
+    catching_up = bool(getattr(cr, "wait_sync", False))
+    br = getattr(node, "blocksync_reactor", None)
+    if br is not None and br.pool is not None:
+        best_peer = max(best_peer, br.pool.max_peer_height())
+    lag = max(0, best_peer - height)
+    sw = getattr(node, "switch", None)
+    n_peers = sw.num_peers() if sw is not None else 0
+    mp = getattr(node, "mempool", None)
+    barrier_p95 = 0.0
+    cs = getattr(node, "consensus_state", None)
+    if cs is not None:
+        barrier_p95 = cs.metrics \
+            .pipeline_barrier_wait_seconds.quantile(0.95)
+    loop_lag_p95 = 0.0
+    hm = getattr(node, "health_metrics", None)
+    if hm is not None:
+        loop_lag_p95 = hm.event_loop_lag_seconds.quantile(0.95)
+    if catching_up:
+        status = "syncing"
+    elif lag > 2:
+        status = "lagging"
+    else:
+        status = "ok"
+    return {
+        "status": status,
+        "height": str(height),
+        "best_peer_height": str(best_peer),
+        "height_lag": str(lag),
+        "catching_up": catching_up,
+        "n_peers": str(n_peers),
+        "mempool_txs": str(mp.size() if mp is not None else 0),
+        "mempool_bytes": str(
+            mp.size_bytes() if mp is not None else 0),
+        "pipeline_barrier_wait_p95_s": round(barrier_p95, 6),
+        "event_loop_lag_p95_s": round(loop_lag_p95, 6),
+    }
 
 
 async def _status(env):
@@ -605,9 +656,16 @@ async def _trace(env, height, category, limit):
                               category=str(category)
                               if category else None,
                               limit=lim)
+    r = tracing.recorder()
+    r.refresh_anchor()
     return {
         "enabled": tracing.enabled(),
         "count": len(events),
+        "node": r.node_id,
+        # (monotonic_ns, wall_ns) clock-anchor pairs: what lets
+        # tools/fleet_report.py place this node's monotonic
+        # timeline on a cluster-wide wall clock
+        "anchors": [[str(m), str(w)] for m, w in r.anchors],
         # int64s ride as strings, the surface-wide convention
         "events": [{**e, "ts_ns": str(e["ts_ns"]),
                     "dur_ns": str(e["dur_ns"]),

@@ -107,6 +107,13 @@ class QAReport:
     # mismatches prove the full-part fallback was exercised in-run
     # (ISSUE 12 acceptance)
     compact_blocks: dict = field(default_factory=dict)
+    # cluster critical-path metrics from the fleet collector's
+    # artifact (ISSUE 19; -1 = not measured): p95 time from proposal
+    # first-sent to 2/3 prevote power arriving at a node, and the max
+    # inter-node commit skew observed at any height
+    fleet_path: str = ""
+    prevote_t23_p95_s: float = -1.0
+    commit_skew_max_s: float = -1.0
     mismatches: list[str] = field(default_factory=list)
     notes: list[str] = field(default_factory=list)
     # stages that ran but failed their objective (e.g. a statesync
@@ -774,6 +781,161 @@ async def _fetch_profile(pprof_port: int, seconds: int = 30) -> list:
     return out
 
 
+# --------------------------------------------------------------------------
+# fleet collector (docs/observability.md): periodic /trace + /health
+# scrapes across every node streamed into one run-level artifact, so
+# a finished (or crashed) run always has the cross-node evidence
+# tools/fleet_report.py needs — not just the one node that failed.
+
+def _load_fleet_report():
+    """tools/fleet_report.py lives at the repo root (outside the
+    package, like trace_report); load it by path."""
+    import importlib.util
+    root = os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))))
+    p = os.path.join(root, "tools", "fleet_report.py")
+    spec = importlib.util.spec_from_file_location("fleet_report", p)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _FleetCollector:
+    """Scrapes /trace (events + clock anchors) and /health from every
+    node on a fixed cadence, deduplicating events across overlapping
+    ring snapshots, and writes a ``fleet_<run>.json`` the fleet
+    report consumes directly.  Best-effort throughout: a node
+    mid-restart just misses a round."""
+
+    def __init__(self, rpc_ep: dict, path: str,
+                 interval_s: float = 10.0):
+        self.rpc_ep = dict(rpc_ep)
+        self.path = path
+        self.interval_s = interval_s
+        self._nodes: dict[str, dict] = {}
+        self._health: dict[str, dict] = {}
+        self._task = None
+        self._stop = asyncio.Event()
+
+    def track(self, name: str, endpoint: str) -> None:
+        self.rpc_ep[name] = endpoint
+
+    async def scrape_once(self) -> None:
+        from ..rpc.client import HTTPClient
+        for name, ep in list(self.rpc_ep.items()):
+            cli = HTTPClient(ep, timeout=10.0)
+            try:
+                body = await cli.call("trace")
+            except Exception as e:
+                logger.debug("fleet trace scrape failed", node=name,
+                             err=repr(e))
+                continue
+            rec = self._nodes.setdefault(
+                name, {"node": name, "anchors": [], "events": {}})
+            if body.get("node"):
+                rec["node"] = body["node"]
+            if body.get("anchors"):
+                rec["anchors"] = body["anchors"]
+            for e in body.get("events") or []:
+                key = (e.get("ts_ns"), e.get("category"),
+                       e.get("name"), e.get("dur_ns"))
+                rec["events"][key] = e
+            try:
+                self._health[name] = await cli.call("health")
+            except Exception as e:
+                logger.debug("fleet health scrape failed", node=name,
+                             err=repr(e))
+
+    async def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                await self.scrape_once()
+            except Exception as e:
+                logger.debug("fleet scrape round failed",
+                             err=repr(e))
+            try:
+                await asyncio.wait_for(self._stop.wait(),
+                                       self.interval_s)
+            except asyncio.TimeoutError:
+                pass
+
+    def start(self) -> None:
+        self._task = asyncio.get_running_loop().create_task(
+            self._run())
+
+    async def stop_and_write(self) -> str:
+        """Final fleet-wide scrape (the nodes are still up — this
+        runs before teardown), then the artifact.  Returns the path
+        or "" if nothing was ever collected."""
+        self._stop.set()
+        if self._task is not None:
+            try:
+                await self._task
+            except Exception as e:
+                logger.debug("fleet collector task died",
+                             err=repr(e))
+            self._task = None
+        try:
+            await self.scrape_once()
+        except Exception as e:
+            logger.debug("final fleet scrape failed", err=repr(e))
+        if not self._nodes:
+            return ""
+        doc = {"nodes": {
+            name: {"node": rec["node"], "anchors": rec["anchors"],
+                   "events": sorted(
+                       rec["events"].values(),
+                       key=lambda e: int(e.get("ts_ns") or 0))}
+            for name, rec in self._nodes.items()},
+            "health": self._health}
+        tmp = self.path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(doc, f)
+        os.replace(tmp, self.path)
+        return self.path
+
+
+# cluster-level gates (ISSUE 19): the waterfall numbers a healthy rig
+# must hold.  p95 time-to-2/3-prevotes spans proposal receipt through
+# vote gossip across WAN-profile relays under load; inter-node commit
+# skew is bounded by one gossip round.  Generous on purpose — these
+# catch regressions of kind (a stuck straggler, a gossip plane that
+# stopped fanning out), not percentage drift.
+PREVOTE_T23_P95_LIMIT_S = 10.0
+COMMIT_SKEW_LIMIT_S = 5.0
+
+
+def _gate_fleet(report: "QAReport", fleet_path: str) -> None:
+    """Derive the gated cluster metrics from the collected fleet
+    artifact via tools/fleet_report.py.  Self-degrading, never
+    raising: a failed analysis leaves the metrics at their -1
+    sentinels with a note."""
+    if not fleet_path:
+        return
+    try:
+        fr = _load_fleet_report()
+        fleet = fr.analyze(fr.load_inputs([fleet_path]))
+        t23s = [r["prevote_t23_ms"] / 1e3
+                for h in fleet["heights"].values()
+                for r in h["nodes"].values()
+                if r["prevote_t23_ms"] is not None]
+        skews = [h["commit_skew_ms"] / 1e3
+                 for h in fleet["heights"].values()]
+        if t23s:
+            t23s.sort()
+            report.prevote_t23_p95_s = round(
+                t23s[min(len(t23s) - 1, int(0.95 * len(t23s)))], 4)
+            if report.prevote_t23_p95_s > PREVOTE_T23_P95_LIMIT_S:
+                report.degraded.append("prevote_t23_p95")
+        if skews:
+            report.commit_skew_max_s = round(max(skews), 4)
+            if report.commit_skew_max_s > COMMIT_SKEW_LIMIT_S:
+                report.degraded.append("commit_skew")
+    except Exception as e:
+        logger.error("fleet gate failed", err=repr(e))
+        report.notes.append(f"fleet-gate: {e!r:.120}")
+
+
 # duplicate-delivery gate (ISSUE 12): at most 2 deliveries per tx
 # per node on average — one useful + one duplicate, i.e. a duplicate
 # fraction <= 0.5 of all gossip deliveries (flood ran ~0.9, >= 5x
@@ -934,6 +1096,7 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
     procs: dict = {}
     relays: list[Relay] = []
     sampler: Optional[_Sampler] = None
+    fleet: Optional[_FleetCollector] = None
     profile_task = None
     try:
         for spec in relay_specs:
@@ -946,6 +1109,15 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             raise TimeoutError("not all nodes became RPC-ready")
         sampler = _Sampler(procs)
         sampler.start()
+        # fleet collector: /trace + /health across every node,
+        # streamed into the run artifact; the final scrape happens in
+        # the finally block BEFORE teardown, so even a crashed run
+        # leaves the fleet-wide record (not just the failing node's)
+        fleet = _FleetCollector(
+            rpc_ep, os.path.join(
+                outdir,
+                f"fleet_{time.strftime('%Y%m%d-%H%M%S')}.json"))
+        fleet.start()
         logger.info("process net booted", nodes=len(procs),
                     relays=len(relays))
 
@@ -1108,6 +1280,8 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
             sampler.track("joiner", procs["joiner"])
             joiner_ep = "http://" + \
                 joiner_cfg.rpc.laddr[len("tcp://"):]
+            if fleet is not None:
+                fleet.track("joiner", joiner_ep)
             try:
                 if not await _rpc_ready(joiner_ep, 240.0):
                     raise TimeoutError("joiner RPC never came up")
@@ -1207,6 +1381,15 @@ async def run_qa_procs(outdir: str, n_validators: int = 12,
                 await profile_task
             except (asyncio.CancelledError, Exception):
                 pass
+        if fleet is not None:
+            # final fleet-wide scrape while the nodes are still up —
+            # this is the give-up/violation evidence path too
+            try:
+                report.fleet_path = await fleet.stop_and_write()
+                _gate_fleet(report, report.fleet_path)
+            except Exception as e:
+                logger.error("fleet collection failed", err=repr(e))
+                report.notes.append(f"fleet-collect: {e!r:.120}")
         if sampler is not None:
             sampler.stop()
         for proc in procs.values():

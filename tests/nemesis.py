@@ -458,24 +458,44 @@ def archive_dir() -> str:
                           "nemesis-archive")
 
 
-def _archive_flight_record(s: Scenario, exc: BaseException) -> str:
+def _archive_flight_record(s: Scenario, exc: BaseException,
+                           net: "NemesisNet" = None) -> str:
     """A failing scenario (liveness miss, safety violation, runner
-    crash) archives the whole flight recorder, named after the
-    scenario and seed — liveness regressions in the slow sweeps come
-    with per-height timelines attached (ROADMAP open item).  Never
-    raises; returns the path or ""."""
+    crash) archives the whole flight recorder — liveness regressions
+    in the slow sweeps come with per-height timelines attached
+    (ROADMAP open item).  The archive name carries a run-unique
+    suffix (pid + monotonic) so repeated runs of the same
+    scenario/seed never overwrite each other's evidence.  Nemesis
+    nodes are in-process and share the one module-global recorder, so
+    this single dump IS the fleet-wide record; per-node state
+    (height, running) rides in ``extra["nodes"]`` and the anchors in
+    the dump let tools/fleet_report.py place it on a wall timeline
+    next to out-of-process dumps.  Never raises; returns the path or
+    ""."""
     import os
+    import time as _time
 
     from cometbft_tpu.libs import tracing
     slug = "".join(c if c.isalnum() or c in "-_" else "-"
                    for c in s.name)[:64] or "scenario"
-    path = os.path.join(archive_dir(),
-                        f"nemesis-{slug}-seed{s.seed}.json")
+    run_id = f"{os.getpid():x}-{_time.monotonic_ns() & 0xFFFFFF:06x}"
+    path = os.path.join(
+        archive_dir(),
+        f"nemesis-{slug}-seed{s.seed}-{run_id}.json")
+    nodes = []
+    if net is not None:
+        try:
+            nodes = [{"idx": n.idx, "running": n.running,
+                      "height": n.block_store.height}
+                     for n in net.nodes]
+        except Exception:
+            nodes = []
     return tracing.dump(
         reason=f"nemesis_scenario_failure_{slug}", path=path,
         extra={"scenario": s.name, "seed": s.seed, "n": s.n,
                "fuzz": s.fuzz, "steps": [list(map(str, st))
                                          for st in s.steps],
+               "nodes": nodes,
                "error": repr(exc)[:500]})
 
 
@@ -520,7 +540,7 @@ async def _run_scenario_inner(s: Scenario,
             net.assert_no_conflicting_commits()
         except BaseException as e:
             if not isinstance(e, asyncio.CancelledError):
-                path = _archive_flight_record(s, e)
+                path = _archive_flight_record(s, e, net)
                 if path and isinstance(e, AssertionError):
                     raise AssertionError(
                         f"{e}\nflight record archived: {path}") \

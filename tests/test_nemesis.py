@@ -253,17 +253,49 @@ class TestFailureArchive:
                     recovery_timeout_s=0.2)))
         finally:
             tracing.set_recorder(old)
-        path = os.path.join(
-            str(tmp_path), "nemesis-archive-probe-seed41.json")
-        assert os.path.exists(path), os.listdir(str(tmp_path))
+        import glob
+        matches = glob.glob(os.path.join(
+            str(tmp_path), "nemesis-archive-probe-seed41-*.json"))
+        assert len(matches) == 1, os.listdir(str(tmp_path))
+        path = matches[0]
         assert str(path) in str(exc_info.value)
         with open(path) as f:
             record = json.load(f)
         assert record["extra"]["scenario"] == "archive-probe"
         assert record["extra"]["seed"] == 41
         assert "liveness" in record["extra"]["error"]
+        # fleet observability: per-node state + clock anchors ride in
+        # the archive so fleet_report can place it on a wall timeline
+        assert record["extra"]["nodes"], "per-node state missing"
+        assert all("height" in n for n in record["extra"]["nodes"])
+        assert record["anchors"], "clock anchors missing"
         # the archive carries a real timeline, not an empty ring
         assert record["events"], "archived flight record is empty"
+
+    def test_archive_names_are_run_unique(self, tmp_path,
+                                          monkeypatch):
+        """Re-running the same scenario+seed must never overwrite the
+        previous run's archive (the old fixed naming silently lost
+        the first failure's evidence)."""
+        import glob
+        import os
+
+        from cometbft_tpu.libs import tracing
+        from nemesis import Scenario, _archive_flight_record
+
+        monkeypatch.setenv("COMETBFT_TPU_NEMESIS_ARCHIVE_DIR",
+                           str(tmp_path))
+        old = tracing.set_recorder(tracing.Recorder())
+        try:
+            s = Scenario(name="dup-probe", seed=7)
+            p1 = _archive_flight_record(s, RuntimeError("first"))
+            p2 = _archive_flight_record(s, RuntimeError("second"))
+        finally:
+            tracing.set_recorder(old)
+        assert p1 and p2 and p1 != p2
+        matches = glob.glob(os.path.join(
+            str(tmp_path), "nemesis-dup-probe-seed7-*.json"))
+        assert len(matches) == 2
 
 
 @pytest.mark.slow

@@ -83,7 +83,13 @@ class TestSingleNode:
                            ["latest_block_height"]) >= 2
 
                 h = await _rpc_call(port, "health")
-                assert h["result"] == {}
+                assert h["result"]["status"] == "ok"
+                assert int(h["result"]["height"]) >= 2
+                assert h["result"]["height_lag"] == "0"
+                assert h["result"]["catching_up"] is False
+                assert h["result"]["n_peers"] == "0"
+                assert "event_loop_lag_p95_s" in h["result"]
+                assert "pipeline_barrier_wait_p95_s" in h["result"]
 
                 ai = await _rpc_call(port, "abci_info")
                 assert int(ai["result"]["response"]

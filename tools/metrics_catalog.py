@@ -33,6 +33,7 @@ def collect_catalog() -> list[dict]:
     )
     from cometbft_tpu.crypto import batch as crypto_batch
     from cometbft_tpu.libs import metrics as libmetrics
+    from cometbft_tpu.libs.health import Metrics as HealthMetrics
     from cometbft_tpu.libs.supervisor import (
         Metrics as SupervisorMetrics,
     )
@@ -51,7 +52,8 @@ def collect_catalog() -> list[dict]:
     reg = libmetrics.Registry()
     for cls in (ConsensusMetrics, MempoolMetrics, P2PMetrics,
                 BlocksyncMetrics, StatesyncMetrics, StateMetrics,
-                ProxyMetrics, SupervisorMetrics, LightserveMetrics):
+                ProxyMetrics, SupervisorMetrics, LightserveMetrics,
+                HealthMetrics):
         cls(reg)
     # force the lazy process-global families into existence
     from cometbft_tpu.crypto import bls12381

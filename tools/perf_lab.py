@@ -16,6 +16,9 @@ decomposition the flight recorder attributes per height):
     observation (the metrics-v2 overhead budget);
   * ``tracing_disabled_span``  — the flight-recorder disabled path
     (tier-1 separately guards < 1µs);
+  * ``tracing_overhead``       — the ENABLED path: a peer-attributed
+    arrival instant with the clock-anchor refresh firing every event
+    (the fleet-observatory per-receive cost ceiling);
   * ``p2p_loopback_send``      — MConnection framing/scheduling cost
     per message over an in-memory pipe (no sockets, no crypto);
   * ``multiproof_build`` / ``multiproof_verify`` /
@@ -219,6 +222,23 @@ def bench_tracing_disabled_span(fast: bool):
         def run():
             with tracing.span(tracing.CRYPTO, "bench"):
                 pass
+        return measure(run, reps=5 if fast else 15, inner=5000)
+    finally:
+        tracing.set_recorder(old)
+
+
+def bench_tracing_overhead(fast: bool):
+    """Enabled-path flight-recorder cost: one peer-attributed arrival
+    instant (the fleet-observatory hot path on every p2p/consensus
+    receive) with the passive clock-anchor refresh armed to fire on
+    every event — the worst case including the wall-clock sample."""
+    from cometbft_tpu.libs import tracing
+    rec = tracing.Recorder(buffer_size=4096, anchor_interval_s=1e-9)
+    old = tracing.set_recorder(rec)
+    try:
+        def run():
+            tracing.instant(tracing.P2P, "recv", height=7,
+                            peer="perfpeer1234", chan=32, bytes=512)
         return measure(run, reps=5 if fast else 15, inner=5000)
     finally:
         tracing.set_recorder(old)
@@ -950,6 +970,7 @@ BENCHMARKS = {
     "signature_cache_hit": (bench_signature_cache_hit, True),
     "metrics_observe": (bench_metrics_observe, True),
     "tracing_disabled_span": (bench_tracing_disabled_span, True),
+    "tracing_overhead": (bench_tracing_overhead, True),
     "p2p_loopback_send": (bench_p2p_loopback_send, True),
     "multiproof_build": (bench_multiproof_build, True),
     "multiproof_verify": (bench_multiproof_verify, True),

@@ -17,14 +17,25 @@ events recorded without a height (crypto kernel dispatches, abci
 calls, p2p frames) are attributed to the window their monotonic
 timestamp falls into.  Buckets:
 
-  * gossip  — window start → ``proposal_complete`` (the time spent
-              collecting the proposal over p2p), falling back to the
-              ``step:Propose`` span;
-  * verify  — crypto ``batch_verify``/``kernel_execute``/``host_prep``
-              spans plus consensus ``validate_block``;
-  * execute — abci call spans (the app's share);
-  * commit  — ``save_block`` plus the ``step:Commit`` span (fsync +
-              finalize path).
+  * gossip   — window start → ``proposal_complete`` (the time spent
+               collecting the proposal over p2p), falling back to the
+               ``step:Propose`` span;
+  * verify   — crypto ``batch_verify``/``kernel_execute``/``host_prep``
+               spans plus consensus ``validate_block``;
+  * execute  — abci call spans (the app's share);
+  * commit   — ``save_block`` plus the ``step:Commit`` span (fsync +
+               finalize path);
+  * pipeline — ``apply_block`` + ``barrier_wait``: the pipelined
+               execute/commit overlapping the NEXT height, and the
+               barrier stalls when it didn't finish in time.  Reported
+               separately because pipelined work off the critical path
+               must not be read as height wall-clock.
+
+Marker instants (compact-block relay, aggregate-commit catchup, vote
+and part arrivals) are counted per height in the ``markers`` column —
+they carry no duration, but their counts tell the protocol story
+(e.g. ``compact_block_miss`` > 0 means the reconstruct fast path fell
+back to full parts).
 """
 from __future__ import annotations
 
@@ -38,6 +49,27 @@ _MS = 1e6  # ns per ms
 # crypto span names that count as "verify" work
 _VERIFY_NAMES = {"batch_verify", "kernel_execute", "host_prep",
                  "kernel_compile"}
+
+# consensus span -> bucket (tests/test_observability_drift.py pins
+# this table against the names the instrumented modules actually
+# emit; "step:*" spans are matched by prefix)
+CONSENSUS_SPAN_BUCKETS = {
+    "validate_block": "verify",
+    "save_block": "commit",
+    "step:Commit": "commit",
+    "apply_block": "pipeline",
+    "barrier_wait": "pipeline",
+}
+
+# consensus instants counted per height (zero-duration markers)
+CONSENSUS_MARKERS = frozenset({
+    "proposal_recv", "proposal_received", "proposal_complete",
+    "proposal_broadcast", "block_part_recv", "vote_recv",
+    "compact_block_recv", "compact_block_rebuilt",
+    "compact_block_miss", "compact_block_nack",
+    "agg_commit_recv", "agg_commit_shed", "pipeline_advance",
+    "commit",
+})
 
 
 def _to_int(v) -> int:
@@ -104,8 +136,9 @@ def analyze(record: dict,
             continue
         row = {"wall_ms": (hi - lo) / _MS, "gossip_ms": 0.0,
                "verify_ms": 0.0, "execute_ms": 0.0, "commit_ms": 0.0,
+               "pipeline_ms": 0.0,
                "p2p_events": 0, "p2p_bytes": 0, "stalls": 0,
-               "batches": []}
+               "markers": {}, "batches": []}
         propose_span = 0.0
         proposal_complete_ts = None
         for e in events:
@@ -132,11 +165,13 @@ def analyze(record: dict,
                 if name.endswith(("_full", "_stall")):
                     row["stalls"] += 1
             elif cat == "consensus":
-                if name == "validate_block":
-                    row["verify_ms"] += dur / _MS
-                elif name in ("save_block", "step:Commit"):
-                    row["commit_ms"] += dur / _MS
-                elif name == "step:Propose":
+                bucket = CONSENSUS_SPAN_BUCKETS.get(name)
+                if bucket is not None:
+                    row[bucket + "_ms"] += dur / _MS
+                elif name in CONSENSUS_MARKERS:
+                    row["markers"][name] = \
+                        row["markers"].get(name, 0) + 1
+                if name == "step:Propose":
                     propose_span = dur / _MS
                 elif name == "proposal_complete":
                     proposal_complete_ts = e["ts_ns"]
@@ -164,16 +199,20 @@ def render_report(record: dict,
         return "\n".join(lines) + "\n"
     hdr = (f"{'height':>7} {'wall_ms':>9} {'gossip_ms':>10} "
            f"{'verify_ms':>10} {'execute_ms':>11} {'commit_ms':>10} "
-           f"{'p2p ev':>7} {'stalls':>7}")
+           f"{'pipe_ms':>8} {'p2p ev':>7} {'stalls':>7}")
     lines.append(hdr)
     lines.append("-" * len(hdr))
     for h, r in rows.items():
         lines.append(
             f"{h:>7} {r['wall_ms']:>9.2f} {r['gossip_ms']:>10.2f} "
             f"{r['verify_ms']:>10.2f} {r['execute_ms']:>11.2f} "
-            f"{r['commit_ms']:>10.2f} {r['p2p_events']:>7} "
-            f"{r['stalls']:>7}")
+            f"{r['commit_ms']:>10.2f} {r['pipeline_ms']:>8.2f} "
+            f"{r['p2p_events']:>7} {r['stalls']:>7}")
     for h, r in rows.items():
+        if r["markers"]:
+            mk = " ".join(f"{k}={v}" for k, v in
+                          sorted(r["markers"].items()))
+            lines.append(f"        h{h} markers: {mk}")
         for b in r["batches"]:
             lines.append(
                 f"        h{h} {b['name']}: batch={b['batch']} "
@@ -189,10 +228,17 @@ def main(argv=None) -> int:
     p.add_argument("dump", help="flight-record JSON file")
     p.add_argument("--height", type=int, default=None,
                    help="restrict to one height")
+    p.add_argument("--json", action="store_true",
+                   help="JSON instead of text")
     args = p.parse_args(argv)
     with open(args.dump) as f:
         record = json.load(f)
-    sys.stdout.write(render_report(record, height=args.height))
+    if args.json:
+        json.dump(analyze(record, height=args.height), sys.stdout,
+                  indent=2)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_report(record, height=args.height))
     return 0
 
 
