@@ -919,14 +919,23 @@ class ConsensusReactor(Reactor):
             while True:
                 rs = self.cs.rs
                 prs = ps.prs
+                # every sent-a-vote branch yields before continuing:
+                # the send helpers never suspend (queue puts), so a
+                # peer that keeps accepting votes would otherwise
+                # busy-spin this coroutine and starve the loop — the
+                # PR 1 livelock shape, resurfaced by interprocedural
+                # yield-in-loop once it stopped crediting the
+                # never-awaiting _gossip_votes_for_height await
                 if rs.height == prs.height:
                     if await self._gossip_votes_for_height(rs, ps):
+                        await asyncio.sleep(0)
                         continue
                 # peer is on the previous height: send our last commit
                 if (prs.height != 0 and
                         rs.height == prs.height + 1 and
                         rs.last_commit is not None):
                     if self._pick_send_vote(ps, rs.last_commit):
+                        await asyncio.sleep(0)
                         continue
                 # peer further behind: send precommits from stored
                 # commit
@@ -941,9 +950,11 @@ class ConsensusReactor(Reactor):
                         # (once per peer height, resent after a
                         # cooldown as a lost-message safety net)
                         if self._send_aggregate_commit(ps, commit):
+                            await asyncio.sleep(0)
                             continue
                     elif commit is not None and \
                             self._pick_send_commit_vote(ps, commit):
+                        await asyncio.sleep(0)
                         continue
                 await asyncio.sleep(self._sleep_s)
         except asyncio.CancelledError:

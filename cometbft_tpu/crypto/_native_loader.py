@@ -5,6 +5,14 @@ on first use with g++ (no external deps — SHA-256 is self-contained)
 and cached next to this package.  Pure-Python implementations remain
 the fallback everywhere, gated by COMETBFT_TPU_NATIVE=0.
 """
+# bftlint: disable-file=blocking-in-async
+# Justified: every blocking call here (cpuinfo probe, freshness tag
+# read, g++ subprocess) runs at most once per process — load() is
+# memoized via _mod/_failed, hot paths call load(allow_build=False)
+# which never compiles, and the node pre-builds in a worker thread at
+# startup.  Without this, the interprocedural may_block summary would
+# taint every async caller of batched_hashes with an unreachable
+# build chain.
 from __future__ import annotations
 
 import os

@@ -382,6 +382,11 @@ class Node:
                 # reference state.go OnStart: one repair attempt — keep
                 # the valid prefix, stash the corrupt tail, replay again
                 from ..consensus.wal import repair_wal_file
+                # justified synchronous durability point: one-shot WAL
+                # repair during startup replay — consensus is not
+                # running yet and the truncate must complete before
+                # anything else touches the WAL
+                # bftlint: disable=blocking-in-async
                 dropped = repair_wal_file(wal_path)
                 # repair may have renamed the head file out from under
                 # the already-open append handle

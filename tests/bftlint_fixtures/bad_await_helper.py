@@ -1,0 +1,15 @@
+# bftlint: path=cometbft_tpu/consensus/fixture.py
+# the straddle hides behind an extracted helper: the await point
+# moved into _flush, the unguarded store stayed behind
+class Machine:
+    async def _flush(self):
+        # unresolved operand: may suspend
+        await self.wal.write_sync_marker()
+
+    async def on_proposal(self, h):
+        if self.rs.height != h:
+            return
+        await self._flush()
+        # await-atomicity: the round state may have advanced during
+        # _flush's suspension; no re-check between await and store
+        self.rs.height = h

@@ -1,0 +1,26 @@
+# bftlint: path=cometbft_tpu/consensus/fixture.py
+# the ISSUE 20 blind spot: the blocking call is two helper calls deep
+# from the async entry — invisible to the intra-procedural rule
+import time
+
+
+def _backoff():
+    time.sleep(0.5)
+
+
+def _retry_with_backoff():
+    _backoff()
+
+
+class Dialer:
+    def _pause(self):
+        time.sleep(0.1)
+
+    async def connect(self):
+        # blocking-in-async: transitively blocks via
+        # _retry_with_backoff -> _backoff -> time.sleep
+        _retry_with_backoff()
+
+    async def reconnect(self):
+        # one method-call deep: self._pause -> time.sleep
+        self._pause()

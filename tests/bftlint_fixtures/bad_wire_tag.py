@@ -1,0 +1,11 @@
+# two fields sharing one wire tag: decode order silently picks a
+# winner (the runtime Msg.__init__ check only fires if this arm is
+# ever constructed — rarely-imported reactors may never be, in CI)
+from cometbft_tpu.wire.proto import F, Msg
+
+DUP = Msg(
+    "test.wire.DupTag",
+    F(1, "height", "int64"),
+    F(1, "round", "int32"),
+    F(2, "step", "uint32"),
+)

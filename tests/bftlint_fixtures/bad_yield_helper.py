@@ -1,0 +1,19 @@
+# bftlint: path=cometbft_tpu/consensus/fixture.py
+# the retired false negative: the continue path "awaits", but the
+# awaited helper never suspends — a busy-spin in disguise
+import asyncio
+
+
+class Gossip:
+    async def _drain(self, ps):
+        while ps.queue:
+            ps.queue.pop()
+
+    async def routine(self, ps):
+        while True:
+            if ps.dirty:
+                await self._drain(ps)
+                # yield-in-loop: _drain never awaits, so no
+                # suspension happened on the way here
+                continue
+            await asyncio.sleep(0.1)

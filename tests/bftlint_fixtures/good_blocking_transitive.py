@@ -1,0 +1,27 @@
+# bftlint: path=cometbft_tpu/consensus/fixture.py
+import asyncio
+import time
+
+
+def _compute(x):
+    # pure helper: calling it from async code is fine
+    return x * 2
+
+
+def _flush(tag):
+    # justified synchronous durability point AT THE BLOCKING SITE:
+    # the suppression keeps the blocking call out of the effect
+    # summary, so async callers are not transitively flagged
+    # bftlint: disable=blocking-in-async
+    time.sleep(0.001)
+    return tag
+
+
+class Dialer:
+    async def tick(self, peer):
+        _compute(1)
+        _flush("wal")
+        # unresolved call: sound default is may_block=False — the
+        # linter only claims blocking it can prove
+        peer.transport.poke()
+        await asyncio.sleep(0)

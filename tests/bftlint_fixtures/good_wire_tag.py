@@ -1,0 +1,17 @@
+# a clean descriptor: unique tags, constant positional shape — and a
+# fixture path outside cometbft_tpu/, so no manifest entry is demanded
+from cometbft_tpu.wire.proto import F, Msg
+
+PART = Msg(
+    "test.wire.Part",
+    F(1, "index", "uint32"),
+    F(2, "bytes", "bytes"),
+)
+
+BLOCK_PART = Msg(
+    "test.wire.BlockPart",
+    F(1, "height", "int64"),
+    F(2, "round", "int32"),
+    F(3, "part", "msg", msg=PART, always=True),
+    F(4, "sigs", "bytes", repeated=True),
+)

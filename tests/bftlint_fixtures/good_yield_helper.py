@@ -1,0 +1,16 @@
+# bftlint: path=cometbft_tpu/consensus/fixture.py
+# awaits inside an always-awaiting helper keep their credit: the
+# naive "only literal awaits count" upgrade would have flagged this
+import asyncio
+
+
+class Gossip:
+    async def _drain(self, ps):
+        await ps.flush()
+
+    async def routine(self, ps):
+        while True:
+            if ps.dirty:
+                await self._drain(ps)
+                continue
+            await asyncio.sleep(0.1)

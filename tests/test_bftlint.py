@@ -51,12 +51,12 @@ def _cli(*args):
 # the tier-1 gate: the repo lints clean
 
 class TestRepoGate:
-    def test_all_eight_rules_registered(self):
+    def test_all_nine_rules_registered(self):
         assert RULES == sorted((
             "supervised-spawn", "monotonic-clock",
             "swallowed-exception", "yield-in-loop",
             "await-atomicity", "blocking-in-async",
-            "unbounded-label", "cwd-write"))
+            "unbounded-label", "cwd-write", "wire-tag"))
 
     def test_package_check_is_clean(self):
         """`python -m tools.bftlint check` exits 0 on the repo with

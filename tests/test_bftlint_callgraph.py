@@ -1,0 +1,562 @@
+"""ISSUE 20: the interprocedural layer — call-graph resolution, the
+fixed-point effect engine, the upgraded checkers' transitive fixtures,
+the wire-tag manifest, and ``check --diff``.
+
+Covers, per the ISSUE's test satellite:
+  * resolution unit tests: module function vs method (incl. same-
+    package base classes) vs imported name vs deliberately-unresolved;
+  * cycle convergence of the fixed point (mutual-await cycles settle
+    at False; a chain ending in a real await settles at True);
+  * bad/good fixture pairs for each upgraded rule (blocking two calls
+    deep, await-through-helper straddle, spawn-via-wrapper,
+    yield-credited-helper), each bad one exiting 1 via the CLI;
+  * regression pinning that the retired false-positive shapes stay
+    clean;
+  * wire-tag drift against a scratch manifest + the wire-manifest
+    regeneration subcommand being idempotent against the committed
+    one;
+  * ``check --diff`` judging only changed files (scratch git repo).
+"""
+import ast
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO_ROOT)
+
+from tools.bftlint.callgraph import (  # noqa: E402
+    UNKNOWN,
+    build_program,
+    module_name_for,
+)
+from tools.bftlint.checkers import ALL_CHECKERS  # noqa: E402
+from tools.bftlint.checkers.wire_tag import (  # noqa: E402
+    WireTagChecker,
+    extract_messages,
+)
+from tools.bftlint.core import FileContext, lint_paths  # noqa: E402
+
+FIXTURES = os.path.join(os.path.dirname(__file__), "bftlint_fixtures")
+
+
+def _ctx(logical_path, source):
+    src = f"# bftlint: path={logical_path}\n" + textwrap.dedent(source)
+    return FileContext(logical_path, src)
+
+
+def _program(files):
+    """files: {logical_path: source} -> (Program, {path: ctx})."""
+    ctxs = {lp: _ctx(lp, src) for lp, src in files.items()}
+    return build_program(ctxs.values()), ctxs
+
+
+def _fn(program, logical_path, qualname):
+    mod = program.modules[module_name_for(logical_path)]
+    if "." in qualname:
+        cname, mname = qualname.split(".", 1)
+        return mod.classes[cname].methods[mname]
+    return mod.functions[qualname]
+
+
+def _calls_in(fi):
+    return [n for n in ast.walk(fi.node) if isinstance(n, ast.Call)]
+
+
+def _lint_file(path):
+    return lint_paths([path], ALL_CHECKERS).findings
+
+
+def _cli(*args):
+    return subprocess.run(
+        [sys.executable, "-m", "tools.bftlint", *args],
+        cwd=REPO_ROOT, capture_output=True, text=True, timeout=120)
+
+
+# ---------------------------------------------------------------------
+# resolution
+
+class TestResolution:
+    def test_module_function_bare_name(self):
+        prog, ctxs = _program({"cometbft_tpu/x/a.py": """
+            def helper():
+                pass
+
+            def caller():
+                helper()
+        """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        call = _calls_in(_fn(prog, "cometbft_tpu/x/a.py", "caller"))[0]
+        fi = prog.resolve_call(ctx, call)
+        assert fi is not None and fi.qualname == "helper"
+
+    def test_from_import_across_modules(self):
+        prog, ctxs = _program({
+            "cometbft_tpu/x/util.py": """
+                def shared():
+                    pass
+            """,
+            "cometbft_tpu/x/a.py": """
+                from cometbft_tpu.x.util import shared
+
+                def caller():
+                    shared()
+            """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        call = _calls_in(_fn(prog, "cometbft_tpu/x/a.py", "caller"))[0]
+        fi = prog.resolve_call(ctx, call)
+        assert fi is not None
+        assert fi.module == "cometbft_tpu.x.util"
+        assert fi.qualname == "shared"
+
+    def test_relative_import(self):
+        prog, ctxs = _program({
+            "cometbft_tpu/x/util.py": """
+                def shared():
+                    pass
+            """,
+            "cometbft_tpu/x/a.py": """
+                from .util import shared
+
+                def caller():
+                    shared()
+            """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        call = _calls_in(_fn(prog, "cometbft_tpu/x/a.py", "caller"))[0]
+        fi = prog.resolve_call(ctx, call)
+        assert fi is not None and fi.module == "cometbft_tpu.x.util"
+
+    def test_self_method_and_base_class(self):
+        prog, ctxs = _program({
+            "cometbft_tpu/x/base.py": """
+                class Base:
+                    def inherited(self):
+                        pass
+            """,
+            "cometbft_tpu/x/a.py": """
+                from cometbft_tpu.x.base import Base
+
+                class Impl(Base):
+                    def own(self):
+                        pass
+
+                    def caller(self):
+                        self.own()
+                        self.inherited()
+            """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        calls = _calls_in(_fn(prog, "cometbft_tpu/x/a.py",
+                              "Impl.caller"))
+        own = prog.resolve_call(ctx, calls[0])
+        inh = prog.resolve_call(ctx, calls[1])
+        assert own is not None and own.qualname == "Impl.own"
+        assert inh is not None and inh.qualname == "Base.inherited"
+        assert inh.module == "cometbft_tpu.x.base"
+
+    def test_unresolved_is_explicit_unknown(self):
+        prog, ctxs = _program({"cometbft_tpu/x/a.py": """
+            def caller(peer):
+                peer.transport.poke()
+        """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        call = _calls_in(_fn(prog, "cometbft_tpu/x/a.py", "caller"))[0]
+        assert prog.resolve_call(ctx, call) is None
+        s = prog.summary_for_call(ctx, call)
+        assert s is UNKNOWN
+        # the sound defaults every rule leans on
+        assert s.may_await and not s.may_block
+        assert not s.always_awaits and not s.spawns_directly
+
+    def test_inheritance_cycle_does_not_hang(self):
+        prog, ctxs = _program({"cometbft_tpu/x/a.py": """
+            class A(B):
+                def caller(self):
+                    self.nowhere()
+
+            class B(A):
+                pass
+        """})
+        ctx = ctxs["cometbft_tpu/x/a.py"]
+        call = _calls_in(_fn(prog, "cometbft_tpu/x/a.py",
+                             "A.caller"))[0]
+        assert prog.resolve_call(ctx, call) is None
+
+
+# ---------------------------------------------------------------------
+# effect summaries + fixed point
+
+class TestEffects:
+    def test_transitive_may_block_with_chain(self):
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            import time
+
+            def leaf():
+                time.sleep(1)
+
+            def mid():
+                leaf()
+
+            def top():
+                mid()
+        """})
+        top = _fn(prog, "cometbft_tpu/x/a.py", "top")
+        assert prog.summary(top).may_block
+        chain = " -> ".join(prog.blocking_chain(top))
+        assert "mid" in chain and "leaf" in chain
+        assert "time.sleep()" in chain
+
+    def test_suppressed_blocking_site_does_not_propagate(self):
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            import time
+
+            def leaf():
+                # bftlint: disable=blocking-in-async
+                time.sleep(1)
+
+            def top():
+                leaf()
+        """})
+        top = _fn(prog, "cometbft_tpu/x/a.py", "top")
+        assert not prog.summary(top).may_block
+
+    def test_mutual_await_cycle_converges_false(self):
+        """Two coroutines that only await each other never actually
+        suspend — the least fixed point must settle at False, not
+        hang or oscillate."""
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            async def ping():
+                await pong()
+
+            async def pong():
+                await ping()
+        """})
+        ping = _fn(prog, "cometbft_tpu/x/a.py", "ping")
+        s = prog.summary(ping)
+        assert not s.may_await and not s.always_awaits
+
+    def test_three_node_chain_with_real_await(self):
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            import asyncio
+
+            async def c():
+                await asyncio.sleep(0)
+
+            async def b():
+                await c()
+
+            async def a():
+                await b()
+        """})
+        for name in ("a", "b", "c"):
+            s = prog.summary(_fn(prog, "cometbft_tpu/x/a.py", name))
+            assert s.may_await and s.always_awaits, name
+
+    def test_conditional_await_is_may_not_always(self):
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            import asyncio
+
+            async def maybe(flag):
+                if flag:
+                    await asyncio.sleep(0)
+        """})
+        s = prog.summary(_fn(prog, "cometbft_tpu/x/a.py", "maybe"))
+        assert s.may_await and not s.always_awaits
+
+    def test_spawns_directly_not_transitive(self):
+        prog, _ = _program({"cometbft_tpu/x/a.py": """
+            import asyncio
+
+            def wrapper(coro):
+                return asyncio.create_task(coro)
+
+            def outer(coro):
+                return wrapper(coro)
+        """})
+        w = _fn(prog, "cometbft_tpu/x/a.py", "wrapper")
+        o = _fn(prog, "cometbft_tpu/x/a.py", "outer")
+        assert prog.summary(w).spawns_directly
+        # one-level-only by design: the summary records direct spawns
+        assert not prog.summary(o).spawns_directly
+
+
+# ---------------------------------------------------------------------
+# the upgraded rules' transitive fixtures
+
+_TRANSITIVE_BAD = {
+    "bad_blocking_transitive.py": "blocking-in-async",
+    "bad_await_helper.py": "await-atomicity",
+    "bad_spawn_wrapper.py": "supervised-spawn",
+    "bad_yield_helper.py": "yield-in-loop",
+}
+_TRANSITIVE_GOOD = (
+    "good_blocking_transitive.py",
+    "good_await_helper.py",
+    "good_spawn_wrapper.py",
+    "good_yield_helper.py",
+)
+
+
+@pytest.mark.parametrize("name,rule",
+                         sorted(_TRANSITIVE_BAD.items()))
+def test_transitive_bad_fixture_fires(name, rule):
+    findings = _lint_file(os.path.join(FIXTURES, name))
+    assert any(f.rule == rule for f in findings), \
+        f"{rule} missing on {name}: {findings}"
+
+
+def test_blocking_chain_in_finding_message():
+    findings = _lint_file(
+        os.path.join(FIXTURES, "bad_blocking_transitive.py"))
+    two_deep = [f for f in findings
+                if "_retry_with_backoff" in f.message]
+    assert two_deep, findings
+    msg = two_deep[0].message
+    # the full witness chain, hop by hop, down to the blocking call
+    assert "_backoff" in msg and "time.sleep()" in msg
+    assert "cometbft_tpu/consensus/fixture.py:8" in msg
+
+
+def test_wrapper_spawn_names_the_wrapper():
+    findings = _lint_file(
+        os.path.join(FIXTURES, "bad_spawn_wrapper.py"))
+    wrapper = [f for f in findings if "one level down" in f.message]
+    assert wrapper and "_spawn_bg" in wrapper[0].message
+
+
+@pytest.mark.parametrize("name", _TRANSITIVE_GOOD)
+def test_retired_false_positives_stay_clean(name):
+    """Regression pin: the shapes the interprocedural pass un-flags
+    (never-awaiting helper await before a store, supervisor-routed
+    wrapper, credited awaiting helper, suppressed durability point)
+    must stay clean."""
+    findings = _lint_file(os.path.join(FIXTURES, name))
+    assert not findings, f"{name} flagged: {findings}"
+
+
+def test_cli_exits_nonzero_on_each_transitive_bad_fixture():
+    for name in _TRANSITIVE_BAD:
+        rel = os.path.join("tests", "bftlint_fixtures", name)
+        proc = _cli("check", rel, "--no-baseline")
+        assert proc.returncode == 1, \
+            (f"check on {rel} exited {proc.returncode}:\n"
+             f"{proc.stdout}\n{proc.stderr}")
+
+
+def test_bare_filecontext_falls_back_intraprocedural():
+    """Checkers must keep working on a FileContext with no program
+    attached (ctx.program is None): the pre-ISSUE 20 behavior."""
+    path = os.path.join(FIXTURES, "bad_blocking_transitive.py")
+    with open(path, encoding="utf-8") as f:
+        ctx = FileContext(path, f.read())
+    assert ctx.program is None
+    for checker in ALL_CHECKERS:
+        if checker.in_scope(ctx.logical_path):
+            list(checker.check(ctx))    # must not raise
+    # and the direct-blocking fixture still fires without a program
+    bad = os.path.join(FIXTURES, "bad_blocking_in_async.py")
+    with open(bad, encoding="utf-8") as f:
+        bctx = FileContext(bad, f.read())
+    blocking = [c for c in ALL_CHECKERS
+                if c.rule == "blocking-in-async"][0]
+    assert any(f.rule == "blocking-in-async"
+               for f in blocking.check(bctx))
+
+
+# ---------------------------------------------------------------------
+# wire-tag
+
+class TestWireTag:
+    def _manifest_for(self, ctx, tmp_path):
+        per_path = {ctx.logical_path: extract_messages(ctx)}
+        from tools.bftlint.checkers.wire_tag import manifest_payload
+        p = tmp_path / "wire_manifest.json"
+        p.write_text(json.dumps(manifest_payload(per_path)))
+        return str(p)
+
+    def test_extraction_reads_tags_kinds_repeated(self):
+        ctx = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg(
+                "test.V",
+                F(1, "height", "int64"),
+                F(2, "sigs", "bytes", repeated=True),
+            )
+        """)
+        (decl,) = extract_messages(ctx)
+        assert decl.name == "test.V"
+        assert decl.fields == {1: "height int64",
+                               2: "sigs bytes repeated"}
+        assert not decl.duplicates and not decl.unreadable
+
+    def test_drift_changed_tag_flagged(self, tmp_path):
+        base = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+        """)
+        manifest = self._manifest_for(base, tmp_path)
+        drifted = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(2, "height", "int64"))
+        """)
+        findings = list(WireTagChecker(manifest).check(drifted))
+        assert findings and "drifted" in findings[0].message
+
+    def test_new_message_flagged_until_regenerated(self, tmp_path):
+        base = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+        """)
+        manifest = self._manifest_for(base, tmp_path)
+        grown = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+            W = Msg("test.W", F(1, "round", "int32"))
+        """)
+        findings = list(WireTagChecker(manifest).check(grown))
+        assert any("not in wire_manifest" in f.message
+                   for f in findings)
+
+    def test_deleted_message_flagged_as_drift(self, tmp_path):
+        base = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+            W = Msg("test.W", F(1, "round", "int32"))
+        """)
+        manifest = self._manifest_for(base, tmp_path)
+        shrunk = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+        """)
+        findings = list(WireTagChecker(manifest).check(shrunk))
+        assert any("no longer declared" in f.message
+                   for f in findings)
+
+    def test_fixture_paths_skip_drift(self, tmp_path):
+        """Non-cometbft_tpu paths get duplicate checking only — a
+        scratch descriptor must not demand a manifest entry."""
+        ctx = _ctx("tests/scratch.py", """
+            V = Msg("test.OnlyLocal", F(1, "x", "int64"))
+        """)
+        base = _ctx("cometbft_tpu/wire/fixture.py", """
+            V = Msg("test.V", F(1, "height", "int64"))
+        """)
+        manifest = self._manifest_for(base, tmp_path)
+        assert not list(WireTagChecker(manifest).check(ctx))
+
+    def test_committed_manifest_is_current(self, tmp_path):
+        """Regenerating into a scratch path must reproduce the
+        committed manifest byte-for-byte (modulo nothing): drift in
+        either direction means someone skipped the subcommand."""
+        out = tmp_path / "regen.json"
+        proc = _cli("wire-manifest",
+                    "--wire-manifest-path", str(out))
+        assert proc.returncode == 0, proc.stderr
+        committed = os.path.join(REPO_ROOT, "tools", "bftlint",
+                                 "wire_manifest.json")
+        with open(committed, encoding="utf-8") as f:
+            want = json.load(f)
+        assert json.loads(out.read_text()) == want
+
+    def test_regeneration_refuses_duplicates(self, tmp_path):
+        d = tmp_path / "pkg"
+        d.mkdir()
+        (d / "w.py").write_text(
+            'M = Msg("t.M", F(1, "a", "int64"), F(1, "b", "int64"))\n')
+        out = tmp_path / "m.json"
+        proc = _cli("wire-manifest", str(d),
+                    "--wire-manifest-path", str(out))
+        assert proc.returncode == 2
+        assert "duplicate field number" in proc.stderr
+        assert not out.exists()
+
+
+# ---------------------------------------------------------------------
+# check --diff
+
+class TestDiffMode:
+    def _git(self, root, *args):
+        return subprocess.run(
+            ["git", "-C", str(root), *args], check=True,
+            capture_output=True, text=True,
+            env={**os.environ,
+                 "GIT_AUTHOR_NAME": "t", "GIT_AUTHOR_EMAIL": "t@t",
+                 "GIT_COMMITTER_NAME": "t",
+                 "GIT_COMMITTER_EMAIL": "t@t"})
+
+    def test_diff_judges_only_changed_files(self, tmp_path):
+        """Two files with findings; only the one changed since the
+        ref is judged."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        swallow = ("def f():\n"
+                   "    try:\n"
+                   "        g()\n"
+                   "    except Exception:\n"
+                   "        pass\n")
+        (pkg / "changed.py").write_text("def f():\n    pass\n")
+        (pkg / "untouched.py").write_text(swallow)
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        # now introduce a finding in changed.py only
+        (pkg / "changed.py").write_text(swallow)
+        proc = _cli("check", str(pkg), "--no-baseline",
+                    "--diff", "HEAD", "--git-root", str(tmp_path),
+                    "--format", "json")
+        assert proc.returncode == 1, proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["files_scanned"] == 1
+        new = [f for f in report["findings"] if not f["baselined"]]
+        paths = {f["path"] for f in new}
+        assert paths and all(p.endswith("changed.py")
+                             for p in paths), paths
+        # untouched.py's identical finding was NOT judged
+        assert not any(p.endswith("untouched.py") for p in paths)
+
+    def test_diff_clean_when_no_changes(self, tmp_path):
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "a.py").write_text("def f():\n    pass\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        proc = _cli("check", str(pkg), "--no-baseline",
+                    "--diff", "HEAD", "--git-root", str(tmp_path))
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "no changed Python files" in proc.stdout
+
+    def test_diff_bad_ref_fails_loud(self):
+        proc = _cli("check", "--diff", "no-such-ref-xyz")
+        assert proc.returncode == 2
+        assert "failed" in proc.stderr
+
+    def test_diff_summaries_stay_whole_package(self, tmp_path):
+        """The corpus for summaries is the whole lint root even when
+        only one file is judged: a changed async caller of an
+        UNCHANGED blocking helper must still be flagged."""
+        pkg = tmp_path / "pkg"
+        pkg.mkdir()
+        (pkg / "helper.py").write_text(
+            "# bftlint: path=cometbft_tpu/consensus/h.py\n"
+            "import time\n\n"
+            "def pause():\n"
+            "    time.sleep(1)\n")
+        (pkg / "caller.py").write_text(
+            "# bftlint: path=cometbft_tpu/consensus/c.py\n"
+            "async def ok():\n"
+            "    pass\n")
+        self._git(tmp_path, "init", "-q")
+        self._git(tmp_path, "add", "-A")
+        self._git(tmp_path, "commit", "-qm", "seed")
+        (pkg / "caller.py").write_text(
+            "# bftlint: path=cometbft_tpu/consensus/c.py\n"
+            "from cometbft_tpu.consensus.h import pause\n\n"
+            "async def ok():\n"
+            "    pause()\n")
+        proc = _cli("check", str(pkg), "--no-baseline",
+                    "--diff", "HEAD", "--git-root", str(tmp_path),
+                    "--format", "json")
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        report = json.loads(proc.stdout)
+        assert report["files_scanned"] == 1
+        new = [f for f in report["findings"] if not f["baselined"]]
+        assert any(f["rule"] == "blocking-in-async"
+                   and "transitively" in f["message"]
+                   for f in new), new

@@ -1,0 +1,622 @@
+"""Whole-package call graph + fixed-point effect summaries.
+
+Every rule bftlint enforces is an *effect discipline* — verification
+stays off the one event loop (docs/pipeline.md), RoundState/PeerState
+mutations go through re-validating seams, background tasks are
+supervisor-owned — but until this module the checkers were strictly
+intra-procedural: a ``time.sleep()``, a sync batch ``verify()`` or a
+bare ``create_task`` moved one helper-call deep became invisible,
+which is exactly the refactoring pressure every perf PR applies (the
+ISSUE 14 off-loop seam, the ISSUE 12 gossip rewrite).  This module
+closes the helper blind spot with one pass over the shared
+``FileContext``s:
+
+  * a **call graph** resolving module-level functions, ``self.m()`` /
+    ``cls.m()`` within a class and its same-package bases, and
+    imported names (``from x import f``, ``import x.y as z``);
+    anything else — attribute chains through unknown objects,
+    stdlib/third-party calls, dynamic dispatch — resolves to the
+    explicit :data:`UNKNOWN` summary so each rule can choose its own
+    sound default instead of silently guessing;
+
+  * a **fixed-point effect engine** computing, per function:
+
+      - ``may_block``       transitively reaches a blocking call
+                            (``time.sleep``, sync sockets, ``open``,
+                            ...) — with the witness chain kept for
+                            the finding message;
+      - ``may_await``       executing the function may suspend: it
+                            has a real await point (an ``await`` whose
+                            operand is not a resolved never-awaiting
+                            call, an ``async for``/``async with``) or
+                            awaits a helper that may;
+      - ``always_awaits``   every path through the body provably
+                            reaches such an await (pessimistic /
+                            least fixed point: mutually-recursive
+                            helpers that only await each other never
+                            actually suspend, and converge to False);
+      - ``spawns_directly`` a bare ``create_task``/``ensure_future``
+                            in the body (supervised-spawn follows
+                            exactly one wrapper level, so this is
+                            deliberately not transitive);
+      - ``swallows_exception``  the body (or a resolved callee)
+                            contains a swallowed-exception site —
+                            informational for rule authors today.
+
+Soundness defaults for :data:`UNKNOWN` (unresolved calls): it *may*
+await (``await asyncio.sleep(...)`` keeps crediting yield-in-loop and
+keeps counting as an await-atomicity suspension — exactly the
+pre-interprocedural behavior), it does *not* definitely await, does
+*not* block (may_block=False: the linter only claims what it can
+prove, so unresolvable calls cannot flood consensus code with
+unfixable findings) and does not spawn or swallow.  Each consuming
+rule documents which direction it leans; see
+docs/static_analysis.md#interprocedural-analysis.
+
+Fixed-point convergence: all effect components are monotone booleans
+seeded at False, so iteration terminates on any call-graph cycle
+(tests/test_bftlint_callgraph.py pins two- and three-node cycles).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Iterable, Optional
+
+from .core import FileContext, call_name, walk_scope
+
+# ---------------------------------------------------------------------
+# blocking / spawning call tables.  These live here (not in the
+# checkers) because the summary engine and the blocking-in-async
+# checker must agree byte-for-byte on what "a blocking call" is —
+# two drifting copies would make the transitive findings lie.
+
+BLOCKING_CALLS = {
+    "time.sleep",
+    "socket.socket", "socket.create_connection",
+    "socket.getaddrinfo", "socket.gethostbyname",
+    "subprocess.run", "subprocess.call", "subprocess.check_call",
+    "subprocess.check_output", "subprocess.Popen",
+    "os.system", "os.popen",
+    "urllib.request.urlopen", "requests.get", "requests.post",
+    "open",
+}
+BLOCKING_TAILS = {"read_text", "read_bytes", "write_text",
+                  "write_bytes"}
+
+SPAWN_ATTRS = {"create_task", "ensure_future"}
+
+_MAX_BASE_DEPTH = 8
+
+
+def _is_blocking_call(node: ast.Call, name: str) -> bool:
+    tail = name.rsplit(".", 1)[-1]
+    if name in BLOCKING_CALLS:
+        return True
+    # attribute calls only: a bare local `read_text()` is not Path
+    # I/O, but any receiver counts (incl. chained Path(...) calls)
+    return tail in BLOCKING_TAILS and isinstance(node.func,
+                                                 ast.Attribute)
+
+
+def _is_spawn_call(node: ast.Call) -> bool:
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in SPAWN_ATTRS
+    return isinstance(fn, ast.Name) and fn.id in SPAWN_ATTRS
+
+
+# ---------------------------------------------------------------------
+# program model
+
+@dataclass
+class FunctionInfo:
+    """One module-level function or method in the package."""
+    module: str                     # dotted module name
+    qualname: str                   # Class.method or function name
+    node: ast.AST                   # FunctionDef | AsyncFunctionDef
+    ctx: FileContext
+    cls: Optional["ClassInfo"] = None
+
+    @property
+    def is_async(self) -> bool:
+        return isinstance(self.node, ast.AsyncFunctionDef)
+
+    def location(self) -> str:
+        return f"{self.ctx.logical_path}:{self.node.lineno}"
+
+    def __repr__(self) -> str:    # pragma: no cover - debugging aid
+        return f"<fn {self.module}:{self.qualname}>"
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str
+    methods: dict[str, FunctionInfo] = field(default_factory=dict)
+    base_names: list[str] = field(default_factory=list)  # as written
+
+
+@dataclass
+class ModuleInfo:
+    name: str
+    ctx: FileContext
+    functions: dict[str, FunctionInfo] = field(default_factory=dict)
+    classes: dict[str, ClassInfo] = field(default_factory=dict)
+    # local name -> ("mod", dotted) | ("obj", dotted_module, attr)
+    imports: dict[str, tuple] = field(default_factory=dict)
+
+
+@dataclass(frozen=True)
+class EffectSummary:
+    may_block: bool = False
+    may_await: bool = False
+    always_awaits: bool = False
+    spawns_directly: bool = False
+    swallows_exception: bool = False
+    unknown: bool = False           # the unresolved-call sentinel
+
+
+#: Summary for calls the graph cannot resolve.  may_await=True is the
+#: load-bearing default: ``await asyncio.sleep(...)`` (and every other
+#: stdlib await) must keep counting as a possible suspension point.
+UNKNOWN = EffectSummary(may_await=True, unknown=True)
+
+
+def module_name_for(logical_path: str) -> str:
+    """``cometbft_tpu/consensus/state.py`` ->
+    ``cometbft_tpu.consensus.state``; ``pkg/__init__.py`` -> ``pkg``."""
+    p = logical_path
+    if p.endswith(".py"):
+        p = p[:-3]
+    if p.endswith("/__init__"):
+        p = p[: -len("/__init__")]
+    return p.replace("/", ".")
+
+
+class _Effects:
+    """Mutable per-function effect state during the fixed point."""
+
+    __slots__ = ("may_block", "may_await", "always_awaits",
+                 "spawns_directly", "swallows_exception",
+                 "block_witness")
+
+    def __init__(self):
+        self.may_block = False
+        self.may_await = False
+        self.always_awaits = False
+        self.spawns_directly = False
+        self.swallows_exception = False
+        # ("direct", call_name, lineno) or ("via", callee_fi, lineno)
+        self.block_witness: Optional[tuple] = None
+
+
+class Program:
+    """The whole-package call graph + effect summaries, built once per
+    lint run by ``core.lint_paths`` and shared by every checker via
+    ``ctx.program``."""
+
+    def __init__(self, contexts: Iterable[FileContext]):
+        self.modules: dict[str, ModuleInfo] = {}
+        self._fn_of_node: dict[ast.AST, FunctionInfo] = {}
+        self._class_of_node: dict[ast.AST, ClassInfo] = {}
+        self._effects: dict[int, _Effects] = {}
+        self._summaries: dict[int, EffectSummary] = {}
+        for ctx in contexts:
+            self._index_module(ctx)
+        self._functions: list[FunctionInfo] = [
+            f for m in self.modules.values()
+            for f in list(m.functions.values())
+            + [mm for c in m.classes.values()
+               for mm in c.methods.values()]]
+        # resolved call edges per function: (callee, call node,
+        # awaited-at-call-site)
+        self._calls: dict[int, list[tuple[FunctionInfo, ast.Call,
+                                          bool]]] = {}
+        self._direct_pass()
+        self._fixed_point()
+
+    # -- indexing -----------------------------------------------------
+    def _index_module(self, ctx: FileContext) -> None:
+        mod = ModuleInfo(name=module_name_for(ctx.logical_path),
+                         ctx=ctx)
+        self.modules[mod.name] = mod
+        for node in ctx.tree.body:
+            if isinstance(node, (ast.FunctionDef,
+                                 ast.AsyncFunctionDef)):
+                fi = FunctionInfo(mod.name, node.name, node, ctx)
+                mod.functions[node.name] = fi
+                self._fn_of_node[node] = fi
+            elif isinstance(node, ast.ClassDef):
+                ci = ClassInfo(name=node.name, module=mod.name)
+                for b in node.bases:
+                    bn = _dotted(b)
+                    if bn:
+                        ci.base_names.append(bn)
+                for item in node.body:
+                    if isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                        fi = FunctionInfo(
+                            mod.name, f"{ci.name}.{item.name}",
+                            item, ctx, cls=ci)
+                        ci.methods[item.name] = fi
+                        self._fn_of_node[item] = fi
+                mod.classes[ci.name] = ci
+                self._class_of_node[node] = ci
+        self._index_imports(ctx, mod)
+
+    def _index_imports(self, ctx: FileContext,
+                       mod: ModuleInfo) -> None:
+        pkg_parts = mod.name.split(".")[:-1]
+        for node in ctx.nodes(ast.Import):
+            for alias in node.names:
+                local = alias.asname or alias.name.split(".")[0]
+                target = alias.name if alias.asname \
+                    else alias.name.split(".")[0]
+                mod.imports[local] = ("mod", target)
+        for node in ctx.nodes(ast.ImportFrom):
+            if node.level:
+                base = pkg_parts[: len(pkg_parts) - (node.level - 1)]
+                if node.level - 1 > len(pkg_parts):
+                    continue
+                src = ".".join(base + ([node.module]
+                                       if node.module else []))
+            else:
+                src = node.module or ""
+            if not src:
+                continue
+            for alias in node.names:
+                local = alias.asname or alias.name
+                if alias.name == "*":
+                    continue
+                mod.imports[local] = ("obj", src, alias.name)
+
+    # -- resolution ---------------------------------------------------
+    def resolve_call(self, ctx: FileContext,
+                     call: ast.Call) -> Optional[FunctionInfo]:
+        """Resolve a call node to a package function, or None.
+
+        Handles: bare names (module functions, ``from x import f``),
+        ``self.m()`` / ``cls.m()`` (the enclosing class, then its
+        same-package bases), and ``mod.f()`` through ``import``
+        aliases.  Everything else is *deliberately* unresolved —
+        rules get :data:`UNKNOWN` and apply their sound default."""
+        mod = self.modules.get(module_name_for(ctx.logical_path))
+        if mod is None:
+            return None
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            fi = mod.functions.get(fn.id)
+            if fi is not None:
+                return fi
+            imp = mod.imports.get(fn.id)
+            if imp and imp[0] == "obj":
+                target = self.modules.get(imp[1])
+                if target:
+                    return target.functions.get(imp[2])
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id in ("self", "cls"):
+            ci = self._enclosing_class(ctx, call)
+            if ci is None:
+                return None
+            return self._resolve_method(ci, fn.attr)
+        if isinstance(recv, ast.Name):
+            imp = mod.imports.get(recv.id)
+            if imp and imp[0] == "mod":
+                target = self.modules.get(imp[1])
+                if target:
+                    return target.functions.get(fn.attr)
+        return None
+
+    def _enclosing_class(self, ctx: FileContext,
+                         node: ast.AST) -> Optional[ClassInfo]:
+        for anc in ctx.ancestors(node):
+            if isinstance(anc, ast.ClassDef):
+                return self._class_of_node.get(anc)
+        return None
+
+    def _resolve_method(self, ci: ClassInfo, name: str,
+                        depth: int = 0,
+                        seen: Optional[set] = None
+                        ) -> Optional[FunctionInfo]:
+        if depth > _MAX_BASE_DEPTH:
+            return None
+        seen = seen if seen is not None else set()
+        key = (ci.module, ci.name)
+        if key in seen:
+            return None
+        seen.add(key)
+        fi = ci.methods.get(name)
+        if fi is not None:
+            return fi
+        mod = self.modules.get(ci.module)
+        if mod is None:
+            return None
+        for base_name in ci.base_names:
+            base = self._resolve_class(mod, base_name)
+            if base is None:
+                continue
+            fi = self._resolve_method(base, name, depth + 1, seen)
+            if fi is not None:
+                return fi
+        return None
+
+    def _resolve_class(self, mod: ModuleInfo,
+                       dotted: str) -> Optional[ClassInfo]:
+        head, _, tail = dotted.partition(".")
+        if not tail:
+            ci = mod.classes.get(head)
+            if ci is not None:
+                return ci
+            imp = mod.imports.get(head)
+            if imp and imp[0] == "obj":
+                target = self.modules.get(imp[1])
+                if target:
+                    return target.classes.get(imp[2])
+            return None
+        imp = mod.imports.get(head)
+        if imp and imp[0] == "mod" and "." not in tail:
+            target = self.modules.get(imp[1])
+            if target:
+                return target.classes.get(tail)
+        return None
+
+    # -- summaries ----------------------------------------------------
+    def summary(self, fi: FunctionInfo) -> EffectSummary:
+        s = self._summaries.get(id(fi))
+        if s is None:
+            e = self._effects.get(id(fi))
+            if e is None:
+                return UNKNOWN
+            s = EffectSummary(
+                may_block=e.may_block, may_await=e.may_await,
+                always_awaits=e.always_awaits,
+                spawns_directly=e.spawns_directly,
+                swallows_exception=e.swallows_exception)
+            self._summaries[id(fi)] = s
+        return s
+
+    def summary_for_call(self, ctx: FileContext,
+                         call: ast.Call) -> EffectSummary:
+        fi = self.resolve_call(ctx, call)
+        if fi is None:
+            return UNKNOWN
+        return self.summary(fi)
+
+    def blocking_chain(self, fi: FunctionInfo) -> list[str]:
+        """Human-readable witness chain from ``fi`` to the blocking
+        call it transitively reaches, for the finding message:
+        ``['_flush_wal (consensus/wal.py:88)', 'open()']``."""
+        chain: list[str] = []
+        seen: set[int] = set()
+        cur: Optional[FunctionInfo] = fi
+        while cur is not None and id(cur) not in seen:
+            seen.add(id(cur))
+            e = self._effects.get(id(cur))
+            if e is None or e.block_witness is None:
+                break
+            kind, payload, lineno = e.block_witness
+            if kind == "direct":
+                chain.append(f"{payload}() "
+                             f"[{cur.ctx.logical_path}:{lineno}]")
+                return chain
+            nxt: FunctionInfo = payload
+            chain.append(f"{nxt.qualname} ({nxt.location()})")
+            cur = nxt
+        chain.append("<cycle>")      # pragma: no cover - defensive
+        return chain
+
+    # -- effect computation -------------------------------------------
+    def _direct_pass(self) -> None:
+        swallow_fns = self._swallow_functions()
+        for fi in self._functions:
+            e = _Effects()
+            self._effects[id(fi)] = e
+            calls: list[tuple[FunctionInfo, ast.Call, bool]] = []
+            ctx = fi.ctx
+            for node in walk_scope(fi.node):
+                if isinstance(node, ast.Call):
+                    name = call_name(node)
+                    if _is_blocking_call(node, name) and \
+                            not ctx.suppressed(node.lineno,
+                                               "blocking-in-async"):
+                        e.may_block = True
+                        if e.block_witness is None:
+                            e.block_witness = ("direct", name,
+                                               node.lineno)
+                    if _is_spawn_call(node) and \
+                            not ctx.suppressed(node.lineno,
+                                               "supervised-spawn"):
+                        e.spawns_directly = True
+                    callee = self.resolve_call(ctx, node)
+                    if callee is not None:
+                        parent = ctx.parent(node)
+                        awaited = isinstance(parent, ast.Await) and \
+                            parent.value is node
+                        calls.append((callee, node, awaited))
+                elif isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+                    e.may_await = True
+                elif isinstance(node, ast.Await):
+                    # refined below: an await over a resolved
+                    # never-awaiting call is NOT a suspension; only
+                    # unresolvable operands count as direct awaits
+                    if not (isinstance(node.value, ast.Call) and
+                            self.resolve_call(ctx, node.value)
+                            is not None):
+                        e.may_await = True
+            if id(fi) in swallow_fns:
+                e.swallows_exception = True
+            self._calls[id(fi)] = calls
+
+    def _swallow_functions(self) -> set[int]:
+        """ids of FunctionInfos containing a swallowed-exception
+        finding (the checker is reused so the two never drift)."""
+        # lazy import: checkers import callgraph's tables, so a
+        # module-level import here would be circular
+        from .checkers.swallowed_exception import (
+            SwallowedExceptionChecker,
+        )
+        checker = SwallowedExceptionChecker()
+        out: set[int] = set()
+        by_ctx: dict[int, list[FunctionInfo]] = {}
+        for fi in self._functions:
+            by_ctx.setdefault(id(fi.ctx), []).append(fi)
+        done_ctx: set[int] = set()
+        for fi in self._functions:
+            if id(fi.ctx) in done_ctx:
+                continue
+            done_ctx.add(id(fi.ctx))
+            ctx = fi.ctx
+            try:
+                findings = list(checker.check(ctx))
+            except Exception:       # pragma: no cover - defensive
+                continue
+            for f in findings:
+                if ctx.suppressed(f.line, f.rule):
+                    continue
+                for cand in by_ctx.get(id(ctx), ()):
+                    end = getattr(cand.node, "end_lineno",
+                                  cand.node.lineno)
+                    if cand.node.lineno <= f.line <= end:
+                        out.add(id(cand))
+        return out
+
+    def _fixed_point(self) -> None:
+        # all components are monotone booleans seeded False, so naive
+        # iteration converges (and cycles cannot oscillate)
+        changed = True
+        while changed:
+            changed = False
+            for fi in self._functions:
+                e = self._effects[id(fi)]
+                for callee, node, awaited in self._calls[id(fi)]:
+                    ce = self._effects.get(id(callee))
+                    if ce is None:
+                        continue
+                    # any call into a blocking helper blocks the
+                    # caller — awaited or not (awaiting an async
+                    # helper runs its body on this very loop)
+                    if ce.may_block and not e.may_block:
+                        e.may_block = True
+                        e.block_witness = ("via", callee,
+                                           node.lineno)
+                        changed = True
+                    if awaited and ce.may_await and not e.may_await:
+                        e.may_await = True
+                        changed = True
+                    if ce.swallows_exception and \
+                            not e.swallows_exception:
+                        e.swallows_exception = True
+                        changed = True
+            # always_awaits consumes may_await fixpoint results and is
+            # itself monotone, so it gets its own inner iteration
+            aw_changed = True
+            while aw_changed:
+                aw_changed = False
+                for fi in self._functions:
+                    e = self._effects[id(fi)]
+                    if e.always_awaits or not fi.is_async:
+                        continue
+                    body = getattr(fi.node, "body", [])
+                    if self._stmts_definitely_await(fi.ctx, body):
+                        e.always_awaits = True
+                        e.may_await = True
+                        aw_changed = True
+                        changed = True
+        self._summaries.clear()
+
+    # -- always-awaits walker -----------------------------------------
+    def _await_is_definite(self, ctx: FileContext,
+                           aw: ast.Await) -> bool:
+        v = aw.value
+        if isinstance(v, ast.Call):
+            fi = self.resolve_call(ctx, v)
+            if fi is not None:
+                return self._effects[id(fi)].always_awaits
+        # unresolved operand (asyncio.sleep, a future, gather...):
+        # treated as a definite suspension — the pragmatic default
+        # that keeps `await asyncio.sleep(0)` a credited yield
+        return True
+
+    def _expr_definitely_awaits(self, ctx: FileContext,
+                                expr: Optional[ast.AST]) -> bool:
+        if expr is None:
+            return False
+        for node in walk_scope(expr):
+            if isinstance(node, ast.Await) and \
+                    self._await_is_definite(ctx, node):
+                return True
+        return False
+
+    def _stmts_definitely_await(self, ctx: FileContext,
+                                stmts: list) -> bool:
+        """True when every path through ``stmts`` reaches a definite
+        await.  Conservative: any possible early exit (return/raise/
+        break/continue) before a proven await yields False."""
+        for stmt in stmts:
+            if isinstance(stmt, (ast.Return, ast.Raise)):
+                v = stmt.value if isinstance(stmt, ast.Return) \
+                    else stmt.exc
+                return self._expr_definitely_awaits(ctx, v)
+            if isinstance(stmt, (ast.Break, ast.Continue)):
+                return False
+            if self._stmt_definitely_awaits(ctx, stmt):
+                return True
+            if self._has_possible_exit(stmt):
+                return False
+        return False
+
+    def _stmt_definitely_awaits(self, ctx: FileContext,
+                                stmt: ast.AST) -> bool:
+        if isinstance(stmt, (ast.AsyncFor, ast.AsyncWith)):
+            return True
+        if isinstance(stmt, ast.If):
+            if self._expr_definitely_awaits(ctx, stmt.test):
+                return True
+            return bool(stmt.orelse) and \
+                self._stmts_definitely_await(ctx, stmt.body) and \
+                self._stmts_definitely_await(ctx, stmt.orelse)
+        if isinstance(stmt, ast.While):
+            # the test evaluates at least once
+            return self._expr_definitely_awaits(ctx, stmt.test)
+        if isinstance(stmt, ast.With):
+            return self._stmts_definitely_await(ctx, stmt.body)
+        if isinstance(stmt, ast.Try):
+            return self._stmts_definitely_await(ctx, stmt.body)
+        if isinstance(stmt, (ast.Expr, ast.Assign, ast.AugAssign,
+                             ast.AnnAssign)):
+            return self._expr_definitely_awaits(
+                ctx, getattr(stmt, "value", None))
+        return False
+
+    @staticmethod
+    def _has_possible_exit(stmt: ast.AST) -> bool:
+        for node in walk_scope(stmt):
+            if isinstance(node, (ast.Return, ast.Raise, ast.Break,
+                                 ast.Continue)):
+                return True
+        return False
+
+
+def _dotted(node: ast.AST) -> Optional[str]:
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def build_program(contexts: Iterable[FileContext]) -> Program:
+    return Program(contexts)
+
+
+__all__ = ["Program", "FunctionInfo", "EffectSummary", "UNKNOWN",
+           "build_program", "module_name_for",
+           "BLOCKING_CALLS", "BLOCKING_TAILS", "SPAWN_ATTRS"]

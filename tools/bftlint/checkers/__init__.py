@@ -8,6 +8,7 @@ from .monotonic_clock import MonotonicClockChecker
 from .supervised_spawn import SupervisedSpawnChecker
 from .swallowed_exception import SwallowedExceptionChecker
 from .unbounded_label import UnboundedLabelChecker
+from .wire_tag import WireTagChecker
 from .yield_in_loop import YieldInLoopChecker
 
 ALL_CHECKERS = (
@@ -19,6 +20,7 @@ ALL_CHECKERS = (
     BlockingInAsyncChecker(),
     UnboundedLabelChecker(),
     CwdWriteChecker(),
+    WireTagChecker(),
 )
 
 __all__ = ["ALL_CHECKERS"]

@@ -45,6 +45,16 @@ self.rs.votes``) is not chased — it bounds false positives, not
 false negatives.  Findings are triaged like any other rule:
 restructure onto the seam, re-validate, or baseline with a
 justification explaining why the interleaving is benign.
+
+ISSUE 20 extension — interprocedural await points: what counts as a
+suspension is now judged through the package effect summaries
+(callgraph.py).  ``await self._helper()`` where ``_helper`` provably
+never awaits is *not* a suspension — no other task can run there, so
+a store after it needs no re-validation (a false-positive class the
+textual rule had).  An await through a may-awaiting helper remains a
+straddle point exactly as before, so extracting the suspension into a
+helper cannot hide a seam-bypassing store.  Sound default: unresolved
+operands keep their await-point status (``may_await=True``).
 """
 from __future__ import annotations
 
@@ -171,6 +181,14 @@ class AwaitAtomicityChecker(Checker):
         for fn in ctx.nodes(ast.AsyncFunctionDef):
             yield from self._check_fn(ctx, fn)
 
+    @staticmethod
+    def _is_await_point(ctx: FileContext, aw: ast.Await) -> bool:
+        """Summary-aware suspension test: an await over a helper that
+        provably never awaits cannot interleave another task."""
+        if ctx.program is None or not isinstance(aw.value, ast.Call):
+            return True
+        return ctx.program.summary_for_call(ctx, aw.value).may_await
+
     def _check_fn(self, ctx: FileContext,
                   fn: ast.AsyncFunctionDef) -> Iterator[Finding]:
         aliases = _collect_aliases(fn)
@@ -183,7 +201,8 @@ class AwaitAtomicityChecker(Checker):
         # analyzed separately via ctx.nodes)
         for node in walk_scope(fn):
             if isinstance(node, ast.Await):
-                awaits.append(_pos(node))
+                if self._is_await_point(ctx, node):
+                    awaits.append(_pos(node))
             elif isinstance(node, ast.Call) and \
                     isinstance(node.func, ast.Attribute) and \
                     node.func.attr in _TRANSITION_GUARDS:

@@ -25,26 +25,27 @@ device future.  Inside consensus/reactor async scopes the rule flags
 the off-loop seam (``verify_async()`` /
 ``preverify_signatures_async()`` + the verification staging worker,
 crypto/pipeline.py) is the replacement.
+
+ISSUE 20 extension — interprocedural: a ``time.sleep()`` moved one
+helper-call deep used to be invisible.  With the whole-package effect
+summaries (callgraph.py), a call in a scoped ``async def`` to a
+resolved helper whose ``may_block`` summary is true is flagged at the
+call site, with the full witness chain in the message (``helper →
+sub_helper → open() [path:line]``).  Sound default: unresolved calls
+carry ``may_block=False`` — the rule only claims blocking it can
+prove, so stdlib/dynamic dispatch cannot flood async code with
+unfixable findings.  The sync-verify receiver heuristic above stays
+intra-procedural on purpose: it keys on receiver *names*, which do
+not survive the hop into a helper's parameter list.
 """
 from __future__ import annotations
 
 import ast
 from typing import Iterator
 
+from ..callgraph import BLOCKING_CALLS as _BLOCKING_CALLS
+from ..callgraph import BLOCKING_TAILS as _BLOCKING_TAILS
 from ..core import Checker, FileContext, Finding, call_name
-
-_BLOCKING_CALLS = {
-    "time.sleep",
-    "socket.socket", "socket.create_connection",
-    "socket.getaddrinfo", "socket.gethostbyname",
-    "subprocess.run", "subprocess.call", "subprocess.check_call",
-    "subprocess.check_output", "subprocess.Popen",
-    "os.system", "os.popen",
-    "urllib.request.urlopen", "requests.get", "requests.post",
-    "open",
-}
-_BLOCKING_TAILS = {"read_text", "read_bytes", "write_text",
-                   "write_bytes"}
 
 # synchronous verification inside an async scope: the receiver names
 # that identify a batch verifier (narrow on purpose — `proof.verify()`
@@ -117,6 +118,23 @@ class BlockingInAsyncChecker(Checker):
                     f"off-loop seam instead (verify_async() / "
                     f"preverify_signatures_async(), "
                     f"crypto/pipeline.py)")
+            elif ctx.program is not None:
+                callee = ctx.program.resolve_call(ctx, node)
+                if callee is None:
+                    continue
+                if ctx.program.summary(callee).may_block:
+                    chain = " -> ".join(
+                        ctx.program.blocking_chain(callee))
+                    yield ctx.finding(
+                        self.rule, node,
+                        f"{name}() transitively blocks the event "
+                        f"loop inside an async def via "
+                        f"{callee.qualname} -> {chain}; move the "
+                        f"blocking call off-loop (asyncio.sleep, "
+                        f"run_in_executor, to_thread, the "
+                        f"verification staging worker) or justify "
+                        f"the synchronous durability point at the "
+                        f"blocking site")
 
 
 __all__ = ["BlockingInAsyncChecker"]

@@ -13,6 +13,18 @@ manages its own task lifecycle with in-loop error handling
 (p2p/conn.py MConnection, abci/client.py SocketClient, libs/service)
 is deliberately out of scope — those are transports, not reactor/node
 loops.
+
+ISSUE 20 extension — one level of wrappers: ``def _start(self):
+asyncio.create_task(...)`` called from reactor code used to hide the
+bare spawn if the wrapper lived outside the scoped files.  Calls in
+scoped files that resolve (callgraph.py) to a function whose
+``spawns_directly`` summary is true are now flagged at the call site,
+naming the wrapper.  One level only, by design: the summary records
+*direct* spawns, not transitive ones — deep spawn plumbing should be
+the supervisor, not a wrapper chain.  ``self.supervisor.spawn(...)``
+stays clean (an attribute-of-attribute receiver never resolves), and
+spawns inline-suppressed at their own site do not propagate into the
+wrapper's summary.
 """
 from __future__ import annotations
 
@@ -51,6 +63,22 @@ class SupervisedSpawnChecker(Checker):
             elif isinstance(fn, ast.Name) and fn.id in _SPAWN_ATTRS:
                 name = fn.id
             if not name:
+                if ctx.program is not None:
+                    callee = ctx.program.resolve_call(ctx, node)
+                    if callee is not None and \
+                            ctx.program.summary(callee) \
+                               .spawns_directly and \
+                            (ctx.logical_path, node.lineno) \
+                            not in ALLOWLIST:
+                        yield ctx.finding(
+                            self.rule, node,
+                            f"call spawns an unsupervised task one "
+                            f"level down via {callee.qualname} "
+                            f"({callee.location()}) — route the "
+                            f"spawn through "
+                            f"self.supervisor.spawn(...) so crashes "
+                            f"restart (bounded) instead of dying "
+                            f"silently")
                 continue
             if (ctx.logical_path, node.lineno) in ALLOWLIST:
                 continue

@@ -1,0 +1,255 @@
+"""wire-tag: the proto field-tag tables are a consensus-critical
+contract — pin them.
+
+Every ``Msg("pkg.Name", F(num, "field", "kind"), ...)`` descriptor
+(wire/pb.py and the reactor message arms) defines wire bytes other
+nodes parse; a silently-changed field number or kind is a network
+fork, and a duplicate tag within one message makes decode
+order-dependent.  The aggregate-commit 0xff marker (wire/pb.py) is
+one hand-rolled byte away from exactly that class of collision — the
+runtime ``Msg.__init__`` duplicate check only fires when the
+descriptor is *constructed*, which for rarely-imported arms may be
+never in CI.
+
+Statically extracted, per message, from the AST (no imports, no
+construction): field number -> (name, kind, repeated).  Findings:
+
+  * duplicate-tag — two ``F``s in one ``Msg`` share a field number
+    (flagged everywhere, fixtures included);
+  * manifest drift — for files under ``cometbft_tpu/``, the extracted
+    tables must match ``tools/bftlint/wire_manifest.json`` exactly:
+    changed/added/removed fields, new messages, and messages deleted
+    from a manifest-tracked file are all findings.  Intentional wire
+    changes are committed via the regeneration subcommand::
+
+        python -m tools.bftlint wire-manifest
+
+    mirroring ``baseline`` — the diff of wire_manifest.json *is* the
+    wire-compat review artifact.
+
+Extraction is best-effort on purpose: only ``F(<int const>,
+<str const>, <str const>, ...)`` positional shapes are read (the only
+shape the tree uses); a computed field number extracts as unknown and
+is reported, since an unreadable tag table cannot be pinned.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+from dataclasses import dataclass, field
+from typing import Iterator, Optional
+
+from ..core import Checker, FileContext, Finding
+
+_DEFAULT_MANIFEST = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "wire_manifest.json")
+
+MANIFEST_VERSION = 1
+
+
+@dataclass
+class MsgDecl:
+    """One statically-extracted ``Msg(...)`` descriptor."""
+    name: str
+    node: ast.Call
+    # field number -> "name kind" or "name kind repeated"
+    fields: dict[int, str] = field(default_factory=dict)
+    duplicates: list[tuple[int, ast.Call]] = field(default_factory=list)
+    unreadable: list[ast.Call] = field(default_factory=list)
+
+
+def _callee_name(node: ast.Call) -> str:
+    fn = node.func
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return ""
+
+
+def _field_sig(f_call: ast.Call) -> Optional[tuple[int, str]]:
+    """``F(1, "seconds", "int64", repeated=True)`` ->
+    ``(1, "seconds int64 repeated")``; None when the shape is not the
+    constant-positional idiom."""
+    args = f_call.args
+    if len(args) < 3:
+        return None
+    num, name, kind = args[0], args[1], args[2]
+    if not (isinstance(num, ast.Constant) and
+            isinstance(num.value, int) and
+            not isinstance(num.value, bool)):
+        return None
+    if not (isinstance(name, ast.Constant) and
+            isinstance(name.value, str)):
+        return None
+    if not (isinstance(kind, ast.Constant) and
+            isinstance(kind.value, str)):
+        return None
+    sig = f"{name.value} {kind.value}"
+    for kw in f_call.keywords:
+        if kw.arg == "repeated" and \
+                isinstance(kw.value, ast.Constant) and \
+                kw.value.value is True:
+            sig += " repeated"
+    return num.value, sig
+
+
+def extract_messages(ctx: FileContext) -> list[MsgDecl]:
+    """All ``Msg(...)`` descriptor declarations in the file, in source
+    order.  Shared by the checker and the ``wire-manifest``
+    regeneration subcommand so they can never disagree."""
+    decls: list[MsgDecl] = []
+    for node in ctx.nodes(ast.Call):
+        if _callee_name(node) != "Msg" or not node.args:
+            continue
+        head = node.args[0]
+        if not (isinstance(head, ast.Constant) and
+                isinstance(head.value, str)):
+            continue
+        decl = MsgDecl(name=head.value, node=node)
+        for arg in node.args[1:]:
+            if isinstance(arg, ast.Starred):
+                # *fields splat: contents invisible statically
+                decl.unreadable.append(node)
+                continue
+            if not (isinstance(arg, ast.Call) and
+                    _callee_name(arg) == "F"):
+                continue
+            sig = _field_sig(arg)
+            if sig is None:
+                decl.unreadable.append(arg)
+                continue
+            num, fsig = sig
+            if num in decl.fields:
+                decl.duplicates.append((num, arg))
+            else:
+                decl.fields[num] = fsig
+        decls.append(decl)
+    return decls
+
+
+def load_manifest(path: str = _DEFAULT_MANIFEST) -> dict:
+    """The committed manifest: {} when absent (drift checking is then
+    skipped — the rule degrades to duplicate-tag only)."""
+    if not os.path.exists(path):
+        return {}
+    with open(path, encoding="utf-8") as f:
+        data = json.load(f)
+    if not isinstance(data, dict) or \
+            data.get("version") != MANIFEST_VERSION or \
+            not isinstance(data.get("messages"), dict):
+        raise ValueError(
+            f"{path}: not a v{MANIFEST_VERSION} wire manifest")
+    return data["messages"]
+
+
+def manifest_payload(per_path: dict[str, list[MsgDecl]]) -> dict:
+    """Serializable manifest from extracted declarations, keyed by
+    message name; deterministic ordering so the committed file diffs
+    cleanly."""
+    messages: dict[str, dict] = {}
+    for path in sorted(per_path):
+        for decl in per_path[path]:
+            messages[decl.name] = {
+                "path": path,
+                "fields": {str(n): decl.fields[n]
+                           for n in sorted(decl.fields)},
+            }
+    return {"version": MANIFEST_VERSION,
+            "messages": dict(sorted(messages.items()))}
+
+
+class WireTagChecker(Checker):
+    rule = "wire-tag"
+    description = ("proto field-tag table drift or duplicate field "
+                   "number in a Msg descriptor (wire-compat contract; "
+                   "regenerate with the wire-manifest subcommand)")
+    # no scope: descriptors anywhere are checked for duplicates;
+    # manifest drift is enforced only under cometbft_tpu/ (fixtures
+    # and scratch files must not demand manifest entries)
+    _DRIFT_PREFIX = "cometbft_tpu/"
+
+    def __init__(self, manifest_path: str = _DEFAULT_MANIFEST):
+        self._manifest_path = manifest_path
+        self._manifest: Optional[dict] = None
+
+    def _load(self) -> dict:
+        if self._manifest is None:
+            self._manifest = load_manifest(self._manifest_path)
+        return self._manifest
+
+    def check(self, ctx: FileContext) -> Iterator[Finding]:
+        decls = extract_messages(ctx)
+        if not decls:
+            return
+        for decl in decls:
+            for num, f_call in decl.duplicates:
+                yield ctx.finding(
+                    self.rule, f_call,
+                    f"duplicate field number {num} in "
+                    f"{decl.name} — two fields share one wire tag, "
+                    f"so decode order silently picks a winner; "
+                    f"renumber (Msg.__init__ would also raise, but "
+                    f"only if this descriptor is ever constructed)")
+            for bad in decl.unreadable:
+                yield ctx.finding(
+                    self.rule, bad,
+                    f"field of {decl.name} is not the "
+                    f"F(<int>, <name>, <kind>) constant shape — the "
+                    f"wire tag table cannot be statically pinned; "
+                    f"use literal field numbers/kinds")
+        if not ctx.logical_path.startswith(self._DRIFT_PREFIX):
+            return
+        manifest = self._load()
+        if not manifest:
+            return
+        seen_here: set[str] = set()
+        for decl in decls:
+            seen_here.add(decl.name)
+            entry = manifest.get(decl.name)
+            if entry is None:
+                yield ctx.finding(
+                    self.rule, decl.node,
+                    f"{decl.name} is not in wire_manifest.json — a "
+                    f"new wire message is a wire-compat change; "
+                    f"review it, then run `python -m tools.bftlint "
+                    f"wire-manifest` to commit the table")
+                continue
+            want = {int(k): v for k, v in entry["fields"].items()}
+            if want == decl.fields:
+                continue
+            details = []
+            for num in sorted(set(want) | set(decl.fields)):
+                a, b = want.get(num), decl.fields.get(num)
+                if a == b:
+                    continue
+                details.append(
+                    f"field {num}: manifest={a or 'absent'} "
+                    f"code={b or 'absent'}")
+            yield ctx.finding(
+                self.rule, decl.node,
+                f"{decl.name} drifted from wire_manifest.json "
+                f"({'; '.join(details)}) — changed tags/kinds break "
+                f"wire compat with every peer; revert, or review and "
+                f"regenerate via `python -m tools.bftlint "
+                f"wire-manifest`")
+        # messages the manifest pins to THIS file but which no longer
+        # exist here: a deleted/renamed wire message is drift too
+        for name, entry in manifest.items():
+            if entry.get("path") == ctx.logical_path and \
+                    name not in seen_here:
+                # ast.Module has no position: anchor on the first
+                # statement (a file with decls always has one)
+                yield ctx.finding(
+                    self.rule, ctx.tree.body[0],
+                    f"{name} is pinned to this file by "
+                    f"wire_manifest.json but is no longer declared — "
+                    f"deleting/renaming a wire message breaks peers "
+                    f"still sending it; review and regenerate via "
+                    f"`python -m tools.bftlint wire-manifest`")
+
+
+__all__ = ["WireTagChecker", "extract_messages", "load_manifest",
+           "manifest_payload", "MsgDecl", "MANIFEST_VERSION"]

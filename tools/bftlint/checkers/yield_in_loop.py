@@ -16,6 +16,18 @@ collects the subtrees of all statements that lexically precede the
 ``async for`` / ``async with``.  If no suspension point can possibly
 execute before the ``continue``, one starved branch becomes a busy
 loop — flagged.
+
+ISSUE 20 extension — interprocedural await credit: ``await expr``
+only suspends if the awaited coroutine itself reaches a suspension
+point, so ``await self._helper()`` where ``_helper`` *never* awaits
+is a busy-spin in disguise (a false-negative class this rule used to
+miss).  With the package effect summaries (callgraph.py) an ``await``
+over a resolved call is credited iff the callee's ``may_await``
+summary is true — awaits inside always/may-awaiting helpers keep
+their credit (the false-positive class a naive "only literal awaits
+count" upgrade would have introduced), never-awaiting ones lose it.
+Sound default: unresolved operands (``asyncio.sleep``, futures,
+``gather``) stay credited, exactly the pre-interprocedural behavior.
 """
 from __future__ import annotations
 
@@ -29,7 +41,15 @@ def _is_const_true(test: ast.expr) -> bool:
     return isinstance(test, ast.Constant) and bool(test.value) is True
 
 
-def _has_suspension(nodes) -> bool:
+def _await_credited(ctx: FileContext, aw: ast.Await) -> bool:
+    """An await is a suspension unless its operand resolves to a
+    package helper that provably never awaits."""
+    if ctx.program is None or not isinstance(aw.value, ast.Call):
+        return True
+    return ctx.program.summary_for_call(ctx, aw.value).may_await
+
+
+def _has_suspension(ctx: FileContext, nodes) -> bool:
     # an await inside a nested def/lambda defined before the continue
     # never ran on this path — it is not a suspension
     for root in nodes:
@@ -37,8 +57,10 @@ def _has_suspension(nodes) -> bool:
                              ast.Lambda)):
             continue
         for node in walk_scope(root):
-            if isinstance(node, (ast.Await, ast.AsyncFor,
-                                 ast.AsyncWith)):
+            if isinstance(node, (ast.AsyncFor, ast.AsyncWith)):
+                return True
+            if isinstance(node, ast.Await) and \
+                    _await_credited(ctx, node):
                 return True
     return False
 
@@ -99,7 +121,7 @@ class YieldInLoopChecker(Checker):
                             parent is not loop:
                         before.append(parent.test)
                     node = parent
-                if not _has_suspension(before):
+                if not _has_suspension(ctx, before):
                     yield ctx.finding(
                         self.rule, cont,
                         "this continue can be reached without any "

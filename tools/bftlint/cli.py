@@ -8,16 +8,28 @@ current floor).
              tests/test_bftlint.py runs this)
   baseline   rewrite bftlint_baseline.json from the current findings,
              preserving existing justifications
+  wire-manifest
+             regenerate tools/bftlint/wire_manifest.json from the
+             statically-extracted Msg descriptors (the wire-tag
+             rule's pinned contract; commit the diff as the
+             wire-compat review)
+
+``check --diff <git-ref>`` judges only files changed since the ref
+(fast pre-commit); the call graph is still built over the whole
+package so interprocedural summaries stay sound.  Untracked files
+are not part of a git diff — lint them by path, or after ``git add``.
 """
 from __future__ import annotations
 
 import argparse
 import os
+import subprocess
 import sys
 
 from . import baseline as baseline_mod
 from .checkers import ALL_CHECKERS
-from .core import lint_paths
+from .checkers import wire_tag as wire_tag_mod
+from .core import FileContext, iter_python_files, lint_paths
 from .reporters import json_report, text_report
 
 _REPO_ROOT = os.path.dirname(
@@ -58,17 +70,94 @@ class _ExaminedPaths:
             fpath.startswith(self._dir_prefixes)
 
 
+def _write_wire_manifest(paths, manifest_path: str) -> int:
+    """Regenerate the wire-tag manifest from the statically-extracted
+    descriptors.  Refuses on duplicate tags, unreadable field shapes,
+    or a message name declared twice — a manifest written past any of
+    those would pin a broken or ambiguous contract."""
+    per_path: dict[str, list] = {}
+    owners: dict[str, str] = {}
+    errors: list[str] = []
+    for path in iter_python_files(paths):
+        try:
+            with open(path, encoding="utf-8") as f:
+                ctx = FileContext(path, f.read())
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        decls = wire_tag_mod.extract_messages(ctx)
+        if not decls:
+            continue
+        per_path[ctx.logical_path] = decls
+        for decl in decls:
+            loc = f"{ctx.logical_path}:{decl.node.lineno}"
+            for num, _ in decl.duplicates:
+                errors.append(f"{loc}: duplicate field number {num} "
+                              f"in {decl.name}")
+            if decl.unreadable:
+                errors.append(f"{loc}: {decl.name} has fields not in "
+                              f"the F(<int>, <name>, <kind>) constant "
+                              f"shape")
+            if decl.name in owners:
+                errors.append(f"{loc}: {decl.name} already declared "
+                              f"in {owners[decl.name]}")
+            owners[decl.name] = ctx.logical_path
+    if errors:
+        for err in errors:
+            print(f"wire-manifest: {err}", file=sys.stderr)
+        print("refusing to write the manifest — fix the descriptors "
+              "and rerun", file=sys.stderr)
+        return 2
+    payload = wire_tag_mod.manifest_payload(per_path)
+    import json
+    with open(manifest_path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=2, sort_keys=False)
+        f.write("\n")
+    print(f"wire manifest written: {manifest_path} "
+          f"({len(payload['messages'])} messages from "
+          f"{len(per_path)} files)")
+    return 0
+
+
+def _changed_since(ref: str, git_root: str) -> "list[str] | None":
+    """Repo-relative .py paths changed since ``ref`` (worktree
+    included); None when git fails."""
+    try:
+        out = subprocess.run(
+            ["git", "-C", git_root, "diff", "--name-only", "-z",
+             ref, "--"],
+            check=True, capture_output=True, text=True)
+    except (OSError, subprocess.CalledProcessError) as e:
+        msg = getattr(e, "stderr", "") or str(e)
+        print(f"git diff {ref} failed: {msg.strip()}",
+              file=sys.stderr)
+        return None
+    return [p for p in out.stdout.split("\0") if p.endswith(".py")]
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m tools.bftlint",
         description=__doc__.splitlines()[0])
-    ap.add_argument("mode", choices=("run", "check", "baseline"),
+    ap.add_argument("mode",
+                    choices=("run", "check", "baseline",
+                             "wire-manifest"),
                     nargs="?", default="run")
     ap.add_argument("paths", nargs="*",
                     help="files/dirs to lint (default: cometbft_tpu/)")
     ap.add_argument("--rules", default="",
                     help="comma-separated rule subset")
+    ap.add_argument("--diff", default=None, metavar="GIT_REF",
+                    help="judge only .py files changed since GIT_REF "
+                         "(under the lint roots); the summary corpus "
+                         "stays whole-package")
     ap.add_argument("--baseline", default=DEFAULT_BASELINE)
+    ap.add_argument("--wire-manifest-path",
+                    default=wire_tag_mod._DEFAULT_MANIFEST,
+                    help=argparse.SUPPRESS)
+    # --diff's git repo (tests point it at a scratch repo)
+    ap.add_argument("--git-root", default=_REPO_ROOT,
+                    help=argparse.SUPPRESS)
     ap.add_argument("--no-baseline", action="store_true",
                     help="ignore the baseline file (fixture tests)")
     ap.add_argument("--format", choices=("text", "json"),
@@ -105,7 +194,49 @@ def main(argv=None) -> int:
         return 2
 
     paths = args.paths or list(DEFAULT_PATHS)
-    result = lint_paths(paths, ALL_CHECKERS, rules=rules)
+
+    if args.mode == "wire-manifest":
+        return _write_wire_manifest(paths, args.wire_manifest_path)
+
+    program_paths = None
+    if args.diff is not None:
+        if args.mode == "baseline":
+            print("--diff cannot rewrite the baseline — a diff "
+                  "subset would drop every out-of-diff entry; use "
+                  "explicit paths", file=sys.stderr)
+            return 2
+        changed = _changed_since(args.diff, args.git_root)
+        if changed is None:
+            return 2
+        # keep only changed files under the lint roots: everything
+        # else (tools/, tests/) is never baseline-covered and would
+        # fail check spuriously
+        root_files: set[str] = set()
+        root_prefixes: list[str] = []
+        for p in paths:
+            lp = _logical(p)
+            if os.path.isdir(p):
+                root_prefixes.append("" if lp == "." else lp + "/")
+            else:
+                root_files.add(lp)
+        judged = []
+        for c in changed:
+            full = os.path.join(args.git_root, c)
+            if not os.path.exists(full):
+                continue        # deleted since the ref
+            lc = _logical(full)
+            if lc in root_files or \
+                    any(lc.startswith(pre) for pre in root_prefixes):
+                judged.append(full)
+        if not judged:
+            print(f"no changed Python files under the lint roots "
+                  f"since {args.diff}")
+            return 0
+        program_paths = paths
+        paths = judged
+
+    result = lint_paths(paths, ALL_CHECKERS, rules=rules,
+                        program_paths=program_paths)
     if args.paths and not result.files_scanned:
         print(f"no Python files found under: {', '.join(args.paths)}",
               file=sys.stderr)
@@ -146,12 +277,16 @@ def main(argv=None) -> int:
 
     base = {} if args.no_baseline \
         else baseline_mod.load(args.baseline)
-    if base and (rules is not None or args.paths):
-        # a rule-/path-filtered run only re-examined a subset of the
-        # baseline; diffing against the full file would falsely
-        # report every out-of-filter entry as stale
-        examined_paths = _ExaminedPaths(args.paths,
-                                        result.scanned_paths)
+    path_filtered = bool(args.paths) or args.diff is not None
+    if base and (rules is not None or path_filtered):
+        # a rule-/path-/diff-filtered run only re-examined a subset
+        # of the baseline; diffing against the full file would
+        # falsely report every out-of-filter entry as stale.  In
+        # --diff mode the examined set is exactly the judged files
+        # (all file args, so no directory prefixes).
+        examined_paths = _ExaminedPaths(
+            paths if args.diff is not None else args.paths,
+            result.scanned_paths)
 
         def _examined(fp: str) -> bool:
             parts = fp.split("::", 3)
@@ -163,7 +298,7 @@ def main(argv=None) -> int:
             rule, fpath = parts[:2]
             if rules is not None and rule not in rules:
                 return False
-            if args.paths and fpath not in examined_paths:
+            if path_filtered and fpath not in examined_paths:
                 return False
             return True
         base = {fp: e for fp, e in base.items() if _examined(fp)}

@@ -83,6 +83,11 @@ class FileContext:
         self._line_suppress: dict[int, set[str]] = {}
         self._file_suppress: set[str] = set()
         self._index_pragmas()
+        # whole-package call graph + effect summaries, attached by
+        # lint_paths before checkers run (tools/bftlint/callgraph.py);
+        # None for a bare FileContext — checkers must fall back to
+        # their intra-procedural behavior then
+        self.program = None
 
     # -- pragmas ------------------------------------------------------
     def _index_pragmas(self) -> None:
@@ -259,14 +264,28 @@ def iter_python_files(paths: Iterable[str]) -> Iterator[str]:
 
 def lint_paths(paths: Iterable[str], checkers: Iterable[Checker],
                rules: Optional[set[str]] = None,
-               repo_root: str = _REPO_ROOT) -> LintResult:
-    """Parse each file once, run every in-scope checker over it, and
-    drop inline-suppressed findings.  Baseline filtering is the
-    caller's concern (tools/bftlint/baseline.py)."""
+               repo_root: str = _REPO_ROOT,
+               program_paths: Optional[Iterable[str]] = None
+               ) -> LintResult:
+    """Parse each file once, build the whole-corpus call graph
+    (callgraph.py) once, run every in-scope checker over each judged
+    file, and drop inline-suppressed findings.  Baseline filtering is
+    the caller's concern (tools/bftlint/baseline.py).
+
+    ``program_paths`` widens the *summary corpus* beyond the judged
+    ``paths``: ``check --diff`` judges only changed files but still
+    feeds the entire package to the call graph so interprocedural
+    summaries stay sound.  Corpus-only files that fail to parse
+    contribute nothing (their calls resolve to the explicit unknown
+    summary) but do not fail the run — they will when judged."""
+    # lazy import: callgraph imports core's FileContext helpers
+    from .callgraph import build_program
     checkers = list(checkers)
     if rules:
         checkers = [c for c in checkers if c.rule in rules]
     result = LintResult()
+    judged: list[FileContext] = []
+    corpus: dict[str, FileContext] = {}     # realpath -> ctx
     for path in iter_python_files(paths):
         try:
             with open(path, encoding="utf-8") as f:
@@ -275,6 +294,23 @@ def lint_paths(paths: Iterable[str], checkers: Iterable[Checker],
         except (SyntaxError, UnicodeDecodeError, OSError) as e:
             result.parse_errors.append(f"{path}: {e}")
             continue
+        judged.append(ctx)
+        corpus[os.path.realpath(path)] = ctx
+    if program_paths is not None:
+        for path in iter_python_files(program_paths):
+            real = os.path.realpath(path)
+            if real in corpus:
+                continue
+            try:
+                with open(path, encoding="utf-8") as f:
+                    source = f.read()
+                corpus[real] = FileContext(path, source,
+                                           repo_root=repo_root)
+            except (SyntaxError, UnicodeDecodeError, OSError):
+                continue
+    program = build_program(corpus.values())
+    for ctx in judged:
+        ctx.program = program
         result.files_scanned += 1
         result.scanned_paths.add(ctx.logical_path)
         for checker in checkers:

@@ -35,9 +35,11 @@ decomposition the flight recorder attributes per height):
     and building/verifying a 256-key proof envelope (224 existence +
     32 non-inclusion arms under one multiproof);
   * ``bftlint_selfcheck``      — the full-package bftlint run that
-    gates tier-1 (tests/test_bftlint.py); a pathological checker
-    (an accidental O(n^2) walk) must not blow the tier-1 budget, so
-    this is pinned < ~5s via an explicit tolerance.
+    gates tier-1 (tests/test_bftlint.py), including the ISSUE 20
+    whole-package call graph + effect summaries (built once per run,
+    shared by every checker); a pathological checker (an accidental
+    O(n^2) walk) or a diverging fixed point must not blow the tier-1
+    budget, so this is pinned < ~8s via an explicit tolerance.
 
 Modes:
   run                 run the suite, print a JSON report
